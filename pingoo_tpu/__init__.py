@@ -1,0 +1,30 @@
+"""pingoo-tpu: a TPU-native edge-security framework.
+
+A from-scratch rebuild of the capabilities of pingooio/pingoo (reference:
+/root/reference) — load balancer / API gateway / reverse proxy with a
+WAF/bot-protection rules engine — designed TPU-first: the per-request rule
+evaluation (reference: pingoo/rules.rs:37-51, pingoo/listeners/
+http_listener.rs:251-264) is lifted into batched JAX/XLA/Pallas kernels that
+score thousands of buffered requests at once, with IP/ASN blocklists as
+on-HBM bitsets (reference: pingoo/lists.rs) and a vectorized bot-score head
+(reference: pingoo/captcha.rs).
+
+Layout:
+  expr/     — the rule expression language (CEL subset compatible with the
+              reference's `bel` crate surface, docs/rules.md) + CPU
+              interpreter (the parity oracle)
+  compiler/ — rule AST -> typed predicate IR -> TPU lowering (pattern
+              tables, bit-parallel NFAs, bitsets, boolean programs)
+  ops/      — the JAX/Pallas device ops (byte-tensor matching, NFA scan,
+              CIDR/bitset membership)
+  engine/   — batched verdict engine: request encoding, jitted verdict
+              step, adaptive batching service
+  parallel/ — device mesh, dp/tp/sp shardings, ring sequence scan
+  config/   — YAML config loading/validation (reference: pingoo/config/)
+  host/     — host data plane: listeners, proxy services, discovery, TLS,
+              captcha/JWT, GeoIP (reference: pingoo/listeners, services,
+              service_discovery, tls, captcha.rs, geoip.rs)
+  models/   — learned components (bot-score head)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,16 @@
+"""Test configuration.
+
+Tests run on the CPU backend with 8 virtual devices so sharding/mesh code
+paths (dp x tp x sp) are exercised without TPU hardware, per SURVEY.md §4
+item (4). Must run before the first `import jax` anywhere in the test
+process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

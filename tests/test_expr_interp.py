@@ -1,0 +1,317 @@
+"""Interpreter semantics tests — the conformance table for the language.
+
+These encode the parity-oracle semantics the TPU compiler must reproduce
+(SURVEY.md §4 test plan item (1): conformance table from docs/rules.md:40-76
+plus observed reference semantics).
+"""
+
+import math
+
+import pytest
+
+from pingoo_tpu.expr import (
+    Context,
+    EvalError,
+    Ip,
+    compile_expression,
+    execute_as_bool,
+)
+
+
+def run(src, variables=None):
+    return compile_expression(src).execute(Context(variables or {}))
+
+
+def request_ctx(**over):
+    """A context shaped like the reference's (http_listener.rs:238-249)."""
+    http_request = {
+        "host": "example.com",
+        "url": "/index.html?q=1",
+        "path": "/index.html",
+        "method": "GET",
+        "user_agent": "Mozilla/5.0",
+    }
+    client = {
+        "ip": Ip("203.0.113.7"),
+        "remote_port": 54321,
+        "asn": 64500,
+        "country": "FR",
+    }
+    lists = {
+        "blocked_ips": [Ip("127.0.0.1"), Ip("10.0.0.0/8"), Ip("203.0.113.0/24")],
+        "blocked_asns": [64500, 64501],
+        "bad_paths": ["/admin", "/.env"],
+    }
+    base = {"http_request": http_request, "client": client, "lists": lists}
+    base.update(over)
+    return Context(base)
+
+
+class TestArithmetic:
+    @pytest.mark.parametrize(
+        "src,expected",
+        [
+            ("1 + 2", 3),
+            ("5 - 8", -3),
+            ("6 * 7", 42),
+            ("7 / 2", 3),
+            ("-7 / 2", -3),  # Rust i64: truncates toward zero
+            ("7 % 2", 1),
+            ("-7 % 2", -1),  # Rust %: dividend's sign
+            ("7 % -2", 1),
+            ("1.5 + 2.0", 3.5),
+            ("1 + 2.5", 3.5),  # Int/Float promotion
+            ("7.0 / 2", 3.5),
+            ("-3", -3),
+            ("--3", 3),
+            ('"a" + "b"', "ab"),
+            ("[1] + [2]", [1, 2]),
+        ],
+    )
+    def test_values(self, src, expected):
+        assert run(src) == expected
+
+    def test_int_div_by_zero_errors(self):
+        with pytest.raises(EvalError, match="division by zero"):
+            run("1 / 0")
+        with pytest.raises(EvalError, match="division by zero"):
+            run("1 % 0")
+
+    def test_float_div_by_zero_is_ieee(self):
+        assert run("1.0 / 0.0") == math.inf
+        assert run("-1.0 / 0.0") == -math.inf
+        assert math.isnan(run("0.0 / 0.0"))
+
+    def test_overflow_errors(self):
+        with pytest.raises(EvalError, match="overflow"):
+            run("9223372036854775807 + 1")
+        with pytest.raises(EvalError, match="overflow"):
+            run("-9223372036854775807 - 2")
+
+    def test_type_errors(self):
+        with pytest.raises(EvalError):
+            run('1 + "a"')
+        with pytest.raises(EvalError):
+            run("true + true")
+        with pytest.raises(EvalError):
+            run('-"a"')
+
+
+class TestFloatEdgeCases:
+    def test_inf_modulo_is_nan_not_crash(self):
+        assert math.isnan(run("(1.0 / 0.0) % 2.0"))
+        assert math.isnan(run("2.0 % 0.0"))
+        assert math.isnan(run("(0.0 / 0.0) % 2.0"))
+
+    def test_nan_divided_by_zero_is_nan(self):
+        assert math.isnan(run("(0.0 / 0.0) / 0.0"))
+
+
+class TestIntLiteralRange:
+    def test_i64_bounds_writable(self):
+        assert run("9223372036854775807") == 2**63 - 1
+        assert run("-9223372036854775808") == -(2**63)
+
+    def test_out_of_range_literal_rejected(self):
+        from pingoo_tpu.expr import CompileError
+
+        with pytest.raises(CompileError, match="i64 range"):
+            compile_expression("9223372036854775808")
+        with pytest.raises(CompileError, match="i64 range"):
+            compile_expression("-9223372036854775809")
+        with pytest.raises(CompileError, match="i64 range"):
+            compile_expression("0xFFFFFFFFFFFFFFFF")
+
+
+class TestComparison:
+    @pytest.mark.parametrize(
+        "src,expected",
+        [
+            ("1 == 1", True),
+            ("1 != 2", True),
+            ("1 == 1.0", True),  # numeric cross-type
+            ("1 < 2", True),
+            ("2 <= 2", True),
+            ("3 > 2.5", True),
+            ('"a" < "b"', True),
+            ('"abc" == "abc"', True),
+            ('"Z" < "a"', True),  # byte-wise ordering
+            ("true == true", True),
+            ("false != true", True),
+            ("[1, 2] == [1, 2]", True),
+            ("[1, 2] == [1, 3]", False),
+            ("[1] == [1, 2]", False),
+            ('{"a": 1} == {"a": 1}', True),
+            ('{"a": 1} == {"a": 2}', False),
+        ],
+    )
+    def test_values(self, src, expected):
+        assert run(src) is expected
+
+    def test_cross_type_equality_is_error(self):
+        with pytest.raises(EvalError):
+            run('1 == "1"')
+        with pytest.raises(EvalError):
+            run("true == 1")
+
+    def test_cross_type_order_is_error(self):
+        with pytest.raises(EvalError):
+            run('1 < "2"')
+
+    def test_ip_string_equality(self):
+        ctx = request_ctx()
+        prog = compile_expression('client.ip == "203.0.113.7"')
+        assert prog.execute(ctx) is True
+        prog = compile_expression('client.ip == "203.0.113.8"')
+        assert prog.execute(ctx) is False
+
+    def test_ip_bad_string_is_error(self):
+        ctx = request_ctx()
+        prog = compile_expression('client.ip == "not-an-ip"')
+        with pytest.raises(EvalError):
+            prog.execute(ctx)
+
+
+class TestLogic:
+    def test_short_circuit_or_absorbs_right_error(self):
+        assert run("true || (1 / 0 == 1)") is True
+
+    def test_short_circuit_and_absorbs_right_error(self):
+        assert run("false && (1 / 0 == 1)") is False
+
+    def test_left_error_propagates(self):
+        with pytest.raises(EvalError):
+            run("(1 / 0 == 1) || true")
+
+    def test_non_bool_operand_is_error(self):
+        with pytest.raises(EvalError):
+            run("1 && true")
+        with pytest.raises(EvalError):
+            run("false || 1")
+        # Short-circuit: the right operand is never examined.
+        assert run("true || 1") is True
+
+    def test_not(self):
+        assert run("!false") is True
+        assert run("!!true") is True
+        with pytest.raises(EvalError):
+            run("!1")
+
+
+class TestStringsAndFunctions:
+    def test_string_functions(self):
+        ctx = request_ctx()
+        assert execute_as_bool(
+            compile_expression('http_request.path.starts_with("/index")'), ctx
+        )
+        assert execute_as_bool(
+            compile_expression('http_request.path.ends_with(".html")'), ctx
+        )
+        assert execute_as_bool(
+            compile_expression('http_request.path.contains("ndex")'), ctx
+        )
+        assert run('"hello".length()') == 5
+        assert run('length("hello")') == 5
+
+    def test_length_is_bytes(self):
+        assert run('"é".length()') == 2
+
+    def test_matches(self):
+        ctx = request_ctx()
+        assert compile_expression(
+            'http_request.path.matches("^/index\\\\.")'
+        ).execute(ctx) is True
+        assert compile_expression(
+            'http_request.path.matches("admin")'
+        ).execute(ctx) is False
+
+    def test_matches_is_unanchored(self):
+        assert run('"xxabcxx".matches("abc")') is True
+
+    def test_bad_regex_is_error(self):
+        with pytest.raises(EvalError):
+            run('"a".matches("[")')
+
+    def test_array_contains(self):
+        assert run('[1, 2, 3].contains(2)') is True
+        assert run('["a", "b"].contains("c")') is False
+
+    def test_unknown_function_is_error(self):
+        with pytest.raises(EvalError, match="unknown function"):
+            run('"a".reverse()')
+
+    def test_arity_errors(self):
+        with pytest.raises(EvalError):
+            run('"a".contains()')
+        with pytest.raises(EvalError):
+            run('"a".length(1)')
+
+
+class TestContextAndLists:
+    def test_doc_example_blocked_path(self):
+        # docs/rules.md example: http_request.path == "/blocked"
+        ctx = request_ctx()
+        assert not execute_as_bool(
+            compile_expression('http_request.path == "/blocked"'), ctx
+        )
+
+    def test_default_waf_rule(self):
+        # assets/pingoo.yml basic_waf expression.
+        src = (
+            'http_request.path.starts_with("/.env") || '
+            'http_request.path.starts_with("/.git")'
+        )
+        prog = compile_expression(src)
+        assert not execute_as_bool(prog, request_ctx())
+        ctx = request_ctx()
+        ctx.variables["http_request"] = dict(
+            ctx.variables["http_request"], path="/.env"
+        )
+        assert execute_as_bool(prog, ctx)
+
+    def test_lists_cidr_contains(self):
+        # docs/rules.md:110: lists["blocked_ips"].contains(client.ip)
+        prog = compile_expression('lists["blocked_ips"].contains(client.ip)')
+        assert execute_as_bool(prog, request_ctx())  # 203.0.113.0/24 hit
+        ctx = request_ctx()
+        ctx.variables["client"] = dict(ctx.variables["client"], ip=Ip("8.8.8.8"))
+        assert not execute_as_bool(prog, ctx)
+
+    def test_int_list(self):
+        prog = compile_expression('lists["blocked_asns"].contains(client.asn)')
+        assert execute_as_bool(prog, request_ctx())
+
+    def test_missing_list_is_error_hence_no_match(self):
+        prog = compile_expression('lists["nope"].contains(client.ip)')
+        with pytest.raises(EvalError):
+            prog.execute(request_ctx())
+        assert execute_as_bool(prog, request_ctx()) is False
+
+    def test_unknown_variable(self):
+        with pytest.raises(EvalError):
+            run("nope == 1")
+
+    def test_unknown_field(self):
+        prog = compile_expression("http_request.nope == 1")
+        with pytest.raises(EvalError):
+            prog.execute(request_ctx())
+
+    def test_index_errors(self):
+        with pytest.raises(EvalError):
+            run("[1, 2][5]")
+        with pytest.raises(EvalError):
+            run("[1, 2][-1]")
+        with pytest.raises(EvalError):
+            run('{"a": 1}["b"]')
+        assert run("[10, 20][1]") == 20
+        assert run('{"a": 7}["a"]') == 7
+
+
+class TestRuleMatching:
+    def test_non_bool_result_is_no_match(self):
+        # pingoo/rules.rs:47: result must be exactly `true`.
+        assert execute_as_bool(compile_expression("1 + 1"), Context()) is False
+
+    def test_error_is_no_match(self):
+        # pingoo/rules.rs:41-44: execution error -> false.
+        assert execute_as_bool(compile_expression("1 / 0 == 1"), Context()) is False

@@ -1,0 +1,124 @@
+"""Lexer + parser tests for the expression language."""
+
+import pytest
+
+from pingoo_tpu.expr import CompileError, parse
+from pingoo_tpu.expr import ast
+from pingoo_tpu.expr.lexer import tokenize
+
+
+class TestLexer:
+    def test_operators(self):
+        toks = tokenize("|| && == != <= >= < > + - * / % ! ( ) [ ] { } , . :")
+        lexemes = [t.value for t in toks[:-1]]
+        assert lexemes == [
+            "||", "&&", "==", "!=", "<=", ">=", "<", ">", "+", "-", "*",
+            "/", "%", "!", "(", ")", "[", "]", "{", "}", ",", ".", ":",
+        ]
+
+    def test_numbers(self):
+        toks = tokenize("1 42 0x1F 3.5 1e3 2.5e-2")
+        vals = [t.value for t in toks[:-1]]
+        assert vals == [1, 42, 31, 3.5, 1000.0, 0.025]
+
+    def test_strings_and_escapes(self):
+        toks = tokenize(r'"a\"b" ' + r"'c\n' " + r'"\x41" "B"')
+        vals = [t.value for t in toks[:-1]]
+        assert vals == ['a"b', "c\n", "A", "B"]
+
+    def test_bools_and_idents(self):
+        toks = tokenize("true false http_request _x")
+        assert [t.kind for t in toks[:-1]] == ["BOOL", "BOOL", "IDENT", "IDENT"]
+        assert toks[0].value is True and toks[1].value is False
+
+    def test_comments(self):
+        toks = tokenize("1 // comment\n + 2")
+        assert [t.value for t in toks[:-1]] == [1, "+", 2]
+
+    def test_in_rejected(self):
+        # Reference parity: rules/rules.rs:69-71 rejects the `in` operator.
+        with pytest.raises(CompileError, match="unknown operator: in"):
+            tokenize('"a" in ["a"]')
+
+    def test_unterminated_string(self):
+        with pytest.raises(CompileError, match="unterminated"):
+            tokenize('"abc')
+
+    def test_unknown_escapes_preserved(self):
+        # Regex-heavy rule strings must survive: "\s" stays "\s".
+        toks = tokenize(r'"union\s+select"')
+        assert toks[0].value == "union\\s+select"
+
+    def test_surrogate_escape_rejected(self):
+        with pytest.raises(CompileError, match="surrogate"):
+            tokenize(r'"\ud800"')
+
+    def test_bad_char(self):
+        with pytest.raises(CompileError, match="unexpected character"):
+            tokenize("a @ b")
+
+
+class TestParser:
+    def test_empty_invalid(self):
+        # Reference parity: rules/rules.rs:56-58.
+        for src in ("", "   ", "\n"):
+            with pytest.raises(CompileError, match="empty"):
+                parse(src)
+
+    def test_precedence(self):
+        node = parse("1 + 2 * 3 == 7 && true || false")
+        assert isinstance(node, ast.Logical) and node.op == "||"
+        left = node.left
+        assert isinstance(left, ast.Logical) and left.op == "&&"
+        cmp_node = left.left
+        assert isinstance(cmp_node, ast.Binary) and cmp_node.op == "=="
+        add = cmp_node.left
+        assert isinstance(add, ast.Binary) and add.op == "+"
+        mul = add.right
+        assert isinstance(mul, ast.Binary) and mul.op == "*"
+
+    def test_member_and_index(self):
+        node = parse('lists["blocked"].contains(client.ip)')
+        assert isinstance(node, ast.Call) and node.func == "contains"
+        assert isinstance(node.recv, ast.Index)
+        assert isinstance(node.recv.obj, ast.Ident) and node.recv.obj.name == "lists"
+        (arg,) = node.args
+        assert isinstance(arg, ast.Member) and arg.attr == "ip"
+
+    def test_method_chain(self):
+        node = parse('http_request.path.starts_with("/.env")')
+        assert isinstance(node, ast.Call) and node.func == "starts_with"
+        assert isinstance(node.recv, ast.Member) and node.recv.attr == "path"
+
+    def test_non_associative_relations(self):
+        with pytest.raises(CompileError, match="non-associative"):
+            parse("1 < 2 < 3")
+
+    def test_array_and_map_literals(self):
+        node = parse('[1, 2, 3]')
+        assert isinstance(node, ast.ArrayLit) and len(node.items) == 3
+        node = parse('{"a": 1, "b": 2}')
+        assert isinstance(node, ast.MapLit) and len(node.entries) == 2
+
+    def test_unary_chains(self):
+        node = parse("!!true")
+        assert isinstance(node, ast.Unary) and isinstance(node.operand, ast.Unary)
+        # Negative numeric literals constant-fold (so i64::MIN is writable).
+        node = parse("--1")
+        assert isinstance(node, ast.Literal) and node.value == 1
+        node = parse("-x")
+        assert isinstance(node, ast.Unary)
+
+    def test_trailing_garbage(self):
+        with pytest.raises(CompileError, match="trailing"):
+            parse("1 + 2 3")
+
+    def test_unbalanced(self):
+        with pytest.raises(CompileError):
+            parse("(1 + 2")
+        with pytest.raises(CompileError):
+            parse("a[1")
+
+    def test_free_function_call(self):
+        node = parse("length(http_request.path)")
+        assert isinstance(node, ast.Call) and node.recv is None
