@@ -1,0 +1,11 @@
+"""Rule compiler: AST -> predicate IR -> device tables (TPU lowering)."""
+
+from .lowering import DEFAULT_FIELD_SPECS, LowerError
+from .plan import RulesetPlan, compile_ruleset
+
+__all__ = [
+    "DEFAULT_FIELD_SPECS",
+    "LowerError",
+    "RulesetPlan",
+    "compile_ruleset",
+]

@@ -1,0 +1,294 @@
+"""Bit-parallel NFA banks: packing linear patterns into uint32 lanes.
+
+An `NfaBank` holds every contains/regex predicate that scans one request
+field (path, url, host, user_agent, ...). Patterns are packed into uint32
+words — one guard bit + one bit per position, each pattern confined to a
+single word — and executed as extended Shift-And (Glushkov over linear
+patterns) with pure bitwise ops:
+
+    inj  = INIT_unanchored | (t == 0 ? INIT_anchored : 0)
+    adv  = (S << 1) | inj
+    adv |= ((adv & OPT) + OPT) ^ OPT        # skip optional runs (carry trick)
+    pre  = adv | (S & REP)                  # self-loops for x* / x+
+    S'   = pre & B[c]                       # byte-class transition
+    float_matches |= S' & LAST_FLOAT        # accept for non-$ patterns
+    ...after the scan: end_matches = S_final & LAST_END   # $ patterns
+
+The optional-skip identity: within a run of consecutive OPT bits, adding
+(adv & OPT) to OPT carries through the run; XOR with OPT recovers every
+position from the first active bit through one past the run's end —
+exactly the Glushkov epsilon-skip closure for linear patterns.
+
+This module builds the (numpy) tables; ops/nfa_scan.py executes them in
+JAX; `simulate` is the pure-Python oracle used by differential tests
+(pattern semantics are verified three ways: Python `re` (bytes mode) ==
+`simulate` == the bit-parallel scan).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .repat import LinearPattern, Pos, Quant, Unsupported
+
+WORD_BITS = 32
+
+
+def _skippable(p: Pos) -> bool:
+    return p.quant in (Quant.OPT, Quant.STAR)
+
+
+def _repeatable(p: Pos) -> bool:
+    return p.quant in (Quant.STAR, Quant.PLUS)
+
+
+def simulate(lp: LinearPattern, data: bytes) -> bool:
+    """Pure-Python Glushkov simulation of one linear pattern (oracle).
+
+    `$` semantics follow Python `re` in bytes mode (the interpreter's
+    engine, expr/values.py): it accepts at the end of input AND just
+    before one trailing newline.
+    """
+    m = len(lp.positions)
+    if m == 0 or lp.min_len == 0:
+        if not (lp.anchor_start and lp.anchor_end):
+            return True
+        # ^...$ with nothing required: empty input, or empty before a
+        # lone trailing newline, or fall through to the NFA (m>0).
+        if len(data) == 0 or data == b"\n":
+            return True
+        if m == 0:
+            return False
+    last_set = _last_set(lp)
+    active: set[int] = set()
+    matched = False
+    ends_nl = len(data) > 0 and data[-1] == 0x0A
+    for t, c in enumerate(data):
+        inject = (t == 0) or not lp.anchor_start
+        nxt: set[int] = set()
+        candidates: set[int] = set()
+        if inject:
+            candidates |= _closure_from(lp, 0)
+        for i in active:
+            if _repeatable(lp.positions[i]):
+                candidates.add(i)
+            if i + 1 < m:
+                candidates |= _closure_from(lp, i + 1)
+        for i in candidates:
+            if c in lp.positions[i].bytes:
+                nxt.add(i)
+        active = nxt
+        if not lp.anchor_end and active & last_set:
+            matched = True
+        if lp.anchor_end and ends_nl and t == len(data) - 2 and active & last_set:
+            matched = True  # accept just before the trailing newline
+    if lp.anchor_end:
+        return matched or bool(active & last_set)
+    return matched
+
+
+def _closure_from(lp: LinearPattern, start: int) -> set[int]:
+    """Positions reachable as 'next consumed' entering at `start`:
+    start itself plus everything past a run of skippable positions."""
+    out = set()
+    i = start
+    m = len(lp.positions)
+    while i < m:
+        out.add(i)
+        if _skippable(lp.positions[i]):
+            i += 1
+        else:
+            break
+    return out
+
+
+def _last_set(lp: LinearPattern) -> set[int]:
+    """Accept positions: i such that every later position is skippable."""
+    out = set()
+    for i in range(len(lp.positions) - 1, -1, -1):
+        out.add(i)
+        if not _skippable(lp.positions[i]):
+            break
+    return out
+
+
+@dataclass(frozen=True)
+class PatternSlot:
+    """Where one pattern lives in the bank + its accept metadata."""
+
+    word: int
+    accept_mask: int  # last-set bits
+    end_anchored: bool
+    always_match: bool  # min_len == 0 and not (^ and $)
+    empty_ok: bool  # ^...$ with min_len == 0: matches empty input
+
+
+@dataclass
+class NfaBank:
+    """Packed bit-parallel tables for one field's pattern group."""
+
+    num_words: int = 0
+    byte_table: np.ndarray = field(
+        default_factory=lambda: np.zeros((256, 0), dtype=np.uint32)
+    )  # [256, W]
+    init_anchored: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.uint32)
+    )  # [W] injected at t==0 only
+    init_unanchored: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.uint32)
+    )  # [W] injected every step
+    opt: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.uint32))
+    rep: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.uint32))
+    last_float: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.uint32)
+    )  # accept bits of patterns without $
+    last_end: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.uint32)
+    )  # accept bits of $-anchored patterns
+    slots: list[PatternSlot] = field(default_factory=list)
+
+    @property
+    def num_patterns(self) -> int:
+        return len(self.slots)
+
+
+def build_bank(patterns: list[LinearPattern]) -> NfaBank:
+    """Pack linear patterns into an NfaBank (first-fit into uint32 words)."""
+    bank = NfaBank()
+    word_used: list[int] = []  # bits used per word
+
+    byte_rows: list[dict[int, int]] = []  # per word: byte -> mask
+    init_a: list[int] = []
+    init_u: list[int] = []
+    opt: list[int] = []
+    rep: list[int] = []
+    last_f: list[int] = []
+    last_e: list[int] = []
+
+    for lp in patterns:
+        m = len(lp.positions)
+        always = lp.min_len == 0 and not (lp.anchor_start and lp.anchor_end)
+        empty_ok = lp.min_len == 0 and lp.anchor_start and lp.anchor_end
+        if m == 0 or always:
+            # Constant or empty-only patterns carry no device state: "" or
+            # "a*" unanchored match everything (always); "^$" matches only
+            # empty input (empty_ok with accept_mask 0).
+            bank.slots.append(
+                PatternSlot(word=0, accept_mask=0, end_anchored=lp.anchor_end,
+                            always_match=always, empty_ok=empty_ok)
+            )
+            continue
+        need = m + 1  # one guard bit
+        if need > WORD_BITS:
+            raise Unsupported(f"pattern needs {need} bits > {WORD_BITS}")
+        # First-fit placement.
+        w = -1
+        for idx, used in enumerate(word_used):
+            if used + need <= WORD_BITS:
+                w = idx
+                break
+        if w == -1:
+            word_used.append(0)
+            byte_rows.append({})
+            init_a.append(0)
+            init_u.append(0)
+            opt.append(0)
+            rep.append(0)
+            last_f.append(0)
+            last_e.append(0)
+            w = len(word_used) - 1
+        base = word_used[w] + 1  # skip guard bit at word_used[w]
+        word_used[w] += need
+
+        bit = lambda i: 1 << (base + i)  # noqa: E731
+        for i, pos in enumerate(lp.positions):
+            for b in pos.bytes:
+                byte_rows[w][b] = byte_rows[w].get(b, 0) | bit(i)
+            if _skippable(pos):
+                opt[w] |= bit(i)
+            if _repeatable(pos):
+                rep[w] |= bit(i)
+        if lp.anchor_start:
+            init_a[w] |= bit(0)
+        else:
+            init_u[w] |= bit(0)
+        accept_mask = 0
+        for i in _last_set(lp):
+            accept_mask |= bit(i)
+        if lp.anchor_end:
+            last_e[w] |= accept_mask
+        else:
+            last_f[w] |= accept_mask
+        bank.slots.append(
+            PatternSlot(word=w, accept_mask=accept_mask,
+                        end_anchored=lp.anchor_end, always_match=False,
+                        empty_ok=empty_ok)
+        )
+
+    W = len(word_used)
+    bank.num_words = W
+    table = np.zeros((256, W), dtype=np.uint32)
+    for w in range(W):
+        for b, mask in byte_rows[w].items():
+            table[b, w] = mask
+    bank.byte_table = table
+    bank.init_anchored = np.array(init_a, dtype=np.uint32)
+    bank.init_unanchored = np.array(init_u, dtype=np.uint32)
+    bank.opt = np.array(opt, dtype=np.uint32)
+    bank.rep = np.array(rep, dtype=np.uint32)
+    bank.last_float = np.array(last_f, dtype=np.uint32)
+    bank.last_end = np.array(last_e, dtype=np.uint32)
+    return bank
+
+
+def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Reference bitwise scan in numpy (same algebra as the JAX op).
+
+    data: [B, L] uint8, lengths: [B] -> matched [B, P] bool.
+    """
+    B, L = data.shape
+    W = bank.num_words
+    S = np.zeros((B, W), dtype=np.uint32)
+    float_acc = np.zeros((B, W), dtype=np.uint32)
+    end_acc = np.zeros((B, W), dtype=np.uint32)
+    # `$` accepts at end of input or just before one trailing newline
+    # (Python-re semantics; see simulate()).
+    ends_nl = np.zeros(B, dtype=bool)
+    if L > 0:
+        last_byte = data[np.arange(B), np.maximum(lengths - 1, 0)]
+        ends_nl = (lengths > 0) & (last_byte == 0x0A)
+    for t in range(L):
+        c = data[:, t].astype(np.int64)
+        bc = bank.byte_table[c]  # [B, W]
+        inj = bank.init_unanchored[None, :]
+        if t == 0:
+            inj = inj | bank.init_anchored[None, :]
+        adv = ((S << np.uint32(1)) | inj).astype(np.uint32)
+        adv |= ((adv & bank.opt) + bank.opt) ^ bank.opt
+        pre = adv | (S & bank.rep)
+        S_new = (pre & bc).astype(np.uint32)
+        active = (t < lengths)[:, None]
+        S = np.where(active, S_new, S)
+        float_acc |= np.where(active, S_new & bank.last_float, 0).astype(np.uint32)
+        before_nl = (ends_nl & (t == lengths - 2))[:, None]
+        end_acc |= np.where(before_nl, S_new & bank.last_end, 0).astype(np.uint32)
+    end_acc |= S & bank.last_end
+    out = np.zeros((B, bank.num_patterns), dtype=bool)
+    empty_like = (lengths == 0) | (ends_nl & (lengths == 1))
+    for p, slot in enumerate(bank.slots):
+        if slot.always_match:
+            out[:, p] = True
+            continue
+        if slot.end_anchored:
+            if bank.num_words == 0:
+                hit = np.zeros(B, dtype=bool)
+            else:
+                hit = (end_acc[:, slot.word] & np.uint32(slot.accept_mask)) != 0
+            if slot.empty_ok:
+                hit = hit | empty_like
+        else:
+            hit = (float_acc[:, slot.word] & np.uint32(slot.accept_mask)) != 0
+        out[:, p] = hit
+    return out

@@ -1,0 +1,236 @@
+"""Ruleset plan: device table assembly + the batched evaluator builder.
+
+`compile_ruleset` takes the validated rules (config/schema.py RuleConfig)
+plus loaded lists and produces a `RulesetPlan`:
+
+  * every device-lowerable rule becomes a BoolIR over deduplicated leaf
+    predicates (compiler/lowering.py);
+  * leaves are grouped into per-field pattern tables (ops/match_ops.py),
+    per-field NFA banks (compiler/nfa.py -> ops/nfa_scan.py), CIDR/int
+    membership tables (ops/cidr.py);
+  * rules outside the subset keep their compiled Program and are
+    interpreted on host over the same truncated request view, preserving
+    exact verdict parity (the fallback split in SURVEY.md §7).
+
+The plan's `device_tables()` returns one pytree of jnp arrays; the
+verdict function over (tables, batch) lives in engine/verdict.py and is
+traced from the static plan structure, so the whole ruleset compiles to
+one XLA program per batch shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..config.schema import Action, RuleConfig
+from ..expr import Program
+from ..expr.values import Ip
+from . import repat
+from .lowering import (
+    DEFAULT_FIELD_SPECS,
+    IntListPred,
+    IpListPred,
+    IpPred,
+    LeafRegistry,
+    Lowerer,
+    LowerError,
+    NfaPred,
+    NumCmp,
+    StrListPred,
+    StrPred,
+)
+from .nfa import build_bank
+from ..ops.cidr import build_cidr_table, build_int_set, build_v4_buckets, ip_to_words
+from ..ops.match_ops import build_pattern_table, build_suffix_table
+from ..ops.nfa_scan import bank_to_tables
+
+
+@dataclass
+class PlannedRule:
+    name: str
+    actions: tuple[Action, ...]
+    index: int  # original rule order (first-match semantics on host)
+    ir: Optional[object]  # BoolIR when device-lowered
+    program: Optional[Program]  # for host fallback / no-expression rules
+    host: bool  # True -> interpret on host
+    always: bool = False  # rule with no expression matches everything
+
+
+@dataclass
+class LeafBinding:
+    """Where a leaf's [B] result comes from at eval time."""
+
+    kind: str
+    # kind-specific static metadata:
+    field: str = ""
+    group: str = ""  # 'eq' | 'prefix' | 'suffix'
+    col: int = -1
+    span: tuple[int, int] = (0, 0)  # NFA slot range / eq-col range
+    table_key: str = ""  # key into plan tables dict
+    pred: Any = None  # NumCmp / IntListPred probe IR
+
+
+@dataclass
+class RulesetPlan:
+    field_specs: dict[str, int]
+    rules: list[PlannedRule]
+    leaves: list[object]
+    bindings: dict[int, LeafBinding]
+    # static (host-side numpy) table constructors' outputs:
+    np_tables: dict[str, Any] = dc_field(default_factory=dict)
+    stats: dict[str, int] = dc_field(default_factory=dict)
+
+    def device_tables(self) -> dict[str, Any]:
+        """Materialize all tables as device arrays (a pytree)."""
+        import jax.numpy as jnp
+
+        out: dict[str, Any] = {}
+        for key, val in self.np_tables.items():
+            if isinstance(val, np.ndarray):
+                out[key] = jnp.asarray(val)
+            elif isinstance(val, dict):
+                out[key] = {k: jnp.asarray(v) for k, v in val.items()}
+            else:
+                out[key] = val  # already a NamedTuple pytree of jnp arrays
+        return out
+
+    @property
+    def device_rule_indices(self) -> list[int]:
+        return [r.index for r in self.rules if not r.host]
+
+    @property
+    def host_rules(self) -> list[PlannedRule]:
+        return [r for r in self.rules if r.host]
+
+
+def compile_ruleset(
+    rules: list[RuleConfig],
+    lists: dict[str, list],
+    field_specs: Optional[dict[str, int]] = None,
+) -> RulesetPlan:
+    field_specs = dict(field_specs or DEFAULT_FIELD_SPECS)
+    registry = LeafRegistry()
+    lowerer = Lowerer(lists, registry, field_specs)
+
+    planned: list[PlannedRule] = []
+    for idx, rule in enumerate(rules):
+        if rule.expression is None:
+            # No expression -> always matches (pingoo/rules.rs:48-50).
+            planned.append(
+                PlannedRule(name=rule.name, actions=rule.actions, index=idx,
+                            ir=None, program=None, host=False, always=True)
+            )
+            continue
+        try:
+            ir = lowerer.lower_rule(rule.expression.root)
+            planned.append(
+                PlannedRule(name=rule.name, actions=rule.actions, index=idx,
+                            ir=ir, program=rule.expression, host=False)
+            )
+        except LowerError:
+            planned.append(
+                PlannedRule(name=rule.name, actions=rule.actions, index=idx,
+                            ir=None, program=rule.expression, host=True)
+            )
+
+    plan = RulesetPlan(
+        field_specs=field_specs,
+        rules=planned,
+        leaves=registry.leaves,
+        bindings={},
+    )
+    _assemble_tables(plan)
+    plan.stats = {
+        "rules": len(planned),
+        "device_rules": sum(1 for r in planned if not r.host),
+        "host_rules": sum(1 for r in planned if r.host),
+        "leaves": len(registry.leaves),
+    }
+    return plan
+
+
+def _assemble_tables(plan: RulesetPlan) -> None:
+    # Group string predicates per (field, kind).
+    str_groups: dict[tuple[str, str], list[tuple[int, StrPred]]] = {}
+    nfa_groups: dict[str, list[tuple[int, NfaPred]]] = {}
+    ip_preds: list[tuple[int, IpPred]] = []
+
+    for leaf_id, leaf in enumerate(plan.leaves):
+        if isinstance(leaf, StrPred):
+            str_groups.setdefault((leaf.field, leaf.kind), []).append(
+                (leaf_id, leaf))
+        elif isinstance(leaf, NfaPred):
+            nfa_groups.setdefault(leaf.field, []).append((leaf_id, leaf))
+        elif isinstance(leaf, IpPred):
+            ip_preds.append((leaf_id, leaf))
+        elif isinstance(leaf, StrListPred):
+            key = f"strlist_{leaf_id}"
+            plan.np_tables[key] = build_pattern_table(
+                [(e, False) for e in leaf.entries] or [(b"\x00nevermatch", False)]
+            )
+            plan.bindings[leaf_id] = LeafBinding(
+                kind="str_list", field=leaf.field, table_key=key,
+                span=(0, len(leaf.entries)))
+        elif isinstance(leaf, IpListPred):
+            entries = [Ip(e) for e in leaf.entries]
+            key = f"iplist_{leaf_id}"
+            if len(entries) <= 2048:
+                plan.np_tables[key] = build_cidr_table(entries)
+                plan.bindings[leaf_id] = LeafBinding(
+                    kind="ip_list_small", table_key=key)
+            else:
+                plan.np_tables[key] = build_v4_buckets(entries)
+                plan.bindings[leaf_id] = LeafBinding(
+                    kind="ip_list_large", table_key=key)
+        elif isinstance(leaf, IntListPred):
+            key = f"intlist_{leaf_id}"
+            plan.np_tables[key] = build_int_set(list(leaf.values))
+            plan.bindings[leaf_id] = LeafBinding(
+                kind="int_list", table_key=key, pred=leaf.probe)
+        elif isinstance(leaf, NumCmp):
+            plan.bindings[leaf_id] = LeafBinding(kind="num_cmp", pred=leaf)
+        else:
+            raise AssertionError(f"unbound leaf {leaf!r}")
+
+    for (field, kind), entries in str_groups.items():
+        key = f"{kind}_{field}"
+        pats = [(leaf.pattern, leaf.ci) for _, leaf in entries]
+        if kind == "suffix":
+            plan.np_tables[key] = build_suffix_table(pats)
+        else:
+            plan.np_tables[key] = build_pattern_table(pats)
+        for col, (leaf_id, _) in enumerate(entries):
+            plan.bindings[leaf_id] = LeafBinding(
+                kind="str", field=field, group=kind, col=col, table_key=key)
+
+    for field, entries in nfa_groups.items():
+        patterns = []
+        for leaf_id, leaf in entries:
+            if leaf.kind == "contains":
+                alts = [repat.literal_pattern(
+                    leaf.pattern.encode("latin-1"), case_insensitive=leaf.ci)]
+            else:
+                alts = repat.compile_regex(leaf.pattern)
+            start = len(patterns)
+            patterns.extend(alts)
+            plan.bindings[leaf_id] = LeafBinding(
+                kind="nfa", field=field, span=(start, len(patterns)),
+                table_key=f"nfa_{field}")
+        bank = build_bank(patterns)
+        plan.np_tables[f"nfa_{field}"] = bank_to_tables(bank)
+
+    if ip_preds:
+        nets = np.zeros((len(ip_preds), 4), dtype=np.uint32)
+        masks = np.zeros((len(ip_preds), 4), dtype=np.uint32)
+        from ..ops.cidr import _prefix_masks
+
+        for col, (leaf_id, leaf) in enumerate(ip_preds):
+            m = _prefix_masks(leaf.prefix)
+            nets[col] = np.array(leaf.words, dtype=np.uint32) & m
+            masks[col] = m
+            plan.bindings[leaf_id] = LeafBinding(kind="ip_one", col=col,
+                                                 table_key="ip_preds")
+        plan.np_tables["ip_preds"] = {"nets": nets, "masks": masks}

@@ -1,0 +1,535 @@
+"""Regex subset -> linear NFA pattern programs for bit-parallel execution.
+
+The TPU verdict engine executes regex/contains predicates as extended
+Shift-And (bit-parallel Glushkov over *linear* patterns): a pattern is a
+sequence of byte-class positions, each with a quantifier ONE / OPT (x?) /
+STAR (x*) / PLUS (x+), plus start/end anchors. This covers the WAF staples
+(literals, classes, ., \\d\\w\\s, quantifiers, bounded repeats, small
+alternations) with pure uint32 VPU ops on device; anything outside the
+subset (nested quantified groups, backrefs, lookaround, wide expansions)
+is reported Unsupported and the owning rule falls back to host
+interpretation — mirroring the fail-safe split in SURVEY.md §7 "Hard
+parts" ("fallback to host for pathological patterns").
+
+Byte semantics: patterns compile against UTF-8 bytes, consistent with the
+interpreter's bytes-mode `re` (expr/values.py Regex) and with the byte
+tensors the engine scans. `.` matches any byte except \\n. The ASCII-only
+perl classes match Rust regex's (?-u) / RE2 bytes behavior.
+
+Alternation handling: a top-level alternation compiles to multiple linear
+patterns OR-ed at the predicate level; group alternations of single
+chars/classes merge into one byte class; short multi-char group
+alternations expand by cross product (capped).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Iterable
+
+MAX_POSITIONS = 31  # bits per uint32 word, minus one guard bit
+MAX_CROSS_PRODUCT = 16  # cap on alternation expansion
+MAX_REPEAT_EXPANSION = 31
+
+
+class Unsupported(Exception):
+    """Pattern is outside the bit-parallel subset -> host fallback."""
+
+
+class Quant(enum.Enum):
+    ONE = "one"
+    OPT = "opt"  # x?
+    STAR = "star"  # x*
+    PLUS = "plus"  # x+
+
+
+@dataclass(frozen=True)
+class Pos:
+    """One pattern position: a byte class + quantifier."""
+
+    bytes: frozenset[int]
+    quant: Quant = Quant.ONE
+
+
+@dataclass
+class LinearPattern:
+    """A linear NFA: positions consumed left to right."""
+
+    positions: list[Pos] = field(default_factory=list)
+    anchor_start: bool = False
+    anchor_end: bool = False
+
+    @property
+    def min_len(self) -> int:
+        return sum(1 for p in self.positions if p.quant in (Quant.ONE, Quant.PLUS))
+
+    @property
+    def matches_empty(self) -> bool:
+        return self.min_len == 0
+
+
+def literal_pattern(text: bytes, case_insensitive: bool = False) -> LinearPattern:
+    """A plain substring pattern (for `contains`/`starts_with`/... lowering)."""
+    positions = []
+    for b in text:
+        positions.append(Pos(bytes=_fold_byte(b) if case_insensitive else frozenset([b])))
+    if len(positions) > MAX_POSITIONS:
+        raise Unsupported(f"literal longer than {MAX_POSITIONS} bytes")
+    return LinearPattern(positions=positions)
+
+
+def compile_regex(pattern: str) -> list[LinearPattern]:
+    """Compile a regex into alternative linear patterns (match = any).
+
+    Raises Unsupported for constructs outside the subset.
+    """
+    try:
+        data = pattern.encode("latin-1")  # canonical byte view (expr/values.py)
+    except UnicodeEncodeError:
+        raise Unsupported("pattern contains non-byte characters")
+    ci = False
+    # Leading inline flags: (?i) / (?s) / (?i:...) not handled beyond (?i)(?s).
+    while True:
+        if data.startswith(b"(?i)"):
+            ci = True
+            data = data[4:]
+        elif data.startswith(b"(?s)"):
+            # We treat `.` as not matching \n; (?s) changes that.
+            raise Unsupported("(?s) dotall flag")
+        elif data.startswith(b"(?is)") or data.startswith(b"(?si)"):
+            raise Unsupported("(?s) dotall flag")
+        else:
+            break
+    parser = _Parser(data, ci)
+    alts = parser.parse_alternation(top=True)
+    if parser.i < len(parser.data):
+        raise Unsupported(f"unexpected {chr(parser.data[parser.i])!r}")
+    out = []
+    expanded: list[list[_Item]] = []
+    for alt in alts:
+        expanded.extend(_expand_alts(alt))
+    if len(expanded) > MAX_CROSS_PRODUCT:
+        raise Unsupported("too many alternation branches")
+    for alt in expanded:
+        lp = _to_linear(alt)
+        if len(lp.positions) > MAX_POSITIONS:
+            raise Unsupported(f"pattern expands to >{MAX_POSITIONS} positions")
+        out.append(lp)
+    return out
+
+
+def _expand_alts(items: list[_Item]) -> list[list[_Item]]:
+    """Cross-product expansion of group alternations into flat sequences."""
+    seqs: list[list[_Item]] = [[]]
+    for item in items:
+        if item.alts is not None:
+            branches: list[list[_Item]] = []
+            for alt in item.alts:
+                branches.extend(_expand_alts(alt))
+            new_seqs = []
+            for seq in seqs:
+                for branch in branches:
+                    new_seqs.append(seq + branch)
+            seqs = new_seqs
+        elif item.seq is not None and (item.min_rep, item.max_rep) == (1, 1):
+            inner = _expand_alts(item.seq)
+            new_seqs = []
+            for seq in seqs:
+                for branch in inner:
+                    new_seqs.append(seq + branch)
+            seqs = new_seqs
+        else:
+            seqs = [seq + [item] for seq in seqs]
+        if len(seqs) > MAX_CROSS_PRODUCT:
+            raise Unsupported("too many alternation branches")
+    return seqs
+
+
+# -- internal IR before linearization ---------------------------------------
+# An "item" is (Pos | marker) with quantifier applied during linearization.
+# Alternatives are lists of items; _Seq holds expanded sequences.
+
+
+@dataclass
+class _Item:
+    pos: Pos | None = None  # single position
+    seq: list["_Item"] | None = None  # inlined group sequence
+    alts: list[list["_Item"]] | None = None  # group alternation branches
+    min_rep: int = 1
+    max_rep: int = 1  # -1 = unbounded
+    anchor: str | None = None  # "^" or "$"
+
+
+def _to_linear(items: list[_Item]) -> LinearPattern:
+    lp = LinearPattern()
+    flat = _flatten(items)
+    for idx, item in enumerate(flat):
+        if item.anchor == "^":
+            if idx != 0:
+                raise Unsupported("^ not at pattern start")
+            lp.anchor_start = True
+            continue
+        if item.anchor == "$":
+            if idx != len(flat) - 1:
+                raise Unsupported("$ not at pattern end")
+            lp.anchor_end = True
+            continue
+        assert item.pos is not None
+        lp.positions.extend(_expand_quant(item))
+        if len(lp.positions) > MAX_POSITIONS:
+            raise Unsupported(f"pattern expands to >{MAX_POSITIONS} positions")
+    return lp
+
+
+def _flatten(items: list[_Item]) -> list[_Item]:
+    out: list[_Item] = []
+    for item in items:
+        if item.alts is not None:
+            # Alternations survive only under quantified groups; those are
+            # rewritten to alternation in _parse_quant_group, so reaching
+            # here means a shape we can't linearize.
+            raise Unsupported("alternation inside quantified group")
+        if item.seq is not None:
+            # _expand_alts inlined all (1,1) groups; a quantified group
+            # here was already rewritten to an alternation.
+            assert (item.min_rep, item.max_rep) == (1, 1)
+            out.extend(_flatten(item.seq))
+        else:
+            out.append(item)
+    return out
+
+
+def _expand_quant(item: _Item) -> list[Pos]:
+    """Expand a single-position item with {min,max} into positions."""
+    pos = item.pos
+    assert pos is not None
+    lo, hi = item.min_rep, item.max_rep
+    if (lo, hi) == (1, 1):
+        return [pos]
+    # {m,n} repeats only attach to unquantified positions (parser invariant).
+    assert pos.quant == Quant.ONE
+    base = Pos(bytes=pos.bytes)
+    out: list[Pos] = []
+    if hi == -1:
+        # x{n,} -> n-1 required + one PLUS (or STAR for n==0).
+        if lo == 0:
+            out.append(Pos(bytes=pos.bytes, quant=Quant.STAR))
+        else:
+            out.extend([base] * (lo - 1))
+            out.append(Pos(bytes=pos.bytes, quant=Quant.PLUS))
+    else:
+        if hi < lo:
+            raise Unsupported("bad repeat range")
+        if hi > MAX_REPEAT_EXPANSION:
+            raise Unsupported("repeat expansion too large")
+        out.extend([base] * lo)
+        out.extend([Pos(bytes=pos.bytes, quant=Quant.OPT)] * (hi - lo))
+    return out
+
+
+# -- parser ------------------------------------------------------------------
+
+_ANY = frozenset(range(256)) - frozenset([0x0A])  # '.' excludes \n
+_DIGITS = frozenset(range(0x30, 0x3A))
+_WORD = (
+    frozenset(range(0x30, 0x3A))
+    | frozenset(range(0x41, 0x5B))
+    | frozenset(range(0x61, 0x7B))
+    | frozenset([0x5F])
+)
+_SPACE = frozenset([0x09, 0x0A, 0x0B, 0x0C, 0x0D, 0x20])
+_ALL = frozenset(range(256))
+
+
+def _fold_byte(b: int) -> frozenset[int]:
+    if 0x41 <= b <= 0x5A:
+        return frozenset([b, b + 0x20])
+    if 0x61 <= b <= 0x7A:
+        return frozenset([b, b - 0x20])
+    return frozenset([b])
+
+
+def _fold_class(cls: frozenset[int]) -> frozenset[int]:
+    out = set(cls)
+    for b in cls:
+        out |= _fold_byte(b)
+    return frozenset(out)
+
+
+class _Parser:
+    def __init__(self, data: bytes, ci: bool):
+        self.data = data
+        self.i = 0
+        self.ci = ci
+
+    def parse_alternation(self, top: bool = False) -> list[list[_Item]]:
+        """Returns list of alternative item sequences."""
+        alts: list[list[_Item]] = [[]]
+        while self.i < len(self.data):
+            c = self.data[self.i]
+            if c == ord("|"):
+                self.i += 1
+                alts.append([])
+                continue
+            if c == ord(")"):
+                if top:
+                    raise Unsupported("unbalanced )")
+                break
+            item = self.parse_item()
+            if item is not None:
+                alts[-1].append(item)
+        if len(alts) > MAX_CROSS_PRODUCT:
+            raise Unsupported("too many alternation branches")
+        return alts
+
+    def parse_item(self) -> _Item | None:
+        c = self.data[self.i]
+        if c == ord("^"):
+            self.i += 1
+            return _Item(anchor="^")
+        if c == ord("$"):
+            self.i += 1
+            return _Item(anchor="$")
+        if c == ord("("):
+            return self._parse_group()
+        atom = self._parse_atom()
+        return self._parse_quant(atom)
+
+    def _parse_group(self) -> _Item:
+        assert self.data[self.i] == ord("(")
+        self.i += 1
+        if self.data[self.i : self.i + 2] == b"?:":
+            self.i += 2
+        elif self.data[self.i : self.i + 1] == b"?":
+            raise Unsupported("special group (?...)")
+        alts = self.parse_alternation()
+        if self.i >= len(self.data) or self.data[self.i] != ord(")"):
+            raise Unsupported("unbalanced (")
+        self.i += 1
+        if len(alts) == 1:
+            item = _Item(seq=alts[0])
+        else:
+            merged = _merge_single_char_alts(alts)
+            if merged is not None:
+                item = _Item(pos=merged)
+            else:
+                # Multi-char alternation inside a group: expanded by cross
+                # product in _expand_alts (unquantified groups only).
+                item = _Item(alts=alts)
+        return self._parse_quant_group(item)
+
+    def _parse_quant_group(self, item: _Item) -> _Item:
+        quant = self._peek_quant()
+        if quant is None:
+            return item
+        lo, hi, lazy = quant
+        if lazy:
+            raise Unsupported("lazy quantifier")
+        # A quantified group that is a single position quantifies that
+        # position directly: (x){2,4}.
+        if item.seq is not None and len(item.seq) == 1 and item.seq[0].pos is not None \
+                and item.seq[0].pos.quant == Quant.ONE \
+                and (item.seq[0].min_rep, item.seq[0].max_rep) == (1, 1):
+            return _Item(pos=item.seq[0].pos, min_rep=lo, max_rep=hi)
+        # Multi-position group X{lo,hi}: per-position quantifiers cannot
+        # express "skip the whole group" ((abc)? as a?b?c? would wrongly
+        # match "ac"), so rewrite to an alternation of exact repetitions:
+        # X{0,2} -> ( | X | XX ). Unbounded -> Unsupported.
+        if hi == -1:
+            raise Unsupported("unbounded repeat of multi-char group")
+        if hi - lo + 1 > MAX_CROSS_PRODUCT or hi > MAX_REPEAT_EXPANSION:
+            raise Unsupported("repeat expansion too large")
+        branches: list[list[_Item]] = []
+        for k in range(lo, hi + 1):
+            branches.append([item] * k)  # items are read-only downstream
+        return _Item(alts=branches)
+
+    def _parse_quant(self, pos: Pos) -> _Item:
+        quant = self._peek_quant()
+        if quant is None:
+            return _Item(pos=pos)
+        lo, hi, lazy = quant
+        if lazy:
+            raise Unsupported("lazy quantifier")
+        if (lo, hi) == (0, 1):
+            return _Item(pos=Pos(bytes=pos.bytes, quant=Quant.OPT))
+        if (lo, hi) == (0, -1):
+            return _Item(pos=Pos(bytes=pos.bytes, quant=Quant.STAR))
+        if (lo, hi) == (1, -1):
+            return _Item(pos=Pos(bytes=pos.bytes, quant=Quant.PLUS))
+        return _Item(pos=pos, min_rep=lo, max_rep=hi)
+
+    def _peek_quant(self) -> tuple[int, int, bool] | None:
+        if self.i >= len(self.data):
+            return None
+        c = self.data[self.i]
+        lo: int
+        hi: int
+        if c == ord("?"):
+            self.i += 1
+            lo, hi = 0, 1
+        elif c == ord("*"):
+            self.i += 1
+            lo, hi = 0, -1
+        elif c == ord("+"):
+            self.i += 1
+            lo, hi = 1, -1
+        elif c == ord("{"):
+            j = self.data.find(b"}", self.i)
+            if j == -1:
+                raise Unsupported("unbalanced {")
+            body = self.data[self.i + 1 : j]
+            try:
+                if b"," in body:
+                    lo_s, hi_s = body.split(b",", 1)
+                    lo = int(lo_s)
+                    hi = int(hi_s) if hi_s.strip() else -1
+                else:
+                    lo = hi = int(body)
+            except ValueError:
+                raise Unsupported(f"bad repeat {body!r}")
+            self.i = j + 1
+        else:
+            return None
+        lazy = False
+        if self.i < len(self.data) and self.data[self.i] == ord("?"):
+            lazy = True
+            self.i += 1
+        if self.i < len(self.data) and self.data[self.i] in b"?*+{":
+            raise Unsupported("stacked quantifiers")
+        return lo, hi, lazy
+
+    def _parse_atom(self) -> Pos:
+        c = self.data[self.i]
+        if c == ord("."):
+            self.i += 1
+            return Pos(bytes=_ANY)
+        if c == ord("["):
+            return self._parse_class()
+        if c == ord("\\"):
+            cls = self._parse_escape()
+            return Pos(bytes=_fold_class(cls) if self.ci else cls)
+        if c in b"*+?{":
+            raise Unsupported("quantifier with nothing to repeat")
+        self.i += 1
+        return Pos(bytes=_fold_byte(c) if self.ci else frozenset([c]))
+
+    def _parse_escape(self) -> frozenset[int]:
+        assert self.data[self.i] == ord("\\")
+        self.i += 1
+        if self.i >= len(self.data):
+            raise Unsupported("trailing backslash")
+        c = self.data[self.i]
+        self.i += 1
+        simple = {
+            ord("d"): _DIGITS,
+            ord("D"): _ALL - _DIGITS,
+            ord("w"): _WORD,
+            ord("W"): _ALL - _WORD,
+            ord("s"): _SPACE,
+            ord("S"): _ALL - _SPACE,
+            ord("n"): frozenset([0x0A]),
+            ord("r"): frozenset([0x0D]),
+            ord("t"): frozenset([0x09]),
+            ord("f"): frozenset([0x0C]),
+            ord("v"): frozenset([0x0B]),
+            ord("0"): frozenset([0x00]),
+        }
+        if c in simple:
+            return simple[c]
+        if c == ord("x"):
+            if self.i + 2 > len(self.data):
+                raise Unsupported("bad \\x escape")
+            try:
+                val = int(self.data[self.i : self.i + 2], 16)
+            except ValueError:
+                raise Unsupported("bad \\x escape")
+            self.i += 2
+            return frozenset([val])
+        if c in b"bBAZz":
+            raise Unsupported(f"\\{chr(c)} boundary assertion")
+        if c in b"123456789":
+            raise Unsupported("backreference")
+        # Any other letter escape is invalid in the oracle (Python re:
+        # "bad escape") or has semantics we don't implement — never treat
+        # it as a literal, or device and host would diverge.
+        if (0x41 <= c <= 0x5A) or (0x61 <= c <= 0x7A):
+            raise Unsupported(f"escape \\{chr(c)}")
+        # Escaped punctuation: literal byte.
+        return frozenset([c])
+
+    def _parse_class(self) -> Pos:
+        assert self.data[self.i] == ord("[")
+        self.i += 1
+        negate = False
+        if self.i < len(self.data) and self.data[self.i] == ord("^"):
+            negate = True
+            self.i += 1
+        members: set[int] = set()
+        first = True
+        while self.i < len(self.data):
+            c = self.data[self.i]
+            if c == ord("]") and not first:
+                self.i += 1
+                cls = frozenset(members)
+                # Fold BEFORE negation: (?i)[^a] excludes both cases; folding
+                # after negation would re-add the excluded letters.
+                if self.ci:
+                    cls = _fold_class(cls)
+                if negate:
+                    cls = _ALL - cls
+                return Pos(bytes=cls)
+            first = False
+            if c == ord("\\"):
+                sub = self._parse_escape()
+                if len(sub) == 1 and self._peek_range():
+                    members |= self._finish_range(next(iter(sub)))
+                else:
+                    members |= sub
+                continue
+            if c == ord("[") and self.data[self.i : self.i + 2] == b"[:":
+                raise Unsupported("POSIX class")
+            self.i += 1
+            if self._peek_range():
+                members |= self._finish_range(c)
+            else:
+                members.add(c)
+        raise Unsupported("unbalanced [")
+
+    def _peek_range(self) -> bool:
+        return (
+            self.i + 1 < len(self.data)
+            and self.data[self.i] == ord("-")
+            and self.data[self.i + 1] != ord("]")
+        )
+
+    def _finish_range(self, lo: int) -> set[int]:
+        self.i += 1  # consume '-'
+        c = self.data[self.i]
+        if c == ord("\\"):
+            sub = self._parse_escape()
+            if len(sub) != 1:
+                raise Unsupported("class range with multi-byte escape")
+            hi = next(iter(sub))
+        else:
+            hi = c
+            self.i += 1
+        if hi < lo:
+            raise Unsupported("reversed class range")
+        return set(range(lo, hi + 1))
+
+
+def _merge_single_char_alts(alts: list[list[_Item]]) -> Pos | None:
+    """(a|b|c) where each branch is one unquantified position -> one class."""
+    members: set[int] = set()
+    for alt in alts:
+        if len(alt) != 1:
+            return None
+        item = alt[0]
+        if item.pos is None or item.min_rep != 1 or item.max_rep != 1:
+            return None
+        if item.pos.quant != Quant.ONE:
+            return None
+        members |= item.pos.bytes
+    return Pos(bytes=frozenset(members))

@@ -1,0 +1,16 @@
+"""Batched verdict engine: request encoding, jitted verdict, batching."""
+
+from .. import ops as _ops  # noqa: F401  (enables x64 before tracing)
+from .batch import RequestBatch, RequestTuple, batch_to_contexts, encode_requests, pad_batch
+from .verdict import evaluate_batch, first_action, make_verdict_fn
+
+__all__ = [
+    "RequestBatch",
+    "RequestTuple",
+    "batch_to_contexts",
+    "encode_requests",
+    "evaluate_batch",
+    "first_action",
+    "make_verdict_fn",
+    "pad_batch",
+]

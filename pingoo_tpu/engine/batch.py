@@ -1,0 +1,164 @@
+"""Request batch encoding: request tuples -> fixed-shape device tensors.
+
+The host data plane extracts one `RequestTuple` per request — the same
+tuple shape the reference builds for its bel context (pingoo/rules.rs:
+17-34 RequestData + ClientData, constructed at http_listener.rs:238-249)
+— and batches them into zero-padded byte tensors + numeric columns.
+
+Truncation policy: every string field is capped at its plan capacity
+(compiler/lowering.DEFAULT_FIELD_SPECS; the reference similarly caps UA
+at 256 bytes on the hot path, http_listener.rs:159). FP/FN parity is
+defined over this truncated view: `batch_to_contexts` rebuilds exactly
+the strings the device saw (latin-1 view of the bytes), and the host
+interpreter oracle evaluates those.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Mapping, Optional
+
+import numpy as np
+
+from ..compiler.lowering import DEFAULT_FIELD_SPECS
+from ..expr import Context, Ip
+from ..ops.cidr import ip_to_words
+
+STRING_FIELDS = ("host", "url", "path", "method", "user_agent", "country")
+
+
+@dataclass
+class RequestTuple:
+    """One request's rule-relevant metadata (reference pingoo/rules.rs:17-34)."""
+
+    host: str = ""
+    url: str = ""
+    path: str = ""
+    method: str = "GET"
+    user_agent: str = ""
+    ip: str = "0.0.0.0"
+    remote_port: int = 0
+    asn: int = 0
+    country: str = "XX"
+
+
+@dataclass
+class RequestBatch:
+    """Fixed-shape encoded batch (numpy; device transfer happens in the
+    engine). A pytree-compatible dict lives in `.arrays`."""
+
+    size: int
+    arrays: dict  # field -> np/jnp arrays
+
+    def __getitem__(self, key: str):
+        return self.arrays[key]
+
+
+def _to_bytes(text: str) -> bytes:
+    """Canonical byte view (latin-1, bijective); non-byte chars are
+    replaced so a hostile header can't crash encoding."""
+    try:
+        return text.encode("latin-1")
+    except UnicodeEncodeError:
+        return text.encode("latin-1", errors="replace")
+
+
+def encode_requests(
+    requests: list[RequestTuple],
+    field_specs: Optional[Mapping[str, int]] = None,
+) -> RequestBatch:
+    specs = dict(field_specs or DEFAULT_FIELD_SPECS)
+    B = len(requests)
+    arrays: dict = {}
+    for field in STRING_FIELDS:
+        L = specs.get(field, 256)
+        data = np.zeros((B, L), dtype=np.uint8)
+        lens = np.zeros(B, dtype=np.int32)
+        for i, req in enumerate(requests):
+            raw = _to_bytes(getattr(req, field))[:L]
+            data[i, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+            lens[i] = len(raw)
+        arrays[f"{field}_bytes"] = data
+        arrays[f"{field}_len"] = lens
+
+    ip_words = np.zeros((B, 4), dtype=np.uint32)
+    for i, req in enumerate(requests):
+        try:
+            ip_words[i], _ = ip_to_words(Ip(req.ip))
+        except Exception:
+            ip_words[i] = 0  # unparseable -> never matches any predicate
+    arrays["ip"] = ip_words
+    arrays["asn"] = np.array(
+        [_clamp_i64(r.asn) for r in requests], dtype=np.int64)
+    arrays["remote_port"] = np.array(
+        [_clamp_i64(r.remote_port) for r in requests], dtype=np.int64)
+    return RequestBatch(size=B, arrays=arrays)
+
+
+def _clamp_i64(v: int) -> int:
+    return max(min(int(v), 2**63 - 1), -(2**63))
+
+
+def pad_batch(batch: RequestBatch, to_size: int) -> RequestBatch:
+    """Pad a batch to a fixed size (jit shape stability); padded rows are
+    inert (zero-length fields, ip 0)."""
+    B = batch.size
+    if B == to_size:
+        return batch
+    assert to_size > B
+    arrays = {}
+    for key, arr in batch.arrays.items():
+        pad_shape = (to_size - B,) + arr.shape[1:]
+        arrays[key] = np.concatenate([arr, np.zeros(pad_shape, dtype=arr.dtype)])
+    return RequestBatch(size=to_size, arrays=arrays)
+
+
+def batch_to_contexts(
+    batch: RequestBatch, lists: Mapping[str, list]
+) -> list[Context]:
+    """Rebuild interpreter contexts from the encoded batch — the parity
+    oracle sees exactly the (truncated) bytes the device saw."""
+    out = []
+    B = batch.size
+    for i in range(B):
+        fields = {}
+        for field in STRING_FIELDS:
+            data = batch[f"{field}_bytes"][i]
+            n = int(batch[f"{field}_len"][i])
+            fields[field] = bytes(data[:n]).decode("latin-1")
+        ip = _words_to_ip(batch["ip"][i])
+        ctx = Context(
+            {
+                "http_request": {
+                    "host": fields["host"],
+                    "url": fields["url"],
+                    "path": fields["path"],
+                    "method": fields["method"],
+                    "user_agent": fields["user_agent"],
+                },
+                "client": {
+                    "ip": ip,
+                    "remote_port": int(batch["remote_port"][i]),
+                    "asn": int(batch["asn"][i]),
+                    "country": fields["country"],
+                },
+                "lists": dict(lists),
+            }
+        )
+        out.append(ctx)
+    return out
+
+
+def _words_to_ip(words: np.ndarray) -> Ip:
+    value = 0
+    for w in words:
+        value = (value << 32) | int(w)
+    import ipaddress
+
+    if (value >> 32) == 0xFFFF:  # v4-mapped
+        return Ip(ipaddress.ip_address(value & 0xFFFFFFFF))
+    return Ip(ipaddress.ip_address(value))
+
+
+def requests_from_dicts(rows: Iterable[Mapping]) -> list[RequestTuple]:
+    return [RequestTuple(**row) for row in rows]

@@ -1,0 +1,303 @@
+"""The batched verdict function: one jitted XLA program per ruleset.
+
+`make_verdict_fn(plan)` traces the static plan structure (compiler/
+plan.py) into a function of (device_tables, batch_arrays) -> per-rule
+match matrix [B, R_device] bool. This replaces the reference's
+per-request sequential rules loop (pingoo/listeners/http_listener.rs:
+251-264 + pingoo/rules.rs:37-51 tree-walk) with one batched evaluation:
+
+  * string predicate groups run as broadcast byte compares,
+  * contains/regex run as one bit-parallel NFA scan per field,
+  * ip/list membership via masked compares / sorted-search tables,
+  * numeric comparisons as int64 lanes with exact error tracking
+    (div-by-zero, i64 overflow) so the fail-open semantics of
+    pingoo/rules.rs:41-44 are reproduced bit-exactly.
+
+`evaluate_batch` adds the host-interpreted fallback rules and returns
+the full match matrix in original rule order, plus `first_action`
+applies the reference's first-match action semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.lowering import (
+    BAnd,
+    BConst,
+    BEqBool,
+    BErrConst,
+    BLeaf,
+    BNot,
+    BOr,
+    NBin,
+    NCol,
+    NConst,
+    NLen,
+    NNeg,
+    NumCmp,
+)
+from ..compiler.plan import RulesetPlan
+from ..config.schema import Action
+from ..expr import execute_as_bool
+from ..ops.cidr import cidr_contains, int_set_contains, v4_buckets_contains
+from ..ops.match_ops import eq_match, prefix_match, reverse_bytes, suffix_match
+from ..ops.nfa_scan import nfa_scan
+
+I64_MIN = -(2**63)
+
+
+# -- numeric IR evaluation ---------------------------------------------------
+
+
+def _eval_num(ir, arrays, B):
+    """-> (val int64 [B], err bool [B]) with Rust-i64 error semantics."""
+    if isinstance(ir, NConst):
+        return (jnp.full((B,), ir.value, dtype=jnp.int64),
+                jnp.zeros((B,), dtype=bool))
+    if isinstance(ir, NCol):
+        return arrays[ir.name].astype(jnp.int64), jnp.zeros((B,), dtype=bool)
+    if isinstance(ir, NLen):
+        return (arrays[f"{ir.field}_len"].astype(jnp.int64),
+                jnp.zeros((B,), dtype=bool))
+    if isinstance(ir, NNeg):
+        v, e = _eval_num(ir.x, arrays, B)
+        return -v, e | (v == I64_MIN)
+    if isinstance(ir, NBin):
+        lv, le = _eval_num(ir.left, arrays, B)
+        rv, re_ = _eval_num(ir.right, arrays, B)
+        err = le | re_
+        if ir.op == "+":
+            s = lv + rv
+            of = ((lv ^ s) & (rv ^ s)) < 0
+            return s, err | of
+        if ir.op == "-":
+            s = lv - rv
+            of = ((lv ^ rv) & (lv ^ s)) < 0
+            return s, err | of
+        if ir.op == "*":
+            s = lv * rv
+            l_safe = jnp.where(lv == 0, 1, lv)
+            of = (lv != 0) & (jax.lax.div(s, l_safe) != rv)
+            of = of | ((lv == -1) & (rv == I64_MIN))
+            of = of | ((rv == -1) & (lv == I64_MIN))
+            return s, err | of
+        if ir.op in ("/", "%"):
+            zero = rv == 0
+            min_neg1 = (lv == I64_MIN) & (rv == -1)
+            r_safe = jnp.where(zero | min_neg1, 1, rv)
+            if ir.op == "/":
+                # I64_MIN / -1 overflows (interp: checked_i64 raises).
+                return jax.lax.div(lv, r_safe), err | zero | min_neg1
+            # I64_MIN % -1 == 0 in the interpreter (the final checked_i64
+            # sees 0), so only division by zero errors here.
+            val = jnp.where(min_neg1, 0, jax.lax.rem(lv, r_safe))
+            return val, err | zero
+        raise AssertionError(ir.op)
+    raise AssertionError(f"bad num ir {ir!r}")
+
+
+_CMP = {
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+}
+
+
+# -- leaf evaluation ---------------------------------------------------------
+
+
+def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
+    """Compute every leaf's ([B] val, [B] err) with shared group ops."""
+    results: dict[int, tuple] = {}
+    no_err = jnp.zeros((B,), dtype=bool)
+
+    # Shared per-field products.
+    rev_cache: dict[str, Any] = {}
+
+    def rev_field(field):
+        if field not in rev_cache:
+            rev_cache[field] = reverse_bytes(
+                arrays[f"{field}_bytes"], arrays[f"{field}_len"])
+        return rev_cache[field]
+
+    group_cols: dict[str, Any] = {}
+
+    def group_result(key, field, kind):
+        if key not in group_cols:
+            table = tables[key]
+            data = arrays[f"{field}_bytes"]
+            lens = arrays[f"{field}_len"]
+            if kind == "eq":
+                group_cols[key] = eq_match(data, lens, table)
+            elif kind == "prefix":
+                group_cols[key] = prefix_match(data, lens, table)
+            else:
+                group_cols[key] = suffix_match(rev_field(field), lens, table)
+        return group_cols[key]
+
+    nfa_cache: dict[str, Any] = {}
+
+    def nfa_result(key, field):
+        if key not in nfa_cache:
+            nfa_cache[key] = nfa_scan(
+                tables[key], arrays[f"{field}_bytes"], arrays[f"{field}_len"])
+        return nfa_cache[key]
+
+    ip_one_cache: Any = None
+
+    for leaf_id, binding in plan.bindings.items():
+        k = binding.kind
+        if k == "str":
+            cols = group_result(binding.table_key, binding.field, binding.group)
+            results[leaf_id] = (cols[:, binding.col], no_err)
+        elif k == "nfa":
+            hits = nfa_result(binding.table_key, binding.field)
+            lo, hi = binding.span
+            results[leaf_id] = (jnp.any(hits[:, lo:hi], axis=1), no_err)
+        elif k == "str_list":
+            table = tables[binding.table_key]
+            data = arrays[f"{binding.field}_bytes"]
+            lens = arrays[f"{binding.field}_len"]
+            lo, hi = binding.span
+            if hi == lo:  # all entries were non-byte strings
+                results[leaf_id] = (jnp.zeros((B,), dtype=bool), no_err)
+            else:
+                eqs = eq_match(data, lens, table)
+                results[leaf_id] = (jnp.any(eqs[:, lo:hi], axis=1), no_err)
+        elif k == "ip_one":
+            if ip_one_cache is None:
+                t = tables["ip_preds"]
+                ips = arrays["ip"]
+                diff = (ips[:, None, :] & t["masks"][None]) ^ t["nets"][None]
+                ip_one_cache = jnp.all(diff == 0, axis=2)  # [B, N]
+            results[leaf_id] = (ip_one_cache[:, binding.col], no_err)
+        elif k == "ip_list_small":
+            results[leaf_id] = (
+                cidr_contains(tables[binding.table_key], arrays["ip"]), no_err)
+        elif k == "ip_list_large":
+            results[leaf_id] = (
+                v4_buckets_contains(tables[binding.table_key], arrays["ip"]),
+                no_err)
+        elif k == "int_list":
+            pv, pe = _eval_num(binding.pred, arrays, B)
+            hit = int_set_contains(tables[binding.table_key], pv)
+            results[leaf_id] = (hit, pe)
+        elif k == "num_cmp":
+            cmp: NumCmp = binding.pred
+            lv, le = _eval_num(cmp.left, arrays, B)
+            rv, re_ = _eval_num(cmp.right, arrays, B)
+            results[leaf_id] = (_CMP[cmp.op](lv, rv), le | re_)
+        else:
+            raise AssertionError(k)
+    return results
+
+
+# -- boolean IR evaluation ---------------------------------------------------
+
+
+def _eval_bool(ir, leaves, B):
+    """-> (val [B], err [B]) reproducing interpreter error semantics:
+    && / || short-circuit left-to-right; == evaluates both sides."""
+    if isinstance(ir, BConst):
+        return (jnp.full((B,), ir.value, dtype=bool),
+                jnp.zeros((B,), dtype=bool))
+    if isinstance(ir, BErrConst):
+        return (jnp.zeros((B,), dtype=bool), jnp.ones((B,), dtype=bool))
+    if isinstance(ir, BLeaf):
+        return leaves[ir.leaf_id]
+    if isinstance(ir, BNot):
+        v, e = _eval_bool(ir.x, leaves, B)
+        return ~v, e
+    if isinstance(ir, BAnd):
+        lv, le = _eval_bool(ir.left, leaves, B)
+        rv, re_ = _eval_bool(ir.right, leaves, B)
+        return lv & rv, le | (lv & re_)
+    if isinstance(ir, BOr):
+        lv, le = _eval_bool(ir.left, leaves, B)
+        rv, re_ = _eval_bool(ir.right, leaves, B)
+        return lv | rv, le | (~lv & re_)
+    if isinstance(ir, BEqBool):
+        lv, le = _eval_bool(ir.left, leaves, B)
+        rv, re_ = _eval_bool(ir.right, leaves, B)
+        val = lv == rv
+        if ir.negate:
+            val = ~val
+        return val, le | re_
+    raise AssertionError(f"bad bool ir {ir!r}")
+
+
+# -- public API --------------------------------------------------------------
+
+
+def make_verdict_fn(plan: RulesetPlan):
+    """Build the jitted device verdict: (tables, arrays) -> [B, R_dev] bool.
+
+    Columns follow plan.device_rule_indices order.
+    """
+    device_rules = [r for r in plan.rules if not r.host]
+
+    @jax.jit
+    def verdict(tables, arrays):
+        B = arrays["asn"].shape[0]
+        leaves = _eval_leaves(plan, tables, arrays, B)
+        cols = []
+        for rule in device_rules:
+            if rule.always:
+                cols.append(jnp.ones((B,), dtype=bool))
+                continue
+            v, e = _eval_bool(rule.ir, leaves, B)
+            cols.append(v & ~e)  # error -> no-match (pingoo/rules.rs:41-44)
+        if not cols:
+            return jnp.zeros((B, 0), dtype=bool)
+        return jnp.stack(cols, axis=1)
+
+    return verdict
+
+
+def evaluate_batch(plan, verdict_fn, tables, batch, lists) -> np.ndarray:
+    """Full match matrix [B, R] in original rule order (device + host)."""
+    arrays = batch.arrays
+    dev = np.asarray(verdict_fn(tables, arrays))
+    R = len(plan.rules)
+    B = batch.size
+    out = np.zeros((B, R), dtype=bool)
+    for col, idx in enumerate(plan.device_rule_indices):
+        out[:, idx] = dev[:, col]
+    host_rules = plan.host_rules
+    if host_rules:
+        from .batch import batch_to_contexts
+
+        contexts = batch_to_contexts(batch, lists)
+        for rule in host_rules:
+            prog = rule.program
+            col_vals = out[:, rule.index]
+            for i, ctx in enumerate(contexts):
+                col_vals[i] = execute_as_bool(prog, ctx)
+    return out
+
+
+def first_action(plan: RulesetPlan, matched: np.ndarray) -> np.ndarray:
+    """First-match action per request (reference http_listener.rs:251-264):
+    0 = none, 1 = block, 2 = captcha."""
+    B = matched.shape[0]
+    out = np.zeros(B, dtype=np.int32)
+    rule_actions = np.zeros(len(plan.rules), dtype=np.int32)
+    for r in plan.rules:
+        if r.actions:
+            rule_actions[r.index] = 1 if r.actions[0] == Action.BLOCK else 2
+    for i in range(B):
+        hits = np.nonzero(matched[i])[0]
+        for idx in hits:
+            if rule_actions[idx]:
+                out[i] = rule_actions[idx]
+                break
+    return out

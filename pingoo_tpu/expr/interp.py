@@ -197,9 +197,9 @@ def _ordered(op: str, left: object, right: object) -> bool:
     if _is_num(left) and _is_num(right):
         pass
     elif isinstance(left, str) and isinstance(right, str):
-        # Byte-wise UTF-8 ordering (Rust str ordering).
-        left = left.encode("utf-8")
-        right = right.encode("utf-8")
+        # Codepoint ordering == byte ordering under the latin-1 byte view
+        # (see _length); nothing to convert.
+        pass
     else:
         raise EvalError(f"cannot order {type_name(left)} and {type_name(right)}")
     if op == "<":
@@ -306,7 +306,11 @@ def _call(node: ast.Call, ctx: Context) -> object:
 
 def _length(value: object) -> int:
     if isinstance(value, str):
-        return len(value.encode("utf-8"))
+        # Byte length under the framework's canonical string view: host
+        # code materializes request strings by latin-1-decoding the raw
+        # bytes (bijective), so char count == byte count. This matches
+        # the device engine, which only ever sees byte tensors.
+        return len(value)
     if isinstance(value, (list, dict)):
         return len(value)
     raise EvalError(f"length() requires String, Array or Map, got {type_name(value)}")

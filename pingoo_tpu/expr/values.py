@@ -89,9 +89,13 @@ class Ip:
 class Regex:
     """A compiled regular expression value.
 
-    `matches` is an unanchored search (CEL `matches` semantics). The
-    pattern text is retained so the TPU compiler can re-compile it into a
-    bit-parallel NFA (compiler/nfa.py).
+    `matches` is an unanchored search (CEL `matches` semantics). Patterns
+    compile in *bytes mode* over UTF-8: the TPU engine scans byte tensors,
+    so byte semantics everywhere keeps the CPU oracle and the device
+    kernels bit-identical (ASCII-only \\d\\w\\s, `.` = any byte but \\n —
+    also what Rust regex's (?-u) mode does). The pattern text is retained
+    so the TPU compiler can re-compile it into a bit-parallel NFA
+    (compiler/repat.py, compiler/nfa.py).
     """
 
     __slots__ = ("pattern", "_re")
@@ -99,12 +103,16 @@ class Regex:
     def __init__(self, pattern: str):
         self.pattern = pattern
         try:
-            self._re = re.compile(pattern)
-        except re.error as exc:
+            self._re = re.compile(pattern.encode("latin-1"))
+        except (re.error, UnicodeEncodeError) as exc:
             raise EvalError(f"invalid regex {pattern!r}: {exc}") from exc
 
     def search(self, text: str) -> bool:
-        return self._re.search(text) is not None
+        try:
+            data = text.encode("latin-1")
+        except UnicodeEncodeError as exc:
+            raise EvalError("non-byte string in matches()") from exc
+        return self._re.search(data) is not None
 
     def __eq__(self, other: object) -> bool:
         if not isinstance(other, Regex):

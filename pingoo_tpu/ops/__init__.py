@@ -1,0 +1,13 @@
+"""Device ops (JAX/XLA; Pallas variants where profitable).
+
+Enables x64 so numeric predicate lanes run in true int64 — required for
+Rust-i64 parity with the interpreter (expr/values.py checked_i64). All
+ops pin their dtypes explicitly, so the global flag only affects the
+intended lanes.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from . import cidr, match_ops, nfa_scan  # noqa: E402,F401

@@ -1,0 +1,198 @@
+"""IP / CIDR membership ops.
+
+Lowerings for `client.ip == <ip>` and `lists["..."].contains(client.ip)`
+(reference pingoo/lists.rs parses list entries as IpNetwork; docs/
+rules.md:110). IPs travel as 4 big-endian uint32 words [B, 4]
+(v4 addresses are v6-mapped ::ffff:a.b.c.d, matching Python ipaddress
+equivalence used by the interpreter via Ip.contains).
+
+Two lowerings:
+  * masked-compare table for small/medium CIDR lists: [B, N] compare.
+  * sorted-prefix buckets for large v4 lists (the 1M-entry blocklist in
+    BASELINE.md config 3): per distinct prefix length, a sorted uint32
+    array searched with jnp.searchsorted (log2 N gathers, HBM-resident).
+"""
+
+from __future__ import annotations
+
+import ipaddress
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..expr.values import Ip
+
+V4_PREFIX_OFFSET = 96  # ::ffff:0:0/96
+
+
+def ip_to_words(ip: Ip) -> tuple[np.ndarray, int]:
+    """-> (4 big-endian uint32 words, prefix length in 128-bit space)."""
+    if ip.addr is not None:
+        packed_int = int(ip.addr)
+        version = ip.addr.version
+        prefix = 128
+    else:
+        packed_int = int(ip.net.network_address)
+        version = ip.net.version
+        prefix = ip.net.prefixlen + (V4_PREFIX_OFFSET if version == 4 else 0)
+    if version == 4:
+        packed_int |= 0xFFFF << 32  # v6-map
+    words = np.array(
+        [(packed_int >> shift) & 0xFFFFFFFF for shift in (96, 64, 32, 0)],
+        dtype=np.uint32,
+    )
+    return words, prefix
+
+
+def encode_ip_batch(ips: list[Ip]) -> np.ndarray:
+    """[B, 4] uint32 for a batch of addresses."""
+    out = np.zeros((len(ips), 4), dtype=np.uint32)
+    for i, ip in enumerate(ips):
+        out[i], _ = ip_to_words(ip)
+    return out
+
+
+def _prefix_masks(prefix: int) -> np.ndarray:
+    """4 uint32 masks covering the first `prefix` bits of a 128-bit key."""
+    masks = np.zeros(4, dtype=np.uint32)
+    remaining = prefix
+    for w in range(4):
+        bits = min(32, max(0, remaining))
+        if bits > 0:
+            masks[w] = np.uint32(0xFFFFFFFF << (32 - bits) & 0xFFFFFFFF)
+        remaining -= 32
+    return masks
+
+
+class CidrTable(NamedTuple):
+    """Masked-compare CIDR list (exact, any list size; O(B*N))."""
+
+    nets: jax.Array  # [N, 4] uint32 (pre-masked network words)
+    masks: jax.Array  # [N, 4] uint32
+
+
+def build_cidr_table(entries: list[Ip]) -> CidrTable:
+    N = max(len(entries), 1)
+    nets = np.zeros((N, 4), dtype=np.uint32)
+    masks = np.zeros((N, 4), dtype=np.uint32)
+    for i, ip in enumerate(entries):
+        words, prefix = ip_to_words(ip)
+        m = _prefix_masks(prefix)
+        nets[i] = words & m
+        masks[i] = m
+    if not entries:
+        # Unsatisfiable sentinel: net bits outside the mask can never
+        # compare equal ((ip & 0) ^ 1 != 0 for every ip).
+        masks[:] = 0
+        nets[:] = 1
+    return CidrTable(jnp.asarray(nets), jnp.asarray(masks))
+
+
+def cidr_contains(table: CidrTable, ips: jax.Array) -> jax.Array:
+    """ips [B, 4] -> [B] bool: ip in any list entry."""
+    diff = (ips[:, None, :] & table.masks[None, :, :]) ^ table.nets[None, :, :]
+    hit = jnp.all(diff == 0, axis=2)  # [B, N]
+    return jnp.any(hit, axis=1)
+
+
+def cidr_match_one(net_words: np.ndarray, prefix: int, ips: jax.Array) -> jax.Array:
+    """Literal `client.ip == "x.y.z.w"` / single-CIDR predicate: [B] bool."""
+    masks = jnp.asarray(_prefix_masks(prefix))
+    nets = jnp.asarray(net_words) & masks
+    diff = (ips & masks[None, :]) ^ nets[None, :]
+    return jnp.all(diff == 0, axis=1)
+
+
+class V4PrefixBuckets(NamedTuple):
+    """Large-list lowering: per-prefix-length sorted v4 key arrays.
+
+    keys[i] holds entries of bucket i left-justified; bucket_prefix gives
+    each bucket's prefix length; bucket_size the live entry count.
+    Non-v4 entries go to an auxiliary CidrTable.
+    """
+
+    keys: jax.Array  # [NB, Nmax] uint32 sorted per bucket
+    bucket_prefix: jax.Array  # [NB] int32
+    bucket_size: jax.Array  # [NB] int32
+    aux: CidrTable  # non-v4 (or odd) entries
+
+
+def build_v4_buckets(entries: list[Ip]) -> V4PrefixBuckets:
+    by_prefix: dict[int, list[int]] = {}
+    aux: list[Ip] = []
+    for ip in entries:
+        if ip.addr is not None and ip.addr.version == 4:
+            by_prefix.setdefault(32, []).append(int(ip.addr))
+        elif ip.net is not None and ip.net.version == 4:
+            by_prefix.setdefault(ip.net.prefixlen, []).append(
+                int(ip.net.network_address)
+            )
+        else:
+            aux.append(ip)
+    prefixes = sorted(by_prefix)
+    NB = max(len(prefixes), 1)
+    Nmax = max((len(v) for v in by_prefix.values()), default=1)
+    keys = np.full((NB, Nmax), 0xFFFFFFFF, dtype=np.uint32)
+    bucket_prefix = np.zeros(NB, dtype=np.int32)
+    bucket_size = np.zeros(NB, dtype=np.int32)
+    for i, p in enumerate(prefixes):
+        # Keys are right-justified top-p bits: key = addr >> (32 - p).
+        vals = sorted({(v >> (32 - p)) if p < 32 else v for v in by_prefix[p]})
+        keys[i, : len(vals)] = np.array(vals, dtype=np.uint32)
+        bucket_prefix[i] = p
+        bucket_size[i] = len(vals)
+    return V4PrefixBuckets(
+        keys=jnp.asarray(keys),
+        bucket_prefix=jnp.asarray(bucket_prefix),
+        bucket_size=jnp.asarray(bucket_size),
+        aux=build_cidr_table(aux),
+    )
+
+
+def v4_buckets_contains(buckets: V4PrefixBuckets, ips: jax.Array) -> jax.Array:
+    """ips [B, 4] (v6-mapped words) -> [B] bool membership."""
+    is_v4 = (ips[:, 0] == 0) & (ips[:, 1] == 0) & (ips[:, 2] == 0xFFFF)
+    v4 = ips[:, 3]  # [B] uint32
+
+    def check_bucket(prefix, size, keys_row):
+        shift = (32 - prefix).astype(jnp.uint32)
+        # Guard shift-by->=32 (prefix 0 or 32) via explicit selects.
+        shifted = v4 >> jnp.clip(shift, 1, 31)
+        key = jnp.where(prefix >= 32, v4,
+                        jnp.where(prefix <= 0, jnp.uint32(0), shifted))
+        idx = jnp.searchsorted(keys_row, key)
+        idx = jnp.clip(idx, 0, keys_row.shape[0] - 1)
+        found = (jnp.take(keys_row, idx) == key) & (idx < size)
+        return found  # [B]
+
+    hits = jax.vmap(check_bucket)(
+        buckets.bucket_prefix, buckets.bucket_size, buckets.keys
+    )  # [NB, B]
+    v4_hit = jnp.any(hits, axis=0) & is_v4
+    aux_hit = cidr_contains(buckets.aux, ips)
+    return v4_hit | aux_hit
+
+
+class SortedIntSet(NamedTuple):
+    """Int list membership via sorted array + searchsorted
+    (lists["blocked_asns"].contains(client.asn))."""
+
+    keys: jax.Array  # [N] int64 sorted
+    size: jax.Array  # scalar int32
+
+
+def build_int_set(values: list[int]) -> SortedIntSet:
+    vals = sorted(set(values))
+    N = max(len(vals), 1)
+    keys = np.full(N, np.iinfo(np.int64).max, dtype=np.int64)
+    keys[: len(vals)] = np.array(vals, dtype=np.int64)
+    return SortedIntSet(jnp.asarray(keys), jnp.asarray(np.int32(len(vals))))
+
+
+def int_set_contains(table: SortedIntSet, values: jax.Array) -> jax.Array:
+    """values [B] int64 -> [B] bool."""
+    idx = jnp.searchsorted(table.keys, values)
+    idx = jnp.clip(idx, 0, table.keys.shape[0] - 1)
+    return (jnp.take(table.keys, idx) == values) & (idx < table.size)

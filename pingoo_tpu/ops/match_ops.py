@@ -1,0 +1,107 @@
+"""Vectorized byte-tensor string predicates: eq / prefix / suffix.
+
+These lower the bel functions `starts_with` / `ends_with` / `==` over
+request string fields (reference docs/rules.md:71-76; hot use:
+assets/pingoo.yml `http_request.path.starts_with("/.env")`). `contains`
+and `matches` go through the NFA scan instead (ops/nfa_scan.py).
+
+All patterns for one field live in one padded table so a single broadcast
+compare scores every (request, pattern) pair: [B, L] x [P, Lp] -> [B, P].
+Comparisons are masked past each pattern's length, so the op is exact for
+zero-padded fields.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class PatternTable(NamedTuple):
+    """Padded pattern bytes for one (field, kind) group."""
+
+    bytes: jax.Array  # [P, Lp] uint8
+    lengths: jax.Array  # [P] int32
+    ci: jax.Array  # [P] bool — case-insensitive compare
+
+
+def build_pattern_table(patterns: list[tuple[bytes, bool]]) -> PatternTable:
+    """patterns: list of (bytes, case_insensitive)."""
+    P = len(patterns)
+    Lp = max((len(p) for p, _ in patterns), default=1)
+    Lp = max(Lp, 1)
+    arr = np.zeros((P, Lp), dtype=np.uint8)
+    lens = np.zeros(P, dtype=np.int32)
+    ci = np.zeros(P, dtype=bool)
+    for i, (p, fold) in enumerate(patterns):
+        arr[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+        lens[i] = len(p)
+        ci[i] = fold
+    return PatternTable(jnp.asarray(arr), jnp.asarray(lens), jnp.asarray(ci))
+
+
+def _fold_lower(x: jax.Array) -> jax.Array:
+    """ASCII-lowercase a uint8 tensor."""
+    is_upper = (x >= 0x41) & (x <= 0x5A)
+    return jnp.where(is_upper, x + 0x20, x)
+
+
+def _masked_eq(data: jax.Array, table: PatternTable) -> jax.Array:
+    """[B, L], table [P, Lp] -> all-positions-equal [B, P] (masked past
+    pattern length). Positions beyond L are handled by the caller via
+    length checks (a pattern longer than L can never match)."""
+    B, L = data.shape
+    P, Lp = table.bytes.shape
+    take = min(L, Lp)
+    d = data[:, None, :take]  # [B, 1, take]
+    p = table.bytes[None, :, :take]  # [1, P, take]
+    folded = _fold_lower(d) == _fold_lower(p)
+    exact = d == p
+    cmp = jnp.where(table.ci[None, :, None], folded, exact)
+    pos_ok = jnp.arange(take, dtype=jnp.int32)[None, None, :] >= (
+        table.lengths[None, :, None]
+    )
+    return jnp.all(cmp | pos_ok, axis=2)  # [B, P]
+
+
+def prefix_match(
+    data: jax.Array, lengths: jax.Array, table: PatternTable
+) -> jax.Array:
+    """starts_with: [B, P] bool."""
+    ok = _masked_eq(data, table)
+    fits = lengths[:, None] >= table.lengths[None, :]
+    return ok & fits
+
+
+def eq_match(data: jax.Array, lengths: jax.Array, table: PatternTable) -> jax.Array:
+    """string equality: [B, P] bool."""
+    ok = _masked_eq(data, table)
+    same_len = lengths[:, None] == table.lengths[None, :]
+    return ok & same_len
+
+
+def reverse_bytes(data: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Reverse each row's first `length` bytes: rev[b, j] = data[b, len-1-j].
+
+    Computed once per field so every suffix predicate becomes a prefix
+    predicate on the reversed view.
+    """
+    B, L = data.shape
+    idx = lengths[:, None] - 1 - jnp.arange(L, dtype=jnp.int32)[None, :]
+    idx_clipped = jnp.clip(idx, 0, L - 1)
+    rev = jnp.take_along_axis(data, idx_clipped, axis=1)
+    return jnp.where(idx >= 0, rev, 0)
+
+
+def suffix_match(
+    rev_data: jax.Array, lengths: jax.Array, rev_table: PatternTable
+) -> jax.Array:
+    """ends_with: prefix match of reversed pattern on reversed data."""
+    return prefix_match(rev_data, lengths, rev_table)
+
+
+def build_suffix_table(patterns: list[tuple[bytes, bool]]) -> PatternTable:
+    return build_pattern_table([(p[::-1], ci) for p, ci in patterns])

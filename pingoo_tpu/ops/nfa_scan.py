@@ -1,0 +1,137 @@
+"""JAX bit-parallel NFA scan — the device hot op of the verdict engine.
+
+Executes the extended Shift-And algebra built by compiler/nfa.py
+(build_bank) over a byte tensor [B, L]: a `lax.scan` over the length
+dimension carrying [B, W] uint32 state lanes. All ops are elementwise
+uint32 (VPU-friendly); the only memory op per step is an embedding-style
+row gather of the [256, W] byte-class table. See compiler/nfa.py for the
+algebra derivation and the numpy reference implementation this op is
+differentially tested against.
+
+The reference behavior this replaces: per-request sequential regex
+execution inside the rules loop (reference pingoo/listeners/
+http_listener.rs:251-264 -> bel tree-walk with Rust regex). Here a whole
+batch advances through all patterns simultaneously, one byte per step.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..compiler.nfa import NfaBank
+
+
+class NfaTables(NamedTuple):
+    """Device-resident tables for one field's NFA bank (a pytree)."""
+
+    byte_table: jax.Array  # [256, W] uint32
+    init_anchored: jax.Array  # [W]
+    init_unanchored: jax.Array  # [W]
+    opt: jax.Array  # [W]
+    rep: jax.Array  # [W]
+    last_float: jax.Array  # [W]
+    last_end: jax.Array  # [W]
+    # Per-pattern slot extraction data:
+    slot_word: jax.Array  # [P] int32
+    slot_mask: jax.Array  # [P] uint32
+    slot_end: jax.Array  # [P] bool ($-anchored)
+    slot_always: jax.Array  # [P] bool
+    slot_empty_ok: jax.Array  # [P] bool
+
+
+def bank_to_tables(bank: NfaBank) -> NfaTables:
+    slots = bank.slots
+    W = max(bank.num_words, 1)  # keep shapes non-empty for jit
+
+    def pad(a: np.ndarray) -> np.ndarray:
+        if a.shape[0] == W:
+            return a
+        out = np.zeros(W, dtype=np.uint32)
+        out[: a.shape[0]] = a
+        return out
+
+    byte_table = bank.byte_table
+    if byte_table.shape[1] != W:
+        bt = np.zeros((256, W), dtype=np.uint32)
+        bt[:, : byte_table.shape[1]] = byte_table
+        byte_table = bt
+    return NfaTables(
+        byte_table=jnp.asarray(byte_table),
+        init_anchored=jnp.asarray(pad(bank.init_anchored)),
+        init_unanchored=jnp.asarray(pad(bank.init_unanchored)),
+        opt=jnp.asarray(pad(bank.opt)),
+        rep=jnp.asarray(pad(bank.rep)),
+        last_float=jnp.asarray(pad(bank.last_float)),
+        last_end=jnp.asarray(pad(bank.last_end)),
+        slot_word=jnp.asarray(
+            np.array([s.word for s in slots], dtype=np.int32)
+        ),
+        slot_mask=jnp.asarray(
+            np.array([s.accept_mask for s in slots], dtype=np.uint32)
+        ),
+        slot_end=jnp.asarray(np.array([s.end_anchored for s in slots], dtype=bool)),
+        slot_always=jnp.asarray(
+            np.array([s.always_match for s in slots], dtype=bool)
+        ),
+        slot_empty_ok=jnp.asarray(
+            np.array([s.empty_ok for s in slots], dtype=bool)
+        ),
+    )
+
+
+def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array) -> jax.Array:
+    """Run the bank over a byte batch.
+
+    data: [B, L] uint8 (zero-padded), lengths: [B] int32
+    returns: matched [B, P] bool  (P = number of packed patterns)
+    """
+    B, L = data.shape
+    state0 = jnp.zeros((B, tables.opt.shape[0]), dtype=jnp.uint32)
+    acc0 = jnp.zeros_like(state0)
+    endacc0 = jnp.zeros_like(state0)
+
+    lengths = lengths.astype(jnp.int32)
+    last_byte = data[jnp.arange(B), jnp.maximum(lengths - 1, 0)]
+    ends_nl = (lengths > 0) & (last_byte == 0x0A)
+
+    one = jnp.uint32(1)
+    opt = tables.opt
+    rep = tables.rep
+
+    def step(carry, xs):
+        S, float_acc, end_acc = carry
+        c, t = xs  # c: [B] uint8, t: scalar step index
+        bc = jnp.take(tables.byte_table, c.astype(jnp.int32), axis=0)  # [B, W]
+        inj = jnp.where(t == 0, tables.init_unanchored | tables.init_anchored,
+                        tables.init_unanchored)
+        adv = (S << one) | inj[None, :]
+        adv = adv | (((adv & opt) + opt) ^ opt)
+        pre = adv | (S & rep)
+        S_new = pre & bc
+        active = (t < lengths)[:, None]
+        S = jnp.where(active, S_new, S)
+        float_acc = float_acc | jnp.where(active, S_new & tables.last_float, 0)
+        before_nl = (ends_nl & (t == lengths - 2))[:, None]
+        end_acc = end_acc | jnp.where(before_nl, S_new & tables.last_end, 0)
+        return (S, float_acc, end_acc), None
+
+    (S, float_acc, end_acc), _ = jax.lax.scan(
+        step,
+        (state0, acc0, endacc0),
+        (data.T, jnp.arange(L, dtype=jnp.int32)),
+    )
+    end_acc = end_acc | (S & tables.last_end)
+
+    # Slot extraction: [B, P]
+    fa = jnp.take(float_acc, tables.slot_word, axis=1)  # [B, P]
+    ea = jnp.take(end_acc, tables.slot_word, axis=1)
+    lanes = jnp.where(tables.slot_end[None, :], ea, fa)
+    hit = (lanes & tables.slot_mask[None, :]) != 0
+    empty_like = ((lengths == 0) | (ends_nl & (lengths == 1)))[:, None]
+    hit = hit | (tables.slot_end & tables.slot_empty_ok)[None, :] & empty_like
+    hit = hit | tables.slot_always[None, :]
+    return hit

@@ -8,9 +8,18 @@ process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU unconditionally: the ambient environment routes jax to a
+# tunneled TPU ('axon' platform, registered by sitecustomize), which
+# would make every test pay network round-trips. The env var alone is
+# overridden by the plugin, so also update jax.config before any
+# backend initialization. Benchmarks opt into the real device.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,247 @@
+"""Config system + lists loader tests (reference behaviors from
+pingoo/config/config.rs, config_file.rs, lists.rs)."""
+
+import textwrap
+
+import pytest
+
+from pingoo_tpu.config import (
+    Action,
+    ConfigError,
+    ListenerProtocol,
+    ListType,
+    load_and_validate,
+    parse_config,
+    parse_listener_address,
+    parse_upstream,
+)
+from pingoo_tpu.expr import Ip
+from pingoo_tpu.lists import load_lists, parse_list
+
+MINIMAL = {
+    "listeners": {"http": {"address": "http://0.0.0.0"}},
+    "services": {"site": {"static": {"root": "/var/www"}}},
+}
+
+
+def test_reference_default_config(tmp_path):
+    # The reference's shipped assets/pingoo.yml shape.
+    cfg_file = tmp_path / "pingoo.yml"
+    cfg_file.write_text(
+        textwrap.dedent(
+            """
+            listeners:
+              http:
+                address: http://0.0.0.0
+            services:
+              static_site:
+                static:
+                  root: /var/wwww
+            rules:
+              basic_waf:
+                expression: http_request.path.starts_with("/.env") || http_request.path.starts_with("/.git")
+                actions:
+                  - action: block
+            """
+        )
+    )
+    config = load_and_validate(str(cfg_file))
+    assert len(config.listeners) == 1
+    listener = config.listeners[0]
+    assert (listener.host, listener.port) == ("0.0.0.0", 80)
+    assert listener.protocol == ListenerProtocol.HTTP
+    # listener with no explicit services gets all http services (config.rs:236-253)
+    assert listener.services == ("static_site",)
+    assert config.rules[0].name == "basic_waf"
+    assert config.rules[0].actions == (Action.BLOCK,)
+    assert config.rules[0].expression is not None
+
+
+def test_rules_folder_merge_and_duplicates(tmp_path):
+    cfg_file = tmp_path / "pingoo.yml"
+    cfg_file.write_text(
+        "listeners:\n  l: {address: http://0.0.0.0}\n"
+        "services:\n  s: {static: {root: /w}}\n"
+    )
+    rules_dir = tmp_path / "rules"
+    rules_dir.mkdir()
+    (rules_dir / "extra.yml").write_text(
+        'blocked:\n  expression: http_request.path == "/blocked"\n'
+        "  actions: [{action: block}]\n"
+    )
+    (rules_dir / "ignored.yaml").write_text("nope: {actions: []}\n")
+    config = load_and_validate(str(cfg_file))
+    assert [r.name for r in config.rules] == ["blocked"]
+
+    # Duplicate between folder files is an error.
+    (rules_dir / "extra2.yml").write_text("blocked:\n  actions: []\n")
+    with pytest.raises(ConfigError, match="duplicate rule name"):
+        load_and_validate(str(cfg_file))
+
+
+class TestListenerAddress:
+    def test_defaults(self):
+        assert parse_listener_address("http://0.0.0.0") == (
+            "0.0.0.0", 80, ListenerProtocol.HTTP)
+        assert parse_listener_address("https://127.0.0.1") == (
+            "127.0.0.1", 443, ListenerProtocol.HTTPS)
+        assert parse_listener_address("tcp://0.0.0.0:9000") == (
+            "0.0.0.0", 9000, ListenerProtocol.TCP)
+        assert parse_listener_address("tcp+tls://0.0.0.0:9000")[2] == (
+            ListenerProtocol.TCP_AND_TLS)
+
+    def test_scheme_defaults_to_http(self):
+        assert parse_listener_address("0.0.0.0:8080") == (
+            "0.0.0.0", 8080, ListenerProtocol.HTTP)
+
+    def test_errors(self):
+        with pytest.raises(ConfigError, match="port is missing"):
+            parse_listener_address("tcp://0.0.0.0")
+        with pytest.raises(ConfigError, match="not a valid protocol"):
+            parse_listener_address("ftp://0.0.0.0:21")
+        with pytest.raises(ConfigError, match="host must be an ip"):
+            parse_listener_address("http://example.com")
+
+
+class TestUpstream:
+    def test_parse(self):
+        up = parse_upstream("http://127.0.0.1:3000")
+        assert (up.ip, up.port, up.tls) == ("127.0.0.1", 3000, False)
+        up = parse_upstream("https://backend.internal")
+        assert (up.ip, up.hostname, up.port, up.tls) == (
+            None, "backend.internal", 443, True)
+        up = parse_upstream("http://localhost:8080")
+        assert up.ip == "127.0.0.1"
+        up = parse_upstream("tcp://10.0.0.1:5432")
+        assert (up.ip, up.port) == ("10.0.0.1", 5432)
+
+    def test_errors(self):
+        with pytest.raises(ConfigError, match="not a valid protocol"):
+            parse_upstream("ftp://x:21")
+        with pytest.raises(ConfigError, match="port is missing"):
+            parse_upstream("tcp://10.0.0.1")
+        with pytest.raises(ConfigError, match="host is missing"):
+            parse_upstream("http://")
+
+
+class TestValidation:
+    def test_service_exactly_one_kind(self):
+        raw = dict(MINIMAL, services={"bad": {"static": {"root": "/w"},
+                                              "http_proxy": ["http://1.2.3.4"]}})
+        with pytest.raises(ConfigError, match="exactly 1"):
+            parse_config(raw)
+        raw = dict(MINIMAL, services={"bad": {"route": "true"}})
+        with pytest.raises(ConfigError, match="exactly 1"):
+            parse_config(raw)
+
+    def test_tcp_proxy_no_route(self):
+        raw = {
+            "listeners": {"t": {"address": "tcp://0.0.0.0:9000"}},
+            "services": {"db": {"tcp_proxy": ["tcp://10.0.0.1:5432"],
+                                 "route": "true"}},
+        }
+        with pytest.raises(ConfigError, match="TCP proxy can't have a route"):
+            parse_config(raw)
+
+    def test_duplicate_ports(self):
+        raw = dict(
+            MINIMAL,
+            listeners={
+                "a": {"address": "http://0.0.0.0:8080"},
+                "b": {"address": "http://127.0.0.1:8080"},
+            },
+        )
+        with pytest.raises(ConfigError, match="same port"):
+            parse_config(raw)
+
+    def test_unknown_service(self):
+        raw = dict(
+            MINIMAL,
+            listeners={"a": {"address": "http://0.0.0.0", "services": ["nope"]}},
+        )
+        with pytest.raises(ConfigError, match="doesn't exist"):
+            parse_config(raw)
+
+    def test_tcp_listener_single_service(self):
+        raw = {
+            "listeners": {"t": {"address": "tcp://0.0.0.0:9000",
+                                 "services": ["a", "b"]}},
+            "services": {
+                "a": {"tcp_proxy": ["tcp://10.0.0.1:1"]},
+                "b": {"tcp_proxy": ["tcp://10.0.0.2:2"]},
+            },
+        }
+        with pytest.raises(ConfigError, match="only have 1"):
+            parse_config(raw)
+
+    def test_bad_rule_expression_fails_at_load(self):
+        raw = dict(MINIMAL, rules={"r": {"expression": "a ==", "actions": []}})
+        with pytest.raises(ConfigError, match="error parsing rules"):
+            parse_config(raw)
+
+    def test_route_compiled_at_load(self):
+        raw = dict(
+            MINIMAL,
+            services={
+                "site": {
+                    "static": {"root": "/w"},
+                    "route": 'http_request.host == "example.com"',
+                }
+            },
+        )
+        config = parse_config(raw)
+        assert config.services[0].route is not None
+
+    def test_acme_validation(self):
+        base = dict(MINIMAL)
+        base["tls"] = {"acme": {"domains": ["example.com", "example.com"]}}
+        with pytest.raises(ConfigError, match="duplicate domain"):
+            parse_config(base)
+        base["tls"] = {"acme": {"domains": ["*.example.com"]}}
+        with pytest.raises(ConfigError, match="wildcard"):
+            parse_config(base)
+        base["tls"] = {"acme": {"domains": ["EXAMPLE.com"]}}
+        with pytest.raises(ConfigError, match="invalid domain"):
+            parse_config(base)
+        base["tls"] = {"acme": {"domains": ["example.com"],
+                                  "directory_url": "https://acme.example/dir/ "}}
+        config = parse_config(base)
+        assert config.tls.acme.directory_url == "https://acme.example/dir"
+
+    def test_unknown_keys_rejected(self):
+        raw = dict(MINIMAL)
+        raw["nope"] = {}
+        with pytest.raises(ConfigError, match="unknown keys"):
+            parse_config(raw)
+
+
+class TestLists:
+    def test_parse_typed_lists(self):
+        ips = parse_list('127.0.0.1,"really bad person"\n10.0.0.0/8,"corp"\n',
+                         ListType.IP)
+        assert ips[0] == Ip("127.0.0.1")
+        assert ips[1].is_network
+        ints = parse_list("64500\n64501,desc\n", ListType.INT)
+        assert ints == [64500, 64501]
+        strings = parse_list("/admin\n/.env, secret scan \n", ListType.STRING)
+        assert strings == ["/admin", "/.env"]
+
+    def test_values_trimmed(self):
+        assert parse_list(" 42 ,x\n", ListType.INT) == [42]
+
+    def test_errors(self):
+        with pytest.raises(ConfigError, match="number of columns"):
+            parse_list("a,b,c\n", ListType.STRING)
+        with pytest.raises(ConfigError, match="parsing int"):
+            parse_list("abc\n", ListType.INT)
+        with pytest.raises(ConfigError, match="IP network"):
+            parse_list("999.1.1.1\n", ListType.IP)
+
+    def test_load_lists_end_to_end(self, tmp_path):
+        f = tmp_path / "blocked.csv"
+        f.write_text('127.0.0.1,"bad"\n192.0.2.0/24\n')
+        from pingoo_tpu.config.schema import ListConfig
+
+        lists = load_lists([ListConfig(name="blocked_ips", type=ListType.IP,
+                                        file=str(f))])
+        assert "blocked_ips" in lists and len(lists["blocked_ips"]) == 2

@@ -213,8 +213,11 @@ class TestStringsAndFunctions:
         assert run('"hello".length()') == 5
         assert run('length("hello")') == 5
 
-    def test_length_is_bytes(self):
-        assert run('"é".length()') == 2
+    def test_length_is_byte_count(self):
+        # Canonical strings are latin-1 views of bytes: char count is
+        # byte count. A 2-byte UTF-8 sequence arrives as 2 chars.
+        assert run('"\\xc3\\xa9".length()') == 2
+        assert run('"abc".length()') == 3
 
     def test_matches(self):
         ctx = request_ctx()

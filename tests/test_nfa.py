@@ -1,0 +1,220 @@
+"""Differential tests for the regex subset compiler + bit-parallel NFA.
+
+Three-way agreement on every (pattern, input) pair:
+  Python `re` (bytes mode)  ==  compiler/nfa.simulate  ==  nfa.scan_numpy
+
+This is the core guarantee behind FP/FN parity (BASELINE.md): the device
+algebra must be indistinguishable from the reference regex engine on the
+supported subset.
+"""
+
+import random
+import re
+
+import numpy as np
+import pytest
+
+from pingoo_tpu.compiler.nfa import build_bank, scan_numpy, simulate
+from pingoo_tpu.compiler.repat import Unsupported, compile_regex, literal_pattern
+
+SUPPORTED_PATTERNS = [
+    # literals & anchors
+    r"abc",
+    r"^abc",
+    r"abc$",
+    r"^abc$",
+    r"^$",
+    r"a",
+    # classes
+    r"[abc]x",
+    r"[a-z]\d",
+    r"[^a-z]+",
+    r"\d\d\d",
+    r"\w+@\w+",
+    r"\s",
+    r"a.c",
+    r"\.env",
+    # quantifiers
+    r"ab?c",
+    r"ab*c",
+    r"ab+c",
+    r"a?b?c?d",
+    r"^a*$",
+    r"a{3}",
+    r"a{2,4}b",
+    r"a{2,}b",
+    r"ba{0,2}",
+    # groups / alternation
+    r"(abc)",
+    r"(?:abc)d",
+    r"(a|b)c",
+    r"(abc|def)",
+    r"(abc|defg)x",
+    r"abc|xyz",
+    r"^(GET|POST) ",
+    r"(ab){1,2}c",
+    r"(abc)?d",
+    r"x(abc)?$",
+    # WAF-style
+    r"(?i)union\s+select",
+    r"(?i)<script",
+    r"\.\./",
+    r"etc/passwd",
+    r"%3[Cc]script",
+    r"eval\(",
+    r"[0-9]{1,3}\.[0-9]{1,3}",
+    r"(?i)(select|insert|update|delete)\s",
+    r"^/(admin|wp-admin|phpmyadmin)",
+    r"\x00",
+    r"a\|b",
+    r"x$|^y",
+    r"(a|b|c|d|e|f|g){3}",  # single-char alts merge into a class
+    r"(ab|cd){2}",  # repetition rewrite composes with cross product
+    r"abc$",  # trailing-newline $ semantics
+    r"^abc$",
+    r"ab\nc",
+]
+
+UNSUPPORTED_PATTERNS = [
+    r"(abc)+",  # unbounded multi-char group repeat
+    r"a(?=b)",  # lookahead
+    r"(a)\1",  # backreference
+    r"a{1,50}" * 2,  # expansion too large
+    r"\bword\b",  # boundary
+    r"a*?",  # lazy
+    r"(?s)a.c",  # dotall
+    r"(?P<x>ab)",  # named group
+    r"(abc|def){1,9}",  # cross-product expansion too large
+]
+
+
+def gen_inputs(rng: random.Random, n: int = 60) -> list[bytes]:
+    corpus = [
+        b"", b"a", b"abc", b"xabcx", b"ABC", b"aaab", b"abbbc", b"ac",
+        b"abcabc", b"union  select", b"UNION SELECT", b"/admin/x",
+        b"GET /index.html", b"POST /login", b"../../../etc/passwd",
+        b"<script>alert(1)</script>", b"%3Cscript%3E", b"eval(atob(x))",
+        b"10.0.0.1", b"999.999", b"word boundary", b"a|b", b"x", b"y",
+        b"xyz", b"def", b"defgx", b"abcd", b"\x00\x01", b"aa", b"aaaa",
+        b"abc\n", b"abc\n\n", b"\n", b"a\n", b"ab\ncd", b"xabc\n",
+    ]
+    alphabet = b"abcdefgxyz0123456789 ./<>%|$^\\()[]{}\x00\nABC"
+    for _ in range(n):
+        k = rng.randint(0, 24)
+        corpus.append(bytes(rng.choice(alphabet) for _ in range(k)))
+    return corpus
+
+
+@pytest.mark.parametrize("pattern", SUPPORTED_PATTERNS)
+def test_three_way_agreement(pattern):
+    rng = random.Random(hash(pattern) & 0xFFFF)
+    alts = compile_regex(pattern)
+    gold = re.compile(pattern.encode("utf-8"))
+    inputs = gen_inputs(rng)
+
+    # simulate() agreement
+    for data in inputs:
+        want = gold.search(data) is not None
+        got = any(simulate(lp, data) for lp in alts)
+        assert got == want, f"simulate {pattern!r} on {data!r}: {got} != {want}"
+
+    # scan_numpy() agreement (pad to fixed length)
+    bank = build_bank(alts)
+    L = max(1, max(len(d) for d in inputs))
+    mat = np.zeros((len(inputs), L), dtype=np.uint8)
+    lengths = np.zeros(len(inputs), dtype=np.int32)
+    for i, d in enumerate(inputs):
+        mat[i, : len(d)] = np.frombuffer(d, dtype=np.uint8)
+        lengths[i] = len(d)
+    out = scan_numpy(bank, mat, lengths)  # [B, P] per-alternative
+    for i, data in enumerate(inputs):
+        want = gold.search(data) is not None
+        got = bool(out[i].any())
+        assert got == want, f"scan {pattern!r} on {data!r}: {got} != {want}"
+
+
+@pytest.mark.parametrize("pattern", UNSUPPORTED_PATTERNS)
+def test_unsupported_rejected(pattern):
+    with pytest.raises(Unsupported):
+        compile_regex(pattern)
+
+
+def test_literal_pattern_contains():
+    lp = literal_pattern(b"needle")
+    assert simulate(lp, b"find the needle here")
+    assert not simulate(lp, b"nothing")
+    lp_ci = literal_pattern(b"NeEdLe", case_insensitive=True)
+    assert simulate(lp_ci, b"xxNEEDLExx")
+    assert simulate(lp_ci, b"xxneedlexx")
+
+
+def test_random_patterns_fuzz():
+    """Randomized supported-pattern generator vs re, via all three engines."""
+    rng = random.Random(1234)
+    atoms = ["a", "b", "c", "x", r"\d", r"\w", r"[a-c]", r"[^ab]", "."]
+    quants = ["", "", "", "?", "*", "+", "{2}", "{1,3}"]
+    for trial in range(150):
+        n = rng.randint(1, 6)
+        parts = []
+        for _ in range(n):
+            parts.append(rng.choice(atoms) + rng.choice(quants))
+        pattern = "".join(parts)
+        if rng.random() < 0.25:
+            pattern = "^" + pattern
+        if rng.random() < 0.25:
+            pattern = pattern + "$"
+        try:
+            alts = compile_regex(pattern)
+        except Unsupported:
+            continue
+        gold = re.compile(pattern.encode())
+        inputs = gen_inputs(rng, n=25)
+        bank = build_bank(alts)
+        L = max(1, max(len(d) for d in inputs))
+        mat = np.zeros((len(inputs), L), dtype=np.uint8)
+        lengths = np.zeros(len(inputs), dtype=np.int32)
+        for i, d in enumerate(inputs):
+            mat[i, : len(d)] = np.frombuffer(d, dtype=np.uint8)
+            lengths[i] = len(d)
+        out = scan_numpy(bank, mat, lengths)
+        for i, data in enumerate(inputs):
+            want = gold.search(data) is not None
+            got_sim = any(simulate(lp, data) for lp in alts)
+            got_scan = bool(out[i].any())
+            assert got_sim == want, (
+                f"simulate {pattern!r} on {data!r}: {got_sim} != {want}")
+            assert got_scan == want, (
+                f"scan {pattern!r} on {data!r}: {got_scan} != {want}")
+
+
+def test_multi_pattern_bank_packing():
+    """Many patterns packed into shared words keep independent verdicts."""
+    patterns = []
+    sources = [r"abc", r"^xyz", r"\d+$", r"a.c", r"(?i)select", r"x{2,3}",
+               r"[a-f]+z", r"qq", r"^/api/", r"\.php$"]
+    per_pattern = []
+    for src in sources:
+        alts = compile_regex(src)
+        per_pattern.append((src, len(alts)))
+        patterns.extend(alts)
+    bank = build_bank(patterns)
+    # All of these are small; they must share words.
+    assert bank.num_words < len(patterns)
+
+    rng = random.Random(7)
+    inputs = gen_inputs(rng, n=40) + [b"/api/v1/x.php", b"selectx", b"12",
+                                       b"aXc", b"ffz", b"xxx"]
+    L = max(len(d) for d in inputs)
+    mat = np.zeros((len(inputs), L), dtype=np.uint8)
+    lengths = np.array([len(d) for d in inputs], dtype=np.int32)
+    for i, d in enumerate(inputs):
+        mat[i, : len(d)] = np.frombuffer(d, dtype=np.uint8)
+    out = scan_numpy(bank, mat, lengths)
+    col = 0
+    for src, n_alts in per_pattern:
+        gold = re.compile(src.encode())
+        got = out[:, col : col + n_alts].any(axis=1)
+        for i, d in enumerate(inputs):
+            assert got[i] == (gold.search(d) is not None), (
+                f"bank {src!r} on {d!r}")
+        col += n_alts

@@ -1,0 +1,187 @@
+"""Device-op tests: JAX NFA scan vs numpy reference; match ops and CIDR
+ops vs Python oracles."""
+
+import ipaddress
+import random
+import re
+
+import numpy as np
+import pytest
+
+from pingoo_tpu.compiler.nfa import build_bank, scan_numpy
+from pingoo_tpu.compiler.repat import compile_regex
+from pingoo_tpu.expr.values import Ip
+from pingoo_tpu.ops.cidr import (
+    build_cidr_table,
+    build_int_set,
+    build_v4_buckets,
+    cidr_contains,
+    cidr_match_one,
+    encode_ip_batch,
+    int_set_contains,
+    ip_to_words,
+    v4_buckets_contains,
+)
+from pingoo_tpu.ops.match_ops import (
+    build_pattern_table,
+    build_suffix_table,
+    eq_match,
+    prefix_match,
+    reverse_bytes,
+    suffix_match,
+)
+from pingoo_tpu.ops.nfa_scan import bank_to_tables, nfa_scan
+
+
+def to_matrix(inputs, L=None):
+    L = L or max(1, max(len(d) for d in inputs))
+    mat = np.zeros((len(inputs), L), dtype=np.uint8)
+    lens = np.zeros(len(inputs), dtype=np.int32)
+    for i, d in enumerate(inputs):
+        mat[i, : len(d)] = np.frombuffer(d[:L], dtype=np.uint8)
+        lens[i] = min(len(d), L)
+    return mat, lens
+
+
+class TestNfaScanJax:
+    def test_matches_numpy_reference(self):
+        patterns = []
+        sources = [r"abc", r"^/api", r"\.php$", r"(?i)select", r"a.c",
+                   r"x{2,3}y", r"[0-9]+", r"^GET$", r"a*b", r"q?q?z$"]
+        for src in sources:
+            patterns.extend(compile_regex(src))
+        bank = build_bank(patterns)
+        tables = bank_to_tables(bank)
+
+        rng = random.Random(99)
+        alphabet = b"abcqxyGETselct0123456789/.php\nSELECT "
+        inputs = [b"", b"\n", b"abc", b"/api/x.php", b"GET", b"SELECT 1",
+                  b"xxy", b"xxxy", b"qz", b"qqz\n"]
+        for _ in range(80):
+            k = rng.randint(0, 30)
+            inputs.append(bytes(rng.choice(alphabet) for _ in range(k)))
+        mat, lens = to_matrix(inputs)
+        want = scan_numpy(bank, mat, lens)
+        got = np.asarray(nfa_scan(tables, mat, lens))
+        assert got.shape == want.shape
+        np.testing.assert_array_equal(got, want)
+
+    def test_agrees_with_re_end_to_end(self):
+        sources = [r"(?i)union\s+select", r"etc/passwd", r"^/admin", r"\.env$"]
+        patterns, spans = [], []
+        for src in sources:
+            alts = compile_regex(src)
+            spans.append((len(patterns), len(patterns) + len(alts)))
+            patterns.extend(alts)
+        tables = bank_to_tables(build_bank(patterns))
+        inputs = [b"/admin/login", b"UNION  SELECT", b"/app/.env", b"clean",
+                  b"/etc/passwd", b"union select", b"x.env.bak"]
+        mat, lens = to_matrix(inputs)
+        got = np.asarray(nfa_scan(tables, mat, lens))
+        for (lo, hi), src in zip(spans, sources):
+            gold = re.compile(src.encode())
+            for i, d in enumerate(inputs):
+                assert got[i, lo:hi].any() == (gold.search(d) is not None), (
+                    src, d)
+
+
+class TestMatchOps:
+    def test_prefix_eq_suffix(self):
+        inputs = [b"/index.html", b"/.env", b"/.env.local", b"/api/v1",
+                  b"", b"/INDEX.HTML"]
+        mat, lens = to_matrix(inputs)
+        pats = [(b"/.env", False), (b"/index", False), (b"/index", True),
+                (b"", False)]
+        table = build_pattern_table(pats)
+        got = np.asarray(prefix_match(mat, lens, table))
+        for i, d in enumerate(inputs):
+            for j, (p, ci) in enumerate(pats):
+                want = (d.lower() if ci else d).startswith(p.lower() if ci else p)
+                assert got[i, j] == want, (d, p, ci)
+
+        eq_table = build_pattern_table([(b"/.env", False), (b"", False)])
+        got = np.asarray(eq_match(mat, lens, eq_table))
+        for i, d in enumerate(inputs):
+            assert got[i, 0] == (d == b"/.env")
+            assert got[i, 1] == (d == b"")
+
+        spats = [(b".html", False), (b".env", False), (b".HTML", True)]
+        stable = build_suffix_table(spats)
+        rev = reverse_bytes(mat, lens)
+        got = np.asarray(suffix_match(rev, lens, stable))
+        for i, d in enumerate(inputs):
+            for j, (p, ci) in enumerate(spats):
+                want = (d.lower() if ci else d).endswith(p.lower() if ci else p)
+                assert got[i, j] == want, (d, p, ci)
+
+    def test_pattern_longer_than_field(self):
+        mat, lens = to_matrix([b"abc"], L=3)
+        table = build_pattern_table([(b"abcdef", False)])
+        assert not np.asarray(prefix_match(mat, lens, table))[0, 0]
+        assert not np.asarray(eq_match(mat, lens, table))[0, 0]
+
+
+def rand_ip(rng):
+    return ipaddress.ip_address(rng.getrandbits(32))
+
+
+class TestCidrOps:
+    def test_masked_compare_table(self):
+        entries = [Ip("10.0.0.0/8"), Ip("192.0.2.1"), Ip("2001:db8::/32"),
+                   Ip("0.0.0.0/0") if False else Ip("172.16.0.0/12")]
+        table = build_cidr_table(entries)
+        probes = [Ip("10.1.2.3"), Ip("192.0.2.1"), Ip("192.0.2.2"),
+                  Ip("2001:db8::5"), Ip("8.8.8.8"), Ip("172.31.255.255"),
+                  Ip("172.32.0.0")]
+        ips = encode_ip_batch(probes)
+        got = np.asarray(cidr_contains(table, ips))
+        for i, probe in enumerate(probes):
+            want = any(e.contains(probe) for e in entries)
+            assert got[i] == want, probe
+
+    def test_single_cidr_and_literal_ip(self):
+        probes = [Ip("203.0.113.7"), Ip("203.0.113.8"), Ip("2001:db8::1")]
+        ips = encode_ip_batch(probes)
+        words, prefix = ip_to_words(Ip("203.0.113.7"))
+        got = np.asarray(cidr_match_one(words, prefix, ips))
+        assert got.tolist() == [True, False, False]
+        words, prefix = ip_to_words(Ip("203.0.113.0/24"))
+        got = np.asarray(cidr_match_one(words, prefix, ips))
+        assert got.tolist() == [True, True, False]
+
+    def test_v4_buckets_large_list(self):
+        rng = random.Random(5)
+        entries = [Ip(str(rand_ip(rng))) for _ in range(500)]
+        entries += [Ip(f"{rng.randrange(256)}.{rng.randrange(256)}.0.0/16")
+                    for _ in range(50)]
+        entries += [Ip("10.0.0.0/8"), Ip("2001:db8::/32"), Ip("0.0.0.0/5")]
+        buckets = build_v4_buckets(entries)
+        probes = [Ip(str(rand_ip(rng))) for _ in range(300)]
+        probes += [entries[0], entries[3], Ip("10.9.9.9"), Ip("2001:db8::9"),
+                   Ip("3.0.0.1")]
+        ips = encode_ip_batch(probes)
+        got = np.asarray(v4_buckets_contains(buckets, ips))
+        for i, probe in enumerate(probes):
+            want = any(e.contains(probe) for e in entries)
+            assert got[i] == want, probe
+
+    def test_int_set(self):
+        table = build_int_set([64500, 64501, 15169, -5])
+        import jax.numpy as jnp
+
+        vals = jnp.asarray(np.array([64500, 64502, 15169, -5, 0], dtype=np.int64))
+        got = np.asarray(int_set_contains(table, vals))
+        assert got.tolist() == [True, False, True, True, False]
+
+    def test_empty_tables(self):
+        table = build_cidr_table([])
+        ips = encode_ip_batch([Ip("1.2.3.4")])
+        assert not np.asarray(cidr_contains(table, ips))[0]
+        buckets = build_v4_buckets([])
+        assert not np.asarray(v4_buckets_contains(buckets, ips))[0]
+        iset = build_int_set([])
+        import jax.numpy as jnp
+
+        assert not np.asarray(
+            int_set_contains(iset, jnp.asarray(np.array([0], dtype=np.int64)))
+        )[0]

@@ -1,0 +1,277 @@
+"""FP/FN parity: the TPU verdict engine vs the interpreter oracle.
+
+The BASELINE.md contract: exact verdict parity between the batched device
+engine and the CPU rules engine over the encoded (truncated) request
+view. Every rule here compiles through the full pipeline
+(compile_ruleset -> make_verdict_fn -> evaluate_batch) and every verdict
+is cross-checked against `execute_as_bool` on per-request contexts.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from pingoo_tpu.compiler import compile_ruleset
+from pingoo_tpu.config.schema import Action, RuleConfig
+from pingoo_tpu.engine import (
+    RequestTuple,
+    batch_to_contexts,
+    encode_requests,
+    evaluate_batch,
+    first_action,
+    make_verdict_fn,
+)
+from pingoo_tpu.expr import Ip, compile_expression, execute_as_bool
+
+ACTIONS = (Action.BLOCK,)
+
+
+def make_rules(sources):
+    return [
+        RuleConfig(name=f"r{i}", expression=compile_expression(src),
+                   actions=ACTIONS)
+        for i, src in enumerate(sources)
+    ]
+
+
+LISTS = {
+    "blocked_ips": [Ip("10.0.0.0/8"), Ip("192.0.2.1"), Ip("203.0.113.0/24")],
+    "blocked_asns": [64500, 64501, 15169],
+    "bad_paths": ["/admin", "/.env", "/wp-login.php"],
+}
+
+RULE_SOURCES = [
+    # the reference's shipped default rule (assets/pingoo.yml)
+    'http_request.path.starts_with("/.env") || http_request.path.starts_with("/.git")',
+    'http_request.path == "/blocked"',
+    'http_request.path.ends_with(".php")',
+    'http_request.path.contains("passwd")',
+    'http_request.url.matches("(?i)union\\s+select")',
+    'http_request.url.matches("%3[Cc]script")',
+    'http_request.user_agent.length() == 0 || http_request.user_agent.contains("curl")',
+    'http_request.method == "POST" && http_request.path.starts_with("/api")',
+    'lists["blocked_ips"].contains(client.ip)',
+    'lists["blocked_asns"].contains(client.asn)',
+    'lists["bad_paths"].contains(http_request.path)',
+    'client.country == "RU" || client.country == "KP"',
+    'client.ip == "198.51.100.7"',
+    'client.remote_port > 40000 && client.asn != 0',
+    'client.asn * 2 + 1 > 129000',
+    'http_request.host.ends_with(".example.com") && !http_request.path.starts_with("/public")',
+    'http_request.path.length() > 64',
+    '!(http_request.method == "GET" || http_request.method == "HEAD")',
+    'lists["missing"].contains(client.ip)',  # runtime error -> never matches
+    'http_request.path.matches("^/(admin|wp-admin|phpmyadmin)")',
+    'true',
+    'false || http_request.path.contains("..")',
+    '1 / 0 == 1 || http_request.path == "/x"',  # left error -> no-match
+    'http_request.path == "/x" || 1 / 0 == 1',  # right error absorbed when left true
+]
+
+HOST_FALLBACK_SOURCES = [
+    # outside the device subset -> host interpretation, still exact
+    'http_request.path < http_request.url',
+    'http_request.host + ":" == "example.com:"',
+    'http_request.path.matches("(abc)+x")',
+]
+
+
+def random_requests(rng, n):
+    paths = ["/", "/index.html", "/.env", "/.git/config", "/blocked",
+             "/admin", "/wp-login.php", "/api/create", "/public/x",
+             "/etc/passwd", "/x", "/a" * 80, "/search?q=union select",
+             "/login.php", "/..%2f..", "/safe/path"]
+    urls = ["/?q=1", "/?q=UNION  SELECT", "/?x=%3Cscript%3E", "/plain",
+            "/search?q=union\tselect"]
+    uas = ["", "Mozilla/5.0", "curl/8.0", "python-requests", "x" * 300]
+    hosts = ["example.com", "api.example.com", "evil.test", "x.example.com"]
+    methods = ["GET", "POST", "HEAD", "DELETE"]
+    countries = ["US", "FR", "RU", "KP", "XX"]
+    ips = ["8.8.8.8", "10.1.2.3", "192.0.2.1", "203.0.113.99",
+           "198.51.100.7", "2001:db8::1", "172.16.0.1"]
+    out = []
+    for _ in range(n):
+        out.append(
+            RequestTuple(
+                host=rng.choice(hosts),
+                url=rng.choice(urls),
+                path=rng.choice(paths),
+                method=rng.choice(methods),
+                user_agent=rng.choice(uas),
+                ip=rng.choice(ips),
+                remote_port=rng.randrange(1024, 65536),
+                asn=rng.choice([0, 15169, 64500, 64501, 65000]),
+                country=rng.choice(countries),
+            )
+        )
+    return out
+
+
+def assert_parity(sources, requests, lists=LISTS):
+    rules = make_rules(sources)
+    plan = compile_ruleset(rules, lists)
+    verdict_fn = make_verdict_fn(plan)
+    batch = encode_requests(requests)
+    matched = evaluate_batch(plan, verdict_fn, plan.device_tables(), batch, lists)
+
+    contexts = batch_to_contexts(batch, lists)
+    for r, rule in enumerate(rules):
+        for i, ctx in enumerate(contexts):
+            want = execute_as_bool(rule.expression, ctx)
+            got = bool(matched[i, r])
+            assert got == want, (
+                f"rule {rule.name} ({sources[r]!r}) on request {i} "
+                f"({requests[i]!r}): device={got} interp={want}"
+            )
+    return plan, matched
+
+
+class TestDeviceParity:
+    def test_main_corpus(self):
+        rng = random.Random(42)
+        plan, _ = assert_parity(RULE_SOURCES, random_requests(rng, 64))
+        # Everything in the main corpus must actually lower to device.
+        assert plan.stats["host_rules"] == 0
+
+    def test_host_fallback_rules(self):
+        rng = random.Random(43)
+        plan, _ = assert_parity(
+            RULE_SOURCES[:4] + HOST_FALLBACK_SOURCES, random_requests(rng, 32))
+        assert plan.stats["host_rules"] == len(HOST_FALLBACK_SOURCES)
+
+    def test_truncation_view_is_consistent(self):
+        # Paths longer than the field cap: parity is over the truncated view.
+        rng = random.Random(44)
+        reqs = [RequestTuple(path="/long" + "a" * 500, url="/u"),
+                RequestTuple(path="/short")]
+        assert_parity(['http_request.path.length() > 256',
+                       'http_request.path.ends_with("a")'], reqs)
+
+    def test_always_match_rule_without_expression(self):
+        rules = [RuleConfig(name="all", expression=None, actions=ACTIONS)]
+        plan = compile_ruleset(rules, {})
+        verdict_fn = make_verdict_fn(plan)
+        batch = encode_requests([RequestTuple(), RequestTuple(path="/x")])
+        matched = evaluate_batch(plan, verdict_fn, plan.device_tables(), batch, {})
+        assert matched.all()
+
+    def test_first_action_semantics(self):
+        sources = ['http_request.path == "/a"', 'http_request.path.starts_with("/")']
+        rules = [
+            RuleConfig(name="r0", expression=compile_expression(sources[0]),
+                       actions=(Action.CAPTCHA,)),
+            RuleConfig(name="r1", expression=compile_expression(sources[1]),
+                       actions=(Action.BLOCK,)),
+        ]
+        plan = compile_ruleset(rules, {})
+        verdict_fn = make_verdict_fn(plan)
+        batch = encode_requests([RequestTuple(path="/a"), RequestTuple(path="/b")])
+        matched = evaluate_batch(plan, verdict_fn, plan.device_tables(), batch, {})
+        acts = first_action(plan, matched)
+        assert acts.tolist() == [2, 1]  # captcha first for /a, block for /b
+
+    def test_fuzzed_numeric_rules(self):
+        rng = random.Random(45)
+        sources = []
+        cols = ["client.asn", "client.remote_port",
+                "http_request.path.length()"]
+        ops = ["+", "-", "*", "/", "%"]
+        cmps = ["==", "!=", "<", "<=", ">", ">="]
+        for _ in range(25):
+            lhs = rng.choice(cols)
+            if rng.random() < 0.7:
+                lhs = f"({lhs} {rng.choice(ops)} {rng.randint(-3, 3)})"
+            src = f"{lhs} {rng.choice(cmps)} {rng.randint(-100, 70000)}"
+            sources.append(src)
+        # overflow / div-zero edges
+        sources += [
+            "client.asn * 9223372036854775807 > 0",
+            "client.asn / 0 == 1",
+            "client.asn % 0 == 0",
+            "-9223372036854775808 - client.asn < 0",
+            "client.remote_port - 9223372036854775807 - 9 < 0",
+        ]
+        plan, _ = assert_parity(sources, random_requests(rng, 48))
+        assert plan.stats["host_rules"] == 0
+
+    def test_fuzzed_boolean_compositions(self):
+        rng = random.Random(46)
+        atoms = [
+            'http_request.path.starts_with("/a")',
+            'http_request.path.contains("min")',
+            'client.asn == 64500',
+            'client.country == "RU"',
+            'lists["blocked_asns"].contains(client.asn)',
+            'lists["missing"].contains(client.asn)',  # error lane
+            'http_request.method == "POST"',
+            "true",
+            "false",
+            "1 / 0 == 1",  # error lane
+        ]
+
+        def gen(depth):
+            if depth == 0 or rng.random() < 0.35:
+                return rng.choice(atoms)
+            a, b = gen(depth - 1), gen(depth - 1)
+            op = rng.choice(["&&", "||"])
+            node = f"({a} {op} {b})"
+            if rng.random() < 0.25:
+                node = "!" + node
+            if rng.random() < 0.12:
+                node = f"({node} == {gen(depth - 1)})"
+            return node
+
+        sources = [gen(3) for _ in range(40)]
+        assert_parity(sources, random_requests(rng, 32))
+
+    def test_review_regressions(self):
+        """End-to-end parity on the exact divergences found in review:
+        (?i) negated classes, unknown escapes, ip == CIDR, lazy bad list
+        entries, empty lists, I64_MIN % -1, literal length."""
+        rng = random.Random(48)
+        lists = {
+            "mixed_bad": ["10.0.0.0/8", "garbage", "192.0.2.1"],
+            "all_bad": ["garbage"],
+            "empty": [],
+        }
+        sources = [
+            'http_request.path.matches("(?i)[^a]")',
+            'http_request.path.matches("(?i)x[^qz]y")',
+            'client.ip == "10.0.0.0/8"',
+            'client.ip != "10.0.0.0/8"',
+            'lists["mixed_bad"].contains(client.ip)',
+            'lists["all_bad"].contains(client.ip)',
+            'lists["empty"].contains(client.ip)',
+            "client.asn % -1 == 0",
+            "client.asn / -1 < 1",
+        ]
+        reqs = random_requests(rng, 24)
+        reqs[0].path = "a"
+        reqs[1].path = "A"
+        reqs[2].path = "xby"
+        reqs[3].ip = "10.1.2.3"
+        reqs[4].ip = "255.255.255.255"
+        reqs[5].ip = "ffff:ffff:ffff:ffff:ffff:ffff:ffff:ffff"
+        reqs[6].asn = -(2**63)
+        assert_parity(sources, reqs, lists=lists)
+        # \q is a bad escape in the oracle -> must NOT lower as literal q.
+        plan, _ = assert_parity(['http_request.path.matches("\\\\q")'],
+                                [RequestTuple(path="/quote")], lists=lists)
+        assert plan.stats["host_rules"] == 1
+
+    def test_large_ip_list_buckets(self):
+        rng = random.Random(47)
+        entries = [Ip(f"{rng.randrange(1, 255)}.{rng.randrange(256)}."
+                      f"{rng.randrange(256)}.{rng.randrange(256)}")
+                   for _ in range(3000)]
+        entries += [Ip("10.0.0.0/8"), Ip("203.0.113.0/24")]
+        lists = {"big": entries}
+        reqs = random_requests(rng, 40)
+        # Make sure some probes hit exact entries.
+        reqs[0].ip = str(entries[0])
+        reqs[1].ip = str(entries[100])
+        plan, _ = assert_parity(['lists["big"].contains(client.ip)'], reqs,
+                                lists=lists)
+        binding = plan.bindings[0]
+        assert binding.kind == "ip_list_large"
