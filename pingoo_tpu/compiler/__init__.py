@@ -1,7 +1,11 @@
-"""Rule compiler: AST -> predicate IR -> device tables (TPU lowering)."""
+"""Rule compiler: AST -> predicate IR -> device tables (TPU lowering).
+
+Submodules import lazily: ops/ modules import compiler.nfa at module
+scope, so eagerly importing plan here (which imports ops back) would
+cycle.
+"""
 
 from .lowering import DEFAULT_FIELD_SPECS, LowerError
-from .plan import RulesetPlan, compile_ruleset
 
 __all__ = [
     "DEFAULT_FIELD_SPECS",
@@ -9,3 +13,11 @@ __all__ = [
     "RulesetPlan",
     "compile_ruleset",
 ]
+
+
+def __getattr__(name):
+    if name in ("RulesetPlan", "compile_ruleset"):
+        from . import plan
+
+        return getattr(plan, name)
+    raise AttributeError(name)

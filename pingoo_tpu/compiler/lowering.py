@@ -231,6 +231,17 @@ class LeafRegistry:
             self._index[leaf] = idx
         return idx
 
+    def mark(self) -> int:
+        return len(self.leaves)
+
+    def rollback(self, mark: int) -> None:
+        """Drop leaves registered after `mark` — used when a rule fails to
+        lower mid-way, so its partial leaves don't bloat device tables.
+        Leaves shared with earlier rules predate the mark and survive."""
+        for leaf in self.leaves[mark:]:
+            del self._index[leaf]
+        del self.leaves[mark:]
+
 
 # -- lowered value categories ------------------------------------------------
 

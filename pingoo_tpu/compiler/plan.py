@@ -124,6 +124,7 @@ def compile_ruleset(
                             ir=None, program=None, host=False, always=True)
             )
             continue
+        mark = registry.mark()
         try:
             ir = lowerer.lower_rule(rule.expression.root)
             planned.append(
@@ -131,6 +132,7 @@ def compile_ruleset(
                             ir=ir, program=rule.expression, host=False)
             )
         except LowerError:
+            registry.rollback(mark)  # don't ship a host rule's partial leaves
             planned.append(
                 PlannedRule(name=rule.name, actions=rule.actions, index=idx,
                             ir=None, program=rule.expression, host=True)

@@ -439,14 +439,14 @@ class _Parser:
         if c in simple:
             return simple[c]
         if c == ord("x"):
-            if self.i + 2 > len(self.data):
-                raise Unsupported("bad \\x escape")
-            try:
-                val = int(self.data[self.i : self.i + 2], 16)
-            except ValueError:
+            digits = self.data[self.i : self.i + 2]
+            # int(.., 16) would accept '+1'/'-1'/' 1'; require hex digits
+            # so invalid escapes reject like the re/Rust oracles do.
+            if len(digits) != 2 or not all(d in b"0123456789abcdefABCDEF"
+                                           for d in digits):
                 raise Unsupported("bad \\x escape")
             self.i += 2
-            return frozenset([val])
+            return frozenset([int(digits, 16)])
         if c in b"bBAZz":
             raise Unsupported(f"\\{chr(c)} boundary assertion")
         if c in b"123456789":

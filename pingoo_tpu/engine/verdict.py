@@ -152,7 +152,37 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
                 tables[key], arrays[f"{field}_bytes"], arrays[f"{field}_len"])
         return nfa_cache[key]
 
+    # Per-leaf NFA extraction: leaves own contiguous slot spans; doing a
+    # per-leaf slice+any would issue hundreds of tiny ops, so instead one
+    # [B, P] x [P, n_leaves] matmul reduces every span at once (MXU does
+    # the OR as a count > 0).
+    nfa_leaf_cache: dict[str, Any] = {}
+
+    def nfa_leaf_matrix(key, field, spans):
+        if key not in nfa_leaf_cache:
+            hits = nfa_result(key, field)
+            P = hits.shape[1]
+            member = np.zeros((P, len(spans)), dtype=np.float32)
+            for j, (lo, hi) in enumerate(spans):
+                member[lo:hi, j] = 1.0
+            counts = jnp.dot(hits.astype(jnp.float32), jnp.asarray(member),
+                             preferred_element_type=jnp.float32)
+            nfa_leaf_cache[key] = counts > 0.0
+        return nfa_leaf_cache[key]
+
     ip_one_cache: Any = None
+
+    # Group NFA leaves per bank so extraction is one matmul per bank.
+    nfa_groups: dict[str, tuple[str, list]] = {}
+    for leaf_id, binding in plan.bindings.items():
+        if binding.kind == "nfa":
+            entry = nfa_groups.setdefault(binding.table_key, (binding.field, []))
+            entry[1].append((leaf_id, binding.span))
+    nfa_leaf_col = {
+        leaf_id: (key, j)
+        for key, (field, members) in nfa_groups.items()
+        for j, (leaf_id, _) in enumerate(members)
+    }
 
     for leaf_id, binding in plan.bindings.items():
         k = binding.kind
@@ -160,9 +190,10 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
             cols = group_result(binding.table_key, binding.field, binding.group)
             results[leaf_id] = (cols[:, binding.col], no_err)
         elif k == "nfa":
-            hits = nfa_result(binding.table_key, binding.field)
-            lo, hi = binding.span
-            results[leaf_id] = (jnp.any(hits[:, lo:hi], axis=1), no_err)
+            key, col = nfa_leaf_col[leaf_id]
+            field, members = nfa_groups[key]
+            mat = nfa_leaf_matrix(key, field, [span for _, span in members])
+            results[leaf_id] = (mat[:, col], no_err)
         elif k == "str_list":
             table = tables[binding.table_key]
             data = arrays[f"{binding.field}_bytes"]
@@ -241,24 +272,46 @@ def _eval_bool(ir, leaves, B):
 def make_verdict_fn(plan: RulesetPlan):
     """Build the jitted device verdict: (tables, arrays) -> [B, R_dev] bool.
 
-    Columns follow plan.device_rule_indices order.
+    Columns follow plan.device_rule_indices order. Rules whose IR is a
+    single leaf (the common WAF shape — one predicate per rule) read
+    their column straight out of the stacked leaf matrix with one
+    gather; only compound rules evaluate their boolean tree (error ->
+    no-match per pingoo/rules.rs:41-44 either way).
     """
     device_rules = [r for r in plan.rules if not r.host]
+    n_leaves = len(plan.leaves)
 
     @jax.jit
     def verdict(tables, arrays):
         B = arrays["asn"].shape[0]
         leaves = _eval_leaves(plan, tables, arrays, B)
-        cols = []
+        # Effective per-leaf match columns (+ const true / false).
+        eff = [None] * n_leaves
+        for leaf_id, (v, e) in leaves.items():
+            eff[leaf_id] = v & ~e
+        base = eff + [
+            jnp.ones((B,), dtype=bool),  # column n_leaves: const true
+            jnp.zeros((B,), dtype=bool),  # column n_leaves + 1: const false
+        ]
+        extra_cols = []
+        rule_col: list[int] = []
         for rule in device_rules:
             if rule.always:
-                cols.append(jnp.ones((B,), dtype=bool))
-                continue
-            v, e = _eval_bool(rule.ir, leaves, B)
-            cols.append(v & ~e)  # error -> no-match (pingoo/rules.rs:41-44)
-        if not cols:
+                rule_col.append(n_leaves)
+            elif isinstance(rule.ir, BLeaf):
+                rule_col.append(rule.ir.leaf_id)
+            elif isinstance(rule.ir, BConst):
+                rule_col.append(n_leaves if rule.ir.value else n_leaves + 1)
+            elif isinstance(rule.ir, BErrConst):
+                rule_col.append(n_leaves + 1)
+            else:
+                v, e = _eval_bool(rule.ir, leaves, B)
+                rule_col.append(len(base) + len(extra_cols))
+                extra_cols.append(v & ~e)
+        if not rule_col:
             return jnp.zeros((B, 0), dtype=bool)
-        return jnp.stack(cols, axis=1)
+        allmat = jnp.stack(base + extra_cols, axis=1)  # [B, NL + 2 + extra]
+        return jnp.take(allmat, jnp.asarray(rule_col, dtype=jnp.int32), axis=1)
 
     return verdict
 
@@ -287,17 +340,13 @@ def evaluate_batch(plan, verdict_fn, tables, batch, lists) -> np.ndarray:
 
 def first_action(plan: RulesetPlan, matched: np.ndarray) -> np.ndarray:
     """First-match action per request (reference http_listener.rs:251-264):
-    0 = none, 1 = block, 2 = captcha."""
-    B = matched.shape[0]
-    out = np.zeros(B, dtype=np.int32)
+    0 = none, 1 = block, 2 = captcha. Vectorized — runs on the per-batch
+    decision path."""
     rule_actions = np.zeros(len(plan.rules), dtype=np.int32)
     for r in plan.rules:
         if r.actions:
             rule_actions[r.index] = 1 if r.actions[0] == Action.BLOCK else 2
-    for i in range(B):
-        hits = np.nonzero(matched[i])[0]
-        for idx in hits:
-            if rule_actions[idx]:
-                out[i] = rule_actions[idx]
-                break
-    return out
+    acting = matched & (rule_actions != 0)[None, :]  # [B, R]
+    any_hit = acting.any(axis=1)
+    first = np.argmax(acting, axis=1)  # first True column (0 if none)
+    return np.where(any_hit, rule_actions[first], 0).astype(np.int32)

@@ -152,51 +152,62 @@ def _lex_number(src: str, i: int) -> tuple[Token, int]:
     return Token(INT, int(text), start), j
 
 
+_HEX = set("0123456789abcdefABCDEF")
+
+
 def _lex_string(src: str, i: int) -> tuple[Token, int]:
+    """Lex a string literal into the framework's canonical byte view.
+
+    String values are sequences of BYTES presented as latin-1 strings
+    (one char per byte, bijective — see expr/values.py). Source
+    characters encode as their UTF-8 bytes (so a literal "café" compares
+    equal to the UTF-8 wire bytes of café, matching the Rust reference's
+    &str semantics, and "é".length() == 2 like Rust's str::len);
+    `\\xhh` injects the raw byte hh; `\\uXXXX` injects the codepoint's
+    UTF-8 bytes.
+    """
     quote = src[i]
     start = i
     i += 1
     n = len(src)
-    out: list[str] = []
+    out = bytearray()
     while i < n:
         c = src[i]
         if c == quote:
-            return Token(STRING, "".join(out), start), i + 1
+            return Token(STRING, bytes(out).decode("latin-1"), start), i + 1
         if c == "\\":
             if i + 1 >= n:
                 break
             esc = src[i + 1]
             if esc in _ESCAPES:
-                out.append(_ESCAPES[esc])
+                out += _ESCAPES[esc].encode("utf-8")
                 i += 2
                 continue
             if esc == "x" and i + 3 < n:
-                try:
-                    out.append(chr(int(src[i + 2 : i + 4], 16)))
-                except ValueError:
-                    raise CompileError("invalid \\x escape", i) from None
+                hex_digits = src[i + 2 : i + 4]
+                if len(hex_digits) != 2 or not set(hex_digits) <= _HEX:
+                    raise CompileError("invalid \\x escape", i)
+                out.append(int(hex_digits, 16))
                 i += 4
                 continue
             if esc == "u" and i + 5 < n:
-                try:
-                    cp = int(src[i + 2 : i + 6], 16)
-                except ValueError:
-                    raise CompileError("invalid \\u escape", i) from None
+                hex_digits = src[i + 2 : i + 6]
+                if len(hex_digits) != 4 or not set(hex_digits) <= _HEX:
+                    raise CompileError("invalid \\u escape", i)
+                cp = int(hex_digits, 16)
                 if 0xD800 <= cp <= 0xDFFF:
-                    # Lone surrogates are not valid scalar values; letting
-                    # them through would crash UTF-8 encoding later.
                     raise CompileError("invalid \\u escape: surrogate", i)
-                out.append(chr(cp))
+                out += chr(cp).encode("utf-8")
                 i += 6
                 continue
             # Unknown escapes are preserved literally (like Python / YAML
             # single-quoted strings): rule expressions embed regexes
             # ("union\s+select"), and forcing double-backslashes there is
             # exactly the kind of surprise this language trims off.
-            out.append("\\")
-            out.append(esc)
+            out += b"\\"
+            out += esc.encode("utf-8")
             i += 2
             continue
-        out.append(c)
+        out += c.encode("utf-8")
         i += 1
     raise CompileError("unterminated string literal", start)

@@ -1,0 +1,5 @@
+"""Learned components (bot-score head)."""
+
+from . import botscore
+
+__all__ = ["botscore"]

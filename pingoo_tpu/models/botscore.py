@@ -1,0 +1,144 @@
+"""Bot-score head: vectorized feature extraction + logistic MLP.
+
+The reference's bot protection is a proof-of-work captcha gated per
+request by cookie checks (pingoo/captcha.rs; gate wiring at
+http_listener.rs:200-236). The TPU-native upgrade from BASELINE.json
+config 5: extract cheap request features on device from the already-
+encoded verdict batch and score them with a small learned head, so the
+captcha gate can be risk-based instead of rule-only. The head's score
+rides back with the verdict bitmap; the host decides the gate.
+
+Features (all computed from the RequestBatch tensors, no extra host
+work): field lengths, UA byte-class composition (the "UA entropy" proxy),
+path shape, method/country/ASN/port hash buckets. The model is a 2-layer
+MLP trained with BCE; `train_step` is a pure jittable function suitable
+for dp-sharded data-parallel training (GSPMD averages the gradients).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NUM_FEATURES = 46
+HIDDEN = 64
+
+
+def extract_features(arrays: dict) -> jax.Array:
+    """RequestBatch arrays -> [B, NUM_FEATURES] float32 (device-side)."""
+    f32 = jnp.float32
+
+    def norm_len(key, cap):
+        return (arrays[f"{key}_len"].astype(f32) / cap)[:, None]
+
+    ua = arrays["user_agent_bytes"]
+    ua_len = jnp.maximum(arrays["user_agent_len"].astype(f32), 1.0)
+    pos_ok = (
+        jnp.arange(ua.shape[1], dtype=jnp.int32)[None, :]
+        < arrays["user_agent_len"][:, None]
+    )
+
+    def frac(lo, hi):
+        inside = (ua >= lo) & (ua <= hi) & pos_ok
+        return (inside.sum(axis=1).astype(f32) / ua_len)[:, None]
+
+    path = arrays["path_bytes"]
+    path_pos = (
+        jnp.arange(path.shape[1], dtype=jnp.int32)[None, :]
+        < arrays["path_len"][:, None]
+    )
+    slashes = ((path == 0x2F) & path_pos).sum(axis=1).astype(f32)[:, None]
+    dots = ((path == 0x2E) & path_pos).sum(axis=1).astype(f32)[:, None]
+    pcts = ((path == 0x25) & path_pos).sum(axis=1).astype(f32)[:, None]
+
+    method = arrays["method_bytes"]
+    method_hash = (
+        method[:, 0].astype(jnp.int32) * 7 + arrays["method_len"].astype(jnp.int32)
+    ) % 8
+    country = arrays["country_bytes"]
+    country_hash = (
+        country[:, 0].astype(jnp.int32) * 31 + country[:, 1].astype(jnp.int32)
+    ) % 16
+    asn_hash = (arrays["asn"].astype(jnp.int32) * 2654435761 >> 24) % 8
+    port = arrays["remote_port"].astype(f32) / 65535.0
+
+    feats = jnp.concatenate(
+        [
+            norm_len("user_agent", 256.0),
+            norm_len("path", 256.0),
+            norm_len("url", 512.0),
+            norm_len("host", 128.0),
+            (arrays["user_agent_len"] == 0).astype(f32)[:, None],
+            frac(0x30, 0x39),  # digits
+            frac(0x41, 0x5A),  # uppercase
+            frac(0x61, 0x7A),  # lowercase
+            frac(0x20, 0x2F),  # punctuation-ish
+            slashes / 32.0,
+            dots / 16.0,
+            pcts / 16.0,
+            port[:, None],
+            jax.nn.one_hot(method_hash, 8, dtype=f32),
+            jax.nn.one_hot(country_hash, 16, dtype=f32),
+            jax.nn.one_hot(asn_hash, 8, dtype=f32),
+            jnp.ones((ua.shape[0], 1), dtype=f32),  # bias channel
+        ],
+        axis=1,
+    )
+    assert feats.shape[1] == NUM_FEATURES, feats.shape
+    return feats
+
+
+class Params(NamedTuple):
+    w1: jax.Array  # [F, H]
+    b1: jax.Array  # [H]
+    w2: jax.Array  # [H, 1]
+    b2: jax.Array  # [1]
+
+
+def init_params(rng: jax.Array, hidden: int = HIDDEN) -> Params:
+    k1, k2 = jax.random.split(rng)
+    scale1 = 1.0 / np.sqrt(NUM_FEATURES)
+    scale2 = 1.0 / np.sqrt(hidden)
+    return Params(
+        w1=jax.random.normal(k1, (NUM_FEATURES, hidden), jnp.float32) * scale1,
+        b1=jnp.zeros((hidden,), jnp.float32),
+        w2=jax.random.normal(k2, (hidden, 1), jnp.float32) * scale2,
+        b2=jnp.zeros((1,), jnp.float32),
+    )
+
+
+def logits(params: Params, feats: jax.Array) -> jax.Array:
+    h = jax.nn.relu(feats @ params.w1 + params.b1)
+    return (h @ params.w2 + params.b2)[:, 0]
+
+
+def score(params: Params, arrays: dict) -> jax.Array:
+    """[B] bot probability in [0, 1] — runs inside the verdict step."""
+    return jax.nn.sigmoid(logits(params, extract_features(arrays)))
+
+
+def bce_loss(params: Params, feats: jax.Array, labels: jax.Array) -> jax.Array:
+    lg = logits(params, feats)
+    return jnp.mean(
+        jnp.maximum(lg, 0) - lg * labels + jnp.log1p(jnp.exp(-jnp.abs(lg)))
+    )
+
+
+def make_train_step(learning_rate: float = 1e-3):
+    """Returns a jittable (params, opt_state, feats, labels) -> updated
+    (params, opt_state, loss). dp sharding of feats/labels gives
+    data-parallel training; GSPMD inserts the gradient reductions."""
+    import optax
+
+    tx = optax.adamw(learning_rate)
+
+    def train_step(params: Params, opt_state, feats, labels):
+        loss, grads = jax.value_and_grad(bce_loss)(params, feats, labels)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return tx, train_step

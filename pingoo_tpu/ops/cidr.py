@@ -175,24 +175,61 @@ def v4_buckets_contains(buckets: V4PrefixBuckets, ips: jax.Array) -> jax.Array:
     return v4_hit | aux_hit
 
 
-class SortedIntSet(NamedTuple):
-    """Int list membership via sorted array + searchsorted
-    (lists["blocked_asns"].contains(client.asn))."""
+class IntBitset(NamedTuple):
+    """Dense-ish non-negative int set as an HBM bitset (BASELINE.md
+    config 3): one uint32 word gather + bit test per probe — the ASN
+    blocklist lowering. int64 never touches the hot path (it is emulated
+    on TPU)."""
 
-    keys: jax.Array  # [N] int64 sorted
+    bitset: jax.Array  # [ceil(max/32)] uint32
+
+
+class SortedIntSet(NamedTuple):
+    """Sparse / out-of-range int set: sorted array + searchsorted.
+    Keys are int32 whenever every value fits, gated by an in-range check
+    on the int64 probe lane."""
+
+    keys: jax.Array  # [N] sorted (int32 when values fit, else int64)
     size: jax.Array  # scalar int32
 
 
-def build_int_set(values: list[int]) -> SortedIntSet:
+BITSET_MAX_VALUE = 1 << 26  # 8 MB of bits covers the ASN space 16x over
+
+
+def build_int_set(values: list[int]):
     vals = sorted(set(values))
+    if vals and vals[0] >= 0 and vals[-1] < BITSET_MAX_VALUE:
+        nwords = (vals[-1] >> 5) + 1
+        bits = np.zeros(nwords, dtype=np.uint32)
+        arr = np.array(vals, dtype=np.int64)
+        np.bitwise_or.at(bits, arr >> 5, np.uint32(1) << (arr & 31).astype(np.uint32))
+        return IntBitset(bitset=jnp.asarray(bits))
+    fits32 = all(-(2**31) <= v < 2**31 for v in vals)
+    dtype = np.int32 if fits32 else np.int64
     N = max(len(vals), 1)
-    keys = np.full(N, np.iinfo(np.int64).max, dtype=np.int64)
-    keys[: len(vals)] = np.array(vals, dtype=np.int64)
-    return SortedIntSet(jnp.asarray(keys), jnp.asarray(np.int32(len(vals))))
+    keys = np.full(N, np.iinfo(dtype).max, dtype=dtype)
+    keys[: len(vals)] = np.array(vals, dtype=dtype)
+    return SortedIntSet(
+        keys=jnp.asarray(keys), size=jnp.asarray(np.int32(len(vals)))
+    )
 
 
-def int_set_contains(table: SortedIntSet, values: jax.Array) -> jax.Array:
-    """values [B] int64 -> [B] bool."""
-    idx = jnp.searchsorted(table.keys, values)
+def int_set_contains(table, values: jax.Array) -> jax.Array:
+    """values [B] int64 -> [B] bool. `table` is IntBitset or SortedIntSet
+    (static structure, so the branch resolves at trace time)."""
+    if isinstance(table, IntBitset):
+        nbits = table.bitset.shape[0] << 5
+        in_range = (values >= 0) & (values < nbits)
+        idx = jnp.clip(values, 0, nbits - 1).astype(jnp.int32)
+        word = jnp.take(table.bitset, idx >> 5)
+        hit = (word >> (idx & 31).astype(jnp.uint32)) & jnp.uint32(1)
+        return (hit != 0) & in_range
+    if table.keys.dtype == jnp.int32:
+        in_range = (values >= -(2**31)) & (values < 2**31)
+        probe = jnp.clip(values, -(2**31), 2**31 - 1).astype(jnp.int32)
+    else:
+        in_range = jnp.ones(values.shape, dtype=bool)
+        probe = values
+    idx = jnp.searchsorted(table.keys, probe)
     idx = jnp.clip(idx, 0, table.keys.shape[0] - 1)
-    return (jnp.take(table.keys, idx) == values) & (idx < table.size)
+    return (jnp.take(table.keys, idx) == probe) & (idx < table.size) & in_range

@@ -83,6 +83,79 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
     )
 
 
+def scan_chunk(
+    tables: NfaTables,
+    data: jax.Array,
+    lengths: jax.Array,
+    state: jax.Array,
+    float_acc: jax.Array,
+    end_acc: jax.Array,
+    ends_nl: jax.Array,
+    t_offset,
+):
+    """Advance the NFA over one [B, Lc] byte chunk whose first column sits
+    at global position `t_offset`. Carries (state, float_acc, end_acc) so
+    chunks compose — used by the plain scan and by the sp ring scan
+    (parallel/ring.py), which passes state between devices via ppermute.
+    """
+    Lc = data.shape[1]
+    one = jnp.uint32(1)
+    opt = tables.opt
+    rep = tables.rep
+    lengths = lengths.astype(jnp.int32)
+
+    def step(carry, xs):
+        S, fa, ea = carry
+        c, t_local = xs  # c: [B] uint8
+        t = t_local + t_offset  # global byte position
+        bc = jnp.take(tables.byte_table, c.astype(jnp.int32), axis=0)  # [B, W]
+        inj = jnp.where(t == 0, tables.init_unanchored | tables.init_anchored,
+                        tables.init_unanchored)
+        adv = (S << one) | inj[None, :]
+        adv = adv | (((adv & opt) + opt) ^ opt)
+        pre = adv | (S & rep)
+        S_new = pre & bc
+        active = (t < lengths)[:, None]
+        S = jnp.where(active, S_new, S)
+        fa = fa | jnp.where(active, S_new & tables.last_float, 0)
+        before_nl = (ends_nl & (t == lengths - 2))[:, None]
+        ea = ea | jnp.where(before_nl, S_new & tables.last_end, 0)
+        return (S, fa, ea), None
+
+    (state, float_acc, end_acc), _ = jax.lax.scan(
+        step,
+        (state, float_acc, end_acc),
+        (data.T, jnp.arange(Lc, dtype=jnp.int32)),
+    )
+    return state, float_acc, end_acc
+
+
+def trailing_newline_mask(data: jax.Array, lengths: jax.Array) -> jax.Array:
+    B = data.shape[0]
+    lengths = lengths.astype(jnp.int32)
+    last_byte = data[jnp.arange(B), jnp.maximum(lengths - 1, 0)]
+    return (lengths > 0) & (last_byte == 0x0A)
+
+
+def extract_slots(
+    tables: NfaTables,
+    float_acc: jax.Array,
+    end_acc: jax.Array,
+    lengths: jax.Array,
+    ends_nl: jax.Array,
+) -> jax.Array:
+    """Per-pattern verdict columns [B, P] from accumulated word lanes."""
+    lengths = lengths.astype(jnp.int32)
+    fa = jnp.take(float_acc, tables.slot_word, axis=1)  # [B, P]
+    ea = jnp.take(end_acc, tables.slot_word, axis=1)
+    lanes = jnp.where(tables.slot_end[None, :], ea, fa)
+    hit = (lanes & tables.slot_mask[None, :]) != 0
+    empty_like = ((lengths == 0) | (ends_nl & (lengths == 1)))[:, None]
+    hit = hit | (tables.slot_end & tables.slot_empty_ok)[None, :] & empty_like
+    hit = hit | tables.slot_always[None, :]
+    return hit
+
+
 def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array) -> jax.Array:
     """Run the bank over a byte batch.
 
@@ -93,45 +166,8 @@ def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array) -> jax.Arra
     state0 = jnp.zeros((B, tables.opt.shape[0]), dtype=jnp.uint32)
     acc0 = jnp.zeros_like(state0)
     endacc0 = jnp.zeros_like(state0)
-
-    lengths = lengths.astype(jnp.int32)
-    last_byte = data[jnp.arange(B), jnp.maximum(lengths - 1, 0)]
-    ends_nl = (lengths > 0) & (last_byte == 0x0A)
-
-    one = jnp.uint32(1)
-    opt = tables.opt
-    rep = tables.rep
-
-    def step(carry, xs):
-        S, float_acc, end_acc = carry
-        c, t = xs  # c: [B] uint8, t: scalar step index
-        bc = jnp.take(tables.byte_table, c.astype(jnp.int32), axis=0)  # [B, W]
-        inj = jnp.where(t == 0, tables.init_unanchored | tables.init_anchored,
-                        tables.init_unanchored)
-        adv = (S << one) | inj[None, :]
-        adv = adv | (((adv & opt) + opt) ^ opt)
-        pre = adv | (S & rep)
-        S_new = pre & bc
-        active = (t < lengths)[:, None]
-        S = jnp.where(active, S_new, S)
-        float_acc = float_acc | jnp.where(active, S_new & tables.last_float, 0)
-        before_nl = (ends_nl & (t == lengths - 2))[:, None]
-        end_acc = end_acc | jnp.where(before_nl, S_new & tables.last_end, 0)
-        return (S, float_acc, end_acc), None
-
-    (S, float_acc, end_acc), _ = jax.lax.scan(
-        step,
-        (state0, acc0, endacc0),
-        (data.T, jnp.arange(L, dtype=jnp.int32)),
-    )
-    end_acc = end_acc | (S & tables.last_end)
-
-    # Slot extraction: [B, P]
-    fa = jnp.take(float_acc, tables.slot_word, axis=1)  # [B, P]
-    ea = jnp.take(end_acc, tables.slot_word, axis=1)
-    lanes = jnp.where(tables.slot_end[None, :], ea, fa)
-    hit = (lanes & tables.slot_mask[None, :]) != 0
-    empty_like = ((lengths == 0) | (ends_nl & (lengths == 1)))[:, None]
-    hit = hit | (tables.slot_end & tables.slot_empty_ok)[None, :] & empty_like
-    hit = hit | tables.slot_always[None, :]
-    return hit
+    ends_nl = trailing_newline_mask(data, lengths)
+    state, float_acc, end_acc = scan_chunk(
+        tables, data, lengths, state0, acc0, endacc0, ends_nl, 0)
+    end_acc = end_acc | (state & tables.last_end)
+    return extract_slots(tables, float_acc, end_acc, lengths, ends_nl)

@@ -1,0 +1,14 @@
+"""Mesh construction, dp/tp/sp shardings, and the sp ring NFA scan."""
+
+from .. import ops as _ops  # noqa: F401  (x64 before tracing)
+from .mesh import batch_shardings, make_mesh, pad_tables_for_tp, table_shardings
+from .ring import ring_nfa_scan, shard_batch_for_ring
+
+__all__ = [
+    "batch_shardings",
+    "make_mesh",
+    "pad_tables_for_tp",
+    "ring_nfa_scan",
+    "shard_batch_for_ring",
+    "table_shardings",
+]

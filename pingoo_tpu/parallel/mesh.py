@@ -1,0 +1,153 @@
+"""Device mesh + sharding specs for the verdict engine.
+
+The scaling model (SURVEY.md §2 "Parallelism strategies"): the natural
+mapping of the classic axes onto a WAF verdict engine is
+
+  dp — request-batch sharding (the throughput lever; every batch row is
+       independent, so dp scales embarrassingly),
+  tp — rule/pattern sharding: pattern tables shard on their pattern axis
+       and NFA banks on their word axis (patterns are confined to single
+       uint32 words by construction, compiler/nfa.py, so word sharding IS
+       rule sharding),
+  sp — sequence (byte-dimension) sharding for long fields via the ring
+       scan in parallel/ring.py.
+
+Everything here uses jax.sharding + GSPMD: we annotate in_shardings on
+the jitted verdict and let XLA insert the collectives over ICI, rather
+than hand-writing them (scaling-book recipe: pick a mesh, annotate,
+let XLA do the rest).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.match_ops import PatternTable
+from ..ops.nfa_scan import NfaTables
+
+
+def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
+              devices: list | None = None) -> Mesh:
+    devices = devices if devices is not None else jax.devices()
+    n = dp * tp * sp
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(dp, tp, sp)
+    return Mesh(arr, axis_names=("dp", "tp", "sp"))
+
+
+def batch_shardings(mesh: Mesh, arrays: Mapping[str, Any]) -> dict:
+    """Batch pytree: every array shards its leading (request) axis on dp."""
+    out = {}
+    for key, arr in arrays.items():
+        spec = [None] * np.ndim(arr)
+        if np.ndim(arr) >= 1:
+            spec[0] = "dp"
+        out[key] = NamedSharding(mesh, P(*spec))
+    return out
+
+
+def table_shardings(mesh: Mesh, tables: Mapping[str, Any]) -> dict:
+    """Device-table pytree: shard rule-parallel axes on tp, replicate the
+    rest. PatternTable shards its pattern axis; NfaTables shards the NFA
+    word axis (and the per-pattern slot arrays)."""
+    repl = NamedSharding(mesh, P())
+
+    def shard_pattern_table(t: PatternTable) -> PatternTable:
+        return PatternTable(
+            bytes=NamedSharding(mesh, P("tp", None)),
+            lengths=NamedSharding(mesh, P("tp")),
+            ci=NamedSharding(mesh, P("tp")),
+        )
+
+    def shard_nfa(t: NfaTables) -> NfaTables:
+        w = NamedSharding(mesh, P("tp"))
+        return NfaTables(
+            byte_table=NamedSharding(mesh, P(None, "tp")),
+            init_anchored=w,
+            init_unanchored=w,
+            opt=w,
+            rep=w,
+            last_float=w,
+            last_end=w,
+            slot_word=NamedSharding(mesh, P("tp")),
+            slot_mask=NamedSharding(mesh, P("tp")),
+            slot_end=NamedSharding(mesh, P("tp")),
+            slot_always=NamedSharding(mesh, P("tp")),
+            slot_empty_ok=NamedSharding(mesh, P("tp")),
+        )
+
+    out: dict = {}
+    for key, val in tables.items():
+        if isinstance(val, PatternTable) and _divisible(val.bytes.shape[0], mesh, "tp"):
+            out[key] = shard_pattern_table(val)
+        elif isinstance(val, NfaTables) and _divisible(
+                val.opt.shape[0], mesh, "tp") and _divisible(
+                val.slot_word.shape[0], mesh, "tp"):
+            out[key] = shard_nfa(val)
+        else:
+            out[key] = jax.tree_util.tree_map(lambda _: repl, val)
+    return out
+
+
+def _divisible(dim: int, mesh: Mesh, axis: str) -> bool:
+    size = mesh.shape[axis]
+    return size > 1 and dim % size == 0 or size == 1
+
+
+def pad_tables_for_tp(np_tables: dict, tp: int) -> dict:
+    """Pad pattern/word axes to multiples of tp so they shard evenly.
+
+    Padding rows are inert: zero-length patterns in a PatternTable can
+    only produce spurious columns that no leaf binding reads; NFA padding
+    words carry no init bits so their lanes stay dead. Slot arrays pad
+    with always-false slots (mask 0, word 0).
+    """
+    import numpy as np  # local: keep module import-light
+
+    if tp <= 1:
+        return np_tables
+
+    def pad_axis(arr, axis, mult, fill=0):
+        size = arr.shape[axis]
+        target = -(-size // mult) * mult
+        if target == size:
+            return arr
+        pad = [(0, 0)] * arr.ndim
+        pad[axis] = (0, target - size)
+        return np.pad(arr, pad, constant_values=fill)
+
+    out = {}
+    for key, val in np_tables.items():
+        if isinstance(val, PatternTable):
+            b = np.asarray(val.bytes)
+            out[key] = PatternTable(
+                bytes=pad_axis(b, 0, tp),
+                # Padded patterns get length > field capacity so
+                # prefix/eq on them can never match (lengths check).
+                lengths=pad_axis(np.asarray(val.lengths), 0, tp,
+                                 fill=np.int32(2**30)),
+                ci=pad_axis(np.asarray(val.ci), 0, tp),
+            )
+        elif isinstance(val, NfaTables):
+            out[key] = NfaTables(
+                byte_table=pad_axis(np.asarray(val.byte_table), 1, tp),
+                init_anchored=pad_axis(np.asarray(val.init_anchored), 0, tp),
+                init_unanchored=pad_axis(np.asarray(val.init_unanchored), 0, tp),
+                opt=pad_axis(np.asarray(val.opt), 0, tp),
+                rep=pad_axis(np.asarray(val.rep), 0, tp),
+                last_float=pad_axis(np.asarray(val.last_float), 0, tp),
+                last_end=pad_axis(np.asarray(val.last_end), 0, tp),
+                slot_word=pad_axis(np.asarray(val.slot_word), 0, tp),
+                slot_mask=pad_axis(np.asarray(val.slot_mask), 0, tp),
+                slot_end=pad_axis(np.asarray(val.slot_end), 0, tp),
+                slot_always=pad_axis(np.asarray(val.slot_always), 0, tp),
+                slot_empty_ok=pad_axis(np.asarray(val.slot_empty_ok), 0, tp),
+            )
+        else:
+            out[key] = val
+    return out

@@ -1,0 +1,106 @@
+"""Sequence-parallel NFA scan: byte-dimension sharding with a state ring.
+
+Long-field handling (SURVEY.md §5 "Long-context / sequence parallelism"):
+the byte dimension of a field is split into contiguous chunks across the
+`sp` mesh axis; each device scans only its chunk and the carried NFA
+state travels around the ring via `ppermute` — the ring-attention-style
+accumulation of scan state across chunk boundaries, applied to the
+bit-parallel NFA instead of attention blocks.
+
+Stage s: the device holding chunk s advances the state it just received
+over its local bytes; every device then rotates its state register one
+step around the ring, delivering the true state to the device holding
+chunk s+1. Float accepts accumulate on whichever device finds them and
+are OR-combined at the end (psum over the one-hot contributions);
+$-anchored accepts are evaluated by the device that ran the final stage.
+
+This distributes both the byte tensors and the NFA state over sp, so a
+field's device footprint shrinks 1/sp while verdict semantics stay
+bit-identical to ops/nfa_scan.nfa_scan (differentially tested on the
+8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.nfa_scan import NfaTables, extract_slots, scan_chunk
+
+
+def ring_nfa_scan(
+    mesh: Mesh,
+    tables: NfaTables,
+    data: jax.Array,
+    lengths: jax.Array,
+) -> jax.Array:
+    """nfa_scan with the byte axis sharded over mesh axis 'sp' (and the
+    batch axis over 'dp'). data: [B, L] with L % sp == 0."""
+    sp = mesh.shape["sp"]
+    B, L = data.shape
+    assert L % sp == 0, "byte axis must divide evenly over sp"
+    Lc = L // sp
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), P("dp", "sp"), P("dp")),
+        out_specs=P("dp", None),
+        check_vma=False,
+    )
+    def kernel(tables_local: NfaTables, chunk: jax.Array, lengths_local: jax.Array):
+        sp_idx = jax.lax.axis_index("sp")
+        Bl = chunk.shape[0]
+        W = tables_local.opt.shape[0]
+        state = jnp.zeros((Bl, W), dtype=jnp.uint32)
+        float_acc = jnp.zeros_like(state)
+        end_acc = jnp.zeros_like(state)
+
+        # Trailing-newline flag needs the *global* last byte; each device
+        # checks whether it owns position len-1 and the flag is OR-shared.
+        lengths_i = lengths_local.astype(jnp.int32)
+        local_pos = jnp.clip(lengths_i - 1 - sp_idx * Lc, 0, Lc - 1)
+        owns_last = (lengths_i > 0) & (
+            (lengths_i - 1) // Lc == sp_idx)
+        my_last = chunk[jnp.arange(Bl), local_pos]
+        nl_local = owns_last & (my_last == 0x0A)
+        ends_nl = jax.lax.psum(nl_local.astype(jnp.int32), "sp") > 0
+
+        perm = [(i, (i + 1) % sp) for i in range(sp)]
+        final_end_bits = jnp.zeros_like(state)
+        for stage in range(sp):
+            my_turn = sp_idx == stage
+            s2, f2, e2 = scan_chunk(
+                tables_local, chunk, lengths_local, state, float_acc,
+                end_acc, ends_nl, stage * Lc)
+            # Only the stage owner's results are real this round. Note
+            # the owner of stage `stage` is the device whose chunk is at
+            # byte offset stage*Lc — device index == stage.
+            take = my_turn
+            state = jnp.where(take, s2, state)
+            float_acc = jnp.where(take, f2, float_acc)
+            end_acc = jnp.where(take, e2, end_acc)
+            if stage == sp - 1:
+                final_end_bits = jnp.where(
+                    take, state & tables_local.last_end, final_end_bits)
+            # Rotate the state register one step; accs stay local.
+            state = jax.lax.ppermute(state, "sp", perm)
+
+        end_acc = end_acc | final_end_bits
+        hits = extract_slots(
+            tables_local, float_acc, end_acc, lengths_local, ends_nl)
+        # OR the per-device partial verdicts (disjoint discovery times,
+        # possibly overlapping patterns).
+        return jax.lax.psum(hits.astype(jnp.int32), "sp") > 0
+
+    return kernel(tables, data, lengths)
+
+
+def shard_batch_for_ring(mesh: Mesh, data, lengths):
+    """Place [B, L] bytes with B over dp and L over sp; lengths over dp."""
+    data_s = jax.device_put(data, NamedSharding(mesh, P("dp", "sp")))
+    lens_s = jax.device_put(lengths, NamedSharding(mesh, P("dp")))
+    return data_s, lens_s

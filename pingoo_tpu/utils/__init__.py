@@ -1,0 +1,1 @@
+"""Shared utilities (ruleset/traffic generators, observability)."""

@@ -1,0 +1,133 @@
+"""Sharding tests on the 8-device virtual CPU mesh (SURVEY.md §4 item 4:
+multi-node behavior without a cluster)."""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from pingoo_tpu.compiler import compile_ruleset
+from pingoo_tpu.compiler.nfa import build_bank
+from pingoo_tpu.compiler.repat import compile_regex
+from pingoo_tpu.config.schema import Action, RuleConfig
+from pingoo_tpu.engine import encode_requests, evaluate_batch, make_verdict_fn
+from pingoo_tpu.expr import compile_expression
+from pingoo_tpu.ops.nfa_scan import bank_to_tables, nfa_scan
+from pingoo_tpu.parallel import (
+    batch_shardings,
+    make_mesh,
+    pad_tables_for_tp,
+    ring_nfa_scan,
+    shard_batch_for_ring,
+    table_shardings,
+)
+
+from test_parity import LISTS, RULE_SOURCES, make_rules, random_requests
+
+
+@pytest.fixture(scope="module")
+def devices():
+    devs = jax.devices()
+    assert len(devs) >= 8, "tests need 8 virtual CPU devices (conftest)"
+    return devs
+
+
+class TestDpTpSharding:
+    def test_sharded_verdict_matches_unsharded(self, devices):
+        """GSPMD-sharded verdict (dp=2, tp=2): identical match matrix."""
+        rng = random.Random(7)
+        rules = make_rules(RULE_SOURCES)
+        mesh = make_mesh(dp=2, tp=2, sp=1)
+        plan = compile_ruleset(rules, LISTS)
+        plan.np_tables = pad_tables_for_tp(plan.np_tables, tp=2)
+        verdict_fn = make_verdict_fn(plan)
+        batch = encode_requests(random_requests(rng, 32))
+        tables = plan.device_tables()
+
+        want = evaluate_batch(plan, verdict_fn, tables, batch, LISTS)
+
+        # Shard tables + batch and re-evaluate.
+        t_shard = table_shardings(mesh, tables)
+        b_shard = batch_shardings(mesh, batch.arrays)
+        tables_s = {
+            k: jax.device_put(v, t_shard[k]) if not isinstance(t_shard[k], dict)
+            else {kk: jax.device_put(vv, t_shard[k][kk]) for kk, vv in v.items()}
+            for k, v in tables.items()
+        }
+        arrays_s = {k: jax.device_put(np.asarray(v), b_shard[k])
+                    for k, v in batch.arrays.items()}
+
+        class _B:
+            size = batch.size
+            arrays = arrays_s
+
+        got = evaluate_batch(plan, verdict_fn, tables_s, _B(), LISTS)
+        np.testing.assert_array_equal(got, want)
+
+    def test_tp_actually_shards_pattern_tables(self, devices):
+        mesh = make_mesh(dp=1, tp=4, sp=1)
+        rules = make_rules(RULE_SOURCES)
+        plan = compile_ruleset(rules, LISTS)
+        plan.np_tables = pad_tables_for_tp(plan.np_tables, tp=4)
+        tables = plan.device_tables()
+        specs = table_shardings(mesh, tables)
+        from pingoo_tpu.ops.match_ops import PatternTable
+
+        sharded_any = False
+        for key, val in tables.items():
+            if isinstance(val, PatternTable) and val.bytes.shape[0] % 4 == 0:
+                spec = specs[key]
+                arr = jax.device_put(val.bytes, spec.bytes)
+                if len(arr.sharding.device_set) == 4:
+                    sharded_any = True
+        assert sharded_any
+
+
+class TestRingScan:
+    @pytest.mark.parametrize("sp", [2, 4])
+    def test_ring_matches_plain_scan(self, devices, sp):
+        rng = random.Random(11)
+        sources = [r"abc", r"^/api", r"\.php$", r"(?i)select", r"a.c$",
+                   r"x{2,3}y", r"^GET /[a-z]+$", r"qq"]
+        patterns = []
+        for src in sources:
+            patterns.extend(compile_regex(src))
+        tables = bank_to_tables(build_bank(patterns))
+
+        L = 64
+        inputs = [b"/api/x.php", b"GET /abc", b"SELECT 1 union", b"xxy",
+                  b"abcabc\n", b"", b"a" * 63, b"axc"]
+        alphabet = b"abcqxy/GETselct."
+        for _ in range(24):
+            k = rng.randint(0, L)
+            inputs.append(bytes(rng.choice(alphabet) for _ in range(k)))
+        B = len(inputs)
+        data = np.zeros((B, L), dtype=np.uint8)
+        lens = np.zeros(B, dtype=np.int32)
+        for i, d in enumerate(inputs):
+            data[i, : len(d)] = np.frombuffer(d[:L], dtype=np.uint8)
+            lens[i] = min(len(d), L)
+
+        want = np.asarray(nfa_scan(tables, data, lens))
+
+        mesh = make_mesh(dp=2, tp=1, sp=sp)
+        data_s, lens_s = shard_batch_for_ring(mesh, data, lens)
+        got = np.asarray(ring_nfa_scan(mesh, tables, data_s, lens_s))
+        np.testing.assert_array_equal(got, want)
+
+    def test_ring_handles_cross_chunk_matches(self, devices):
+        """A pattern spanning a chunk boundary must still match."""
+        patterns = compile_regex("abcdefgh")
+        tables = bank_to_tables(build_bank(patterns))
+        L = 16  # sp=4 -> chunks of 4; "abcdefgh" spans two boundaries
+        data = np.zeros((4, L), dtype=np.uint8)
+        payload = b"xxabcdefghxx"
+        data[0, : len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+        data[1, :8] = np.frombuffer(b"abcdefgh", dtype=np.uint8)
+        lens = np.array([len(payload), 8, 0, 5], dtype=np.int32)
+        mesh = make_mesh(dp=2, tp=1, sp=4)
+        data_s, lens_s = shard_batch_for_ring(mesh, data, lens)
+        got = np.asarray(ring_nfa_scan(mesh, tables, data_s, lens_s))
+        assert got[0, 0] and got[1, 0]
+        assert not got[2, 0] and not got[3, 0]

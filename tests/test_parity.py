@@ -260,6 +260,58 @@ class TestDeviceParity:
                                 [RequestTuple(path="/quote")], lists=lists)
         assert plan.stats["host_rules"] == 1
 
+    def test_utf8_literal_canonicalization(self):
+        """Non-ASCII rule literals compare against UTF-8 wire bytes like
+        the Rust reference: "café" in a rule equals a path whose bytes are
+        the UTF-8 encoding of café."""
+        wire_path = "/café".encode("utf-8").decode("latin-1")
+        reqs = [RequestTuple(path=wire_path), RequestTuple(path="/cafe")]
+        plan, matched = assert_parity(
+            ['http_request.path == "/café"',
+             'http_request.path.contains("é")',
+             '"é".length() == 2'],  # Rust str::len semantics
+            reqs)
+        assert matched[0, 0] and matched[0, 1] and matched[0, 2]
+        assert not matched[1, 0]
+
+    def test_bad_hex_escape_rejected(self):
+        from pingoo_tpu.compiler.repat import Unsupported, compile_regex
+        from pingoo_tpu.expr import CompileError
+
+        for pat in (r"a\x-1", r"a\x+2", r"a\x 3"):
+            with pytest.raises(Unsupported):
+                compile_regex(pat)
+        with pytest.raises(CompileError):
+            compile_expression('http_request.path == "\\x-1"')
+
+    def test_failed_rule_leaves_rolled_back(self):
+        """A rule that half-lowers then falls back to host must not leave
+        its partial leaves in the device tables."""
+        ok = 'http_request.path.contains("safe")'
+        bad = 'http_request.url.contains("attack") && http_request.url + "x" == "y"'
+        plan_ok = compile_ruleset(make_rules([ok]), {})
+        plan_both = compile_ruleset(make_rules([ok, bad]), {})
+        assert plan_both.stats["host_rules"] == 1
+        assert plan_both.stats["leaves"] == plan_ok.stats["leaves"]
+
+    def test_first_action_vectorized_matches_reference_semantics(self):
+        rules = [
+            RuleConfig(name="no_action", expression=compile_expression("true"),
+                       actions=()),
+            RuleConfig(name="cap", expression=compile_expression(
+                'http_request.path == "/a"'), actions=(Action.CAPTCHA,)),
+            RuleConfig(name="blk", expression=compile_expression(
+                'http_request.path.starts_with("/")'), actions=(Action.BLOCK,)),
+        ]
+        plan = compile_ruleset(rules, {})
+        verdict_fn = make_verdict_fn(plan)
+        batch = encode_requests(
+            [RequestTuple(path="/a"), RequestTuple(path="/b"), RequestTuple(path="")])
+        matched = evaluate_batch(plan, verdict_fn, plan.device_tables(), batch, {})
+        acts = first_action(plan, matched)
+        # Action-less matching rule is skipped; first *acting* rule wins.
+        assert acts.tolist() == [2, 1, 0]
+
     def test_large_ip_list_buckets(self):
         rng = random.Random(47)
         entries = [Ip(f"{rng.randrange(1, 255)}.{rng.randrange(256)}."
