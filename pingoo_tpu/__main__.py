@@ -1,0 +1,66 @@
+"""CLI entrypoint: `python -m pingoo_tpu [--config PATH]`.
+
+Reference parity (pingoo/main.rs:33-85): logging init -> config load ->
+shutdown signal watch -> optional child process (sidecar mode,
+main.rs:60-80) -> server run. The reference takes no CLI flags and uses
+fixed /etc/pingoo paths; we accept overrides for testability but default
+to the same locations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import subprocess
+import sys
+
+from .config import DEFAULT_CONFIG_FILE, ConfigError, load_and_validate
+from .logging_utils import get_logger, init_logging
+
+log = get_logger("pingoo_tpu")
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(prog="pingoo-tpu")
+    parser.add_argument("--config", default=DEFAULT_CONFIG_FILE)
+    parser.add_argument("--no-device", action="store_true",
+                        help="CPU-interpreter rules engine only")
+    parser.add_argument("--no-docker", action="store_true")
+    args = parser.parse_args(argv)
+
+    init_logging()
+    try:
+        config = load_and_validate(args.config)
+    except ConfigError as exc:
+        log.error(str(exc))
+        return 1
+
+    child = None
+    if config.child_process is not None:
+        # Sidecar mode: run the fronted app as a child (main.rs:60-80).
+        child = subprocess.Popen(list(config.child_process.command))
+        log.info("child process started",
+                 extra={"fields": {"pid": child.pid}})
+
+    from .host.server import run
+
+    log.info("starting pingoo-tpu", extra={"fields": {
+        "config": args.config,
+        "listeners": [f"{l.protocol.value}://{l.host}:{l.port}"
+                      for l in config.listeners],
+        "rules": len(config.rules),
+        "device": not args.no_device,
+    }})
+    try:
+        asyncio.run(run(config, use_device=not args.no_device,
+                        enable_docker=not args.no_docker))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if child is not None:
+            child.terminate()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

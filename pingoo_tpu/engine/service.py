@@ -1,0 +1,254 @@
+"""VerdictService: adaptive batching between the host data plane and the
+TPU verdict engine.
+
+The reference evaluates rules inline per request (http_listener.rs:
+251-264). Here requests enqueue a RequestTuple and await a verdict; a
+collector loop drains the queue into fixed-size batches under a latency
+deadline (SURVEY.md §7 "Latency vs batching": adaptive window tuned
+against the 2ms p99 budget), encodes them (engine/batch.py), runs the
+jitted verdict, and resolves per-request futures with (matched_row,
+first_action, bot_score).
+
+Fail-open fallback (SURVEY.md §5 failure detection): if the device path
+raises, the batch is evaluated on the host interpreter instead — same
+verdicts (that is the parity contract), only slower — and the error is
+counted on the metrics surface.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from ..compiler.plan import RulesetPlan
+from ..config.schema import Action
+from ..expr import execute_as_bool
+from .batch import (
+    RequestBatch,
+    RequestTuple,
+    batch_to_contexts,
+    bucket_arrays,
+    encode_requests,
+    pad_batch,
+)
+from .verdict import evaluate_batch, first_action, make_verdict_fn
+
+
+def ensure_jax_backend() -> bool:
+    """Probe the jax backend, degrading axon/tpu failures to CPU.
+
+    The ambient environment may pin JAX_PLATFORMS to an accelerator
+    backend whose registration failed (e.g. a dropped tunnel); any jax
+    array op would then raise at an arbitrary point later. Returns True
+    if SOME backend works after probing (possibly CPU), False if jax is
+    unusable entirely.
+    """
+    try:
+        import jax
+
+        try:
+            jax.devices()
+            return True
+        except RuntimeError:
+            jax.config.update("jax_platforms", "cpu")
+            jax.devices()
+            return True
+    except Exception:
+        return False
+
+
+@dataclass
+class Verdict:
+    action: int  # 0 none, 1 block, 2 captcha
+    matched: np.ndarray  # [R] bool, original rule order
+    bot_score: float = 0.0
+
+    @property
+    def block(self) -> bool:
+        return self.action == 1
+
+    @property
+    def captcha(self) -> bool:
+        return self.action == 2
+
+
+@dataclass
+class ServiceStats:
+    batches: int = 0
+    requests: int = 0
+    device_errors: int = 0
+    host_fallback_batches: int = 0
+    batch_occupancy_sum: int = 0
+    verdict_ms: list = field(default_factory=list)
+
+    def snapshot(self) -> dict:
+        lat = np.array(self.verdict_ms[-4096:] or [0.0])
+        return {
+            "batches": self.batches,
+            "requests": self.requests,
+            "device_errors": self.device_errors,
+            "host_fallback_batches": self.host_fallback_batches,
+            "mean_occupancy": (self.batch_occupancy_sum / self.batches
+                               if self.batches else 0.0),
+            "verdict_p50_ms": float(np.percentile(lat, 50)),
+            "verdict_p99_ms": float(np.percentile(lat, 99)),
+        }
+
+
+class VerdictService:
+    """Async facade over the batched engine."""
+
+    def __init__(
+        self,
+        plan: RulesetPlan,
+        lists: dict,
+        max_batch: int = 1024,
+        max_wait_us: int = 300,
+        device: Optional[object] = None,
+        use_device: bool = True,
+    ):
+        self.plan = plan
+        self.lists = lists
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_us / 1e6
+        self.stats = ServiceStats()
+        self.use_device = use_device
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._task: Optional[asyncio.Task] = None
+        self._verdict_fn = None
+        self._tables = None
+        if use_device and ensure_jax_backend():
+            # Fail-open boot (SURVEY.md §5 failure detection): a broken
+            # accelerator backend degrades to the XLA CPU engine, and a
+            # broken XLA entirely to the interpreter — never crash the
+            # data plane.
+            try:
+                import jax
+
+                self._verdict_fn = make_verdict_fn(plan)
+                tables = plan.device_tables()
+                if device is not None:
+                    tables = jax.device_put(tables, device)
+                self._tables = tables
+            except Exception:
+                self.use_device = False
+        else:
+            self.use_device = False
+
+    async def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.create_task(self._collector())
+            # Warm the XLA program off the serving path so the first real
+            # request doesn't pay the compile.
+            if self.use_device:
+                loop = asyncio.get_running_loop()
+                await loop.run_in_executor(
+                    None, self._evaluate_sync, [RequestTuple()])
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def evaluate(self, req: RequestTuple) -> Verdict:
+        """Await the verdict for one request (the per-request hot call)."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((req, fut))
+        return await fut
+
+    # -- batching loop -------------------------------------------------------
+
+    async def _collector(self) -> None:
+        while True:
+            req, fut = await self._queue.get()
+            pending = [(req, fut)]
+            deadline = time.monotonic() + self.max_wait_s
+            while len(pending) < self.max_batch:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(self._queue.get(), timeout)
+                except asyncio.TimeoutError:
+                    break
+                pending.append(item)
+            try:
+                await self._run_batch(pending)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # The collector must never die: resolve this batch
+                # fail-open (no-match) and keep serving.
+                self.stats.device_errors += 1
+                R = len(self.plan.rules)
+                for _, fut in pending:
+                    if not fut.done():
+                        fut.set_result(Verdict(
+                            action=0, matched=np.zeros(R, dtype=bool)))
+
+    async def _run_batch(self, pending: list) -> None:
+        reqs = [r for r, _ in pending]
+        t0 = time.monotonic()
+        loop = asyncio.get_running_loop()
+        matched = await loop.run_in_executor(None, self._evaluate_sync, reqs)
+        dt_ms = (time.monotonic() - t0) * 1000
+        actions = first_action(self.plan, matched)
+        self.stats.batches += 1
+        self.stats.requests += len(reqs)
+        self.stats.batch_occupancy_sum += len(reqs)
+        self.stats.verdict_ms.append(dt_ms)
+        if len(self.stats.verdict_ms) > 65536:
+            del self.stats.verdict_ms[:32768]
+        for i, (_, fut) in enumerate(pending):
+            if not fut.done():
+                fut.set_result(
+                    Verdict(action=int(actions[i]), matched=matched[i]))
+
+    def _evaluate_sync(self, reqs: list[RequestTuple]) -> np.ndarray:
+        n = len(reqs)
+        batch = encode_requests(reqs, self.plan.field_specs)
+        if self.use_device:
+            try:
+                # Stabilize BOTH shape axes: bucket field lengths, and pad
+                # the batch axis to a power of two so arbitrary collector
+                # occupancies don't each compile a fresh XLA program.
+                arrays = bucket_arrays(batch.arrays)
+                target = 1
+                while target < n:
+                    target *= 2
+                target = min(max(target, 8), self.max_batch)
+                fast = pad_batch(
+                    RequestBatch(size=batch.size, arrays=arrays),
+                    max(target, n))
+                return evaluate_batch(
+                    self.plan, self._verdict_fn, self._tables, fast,
+                    self.lists)[:n]
+            except Exception:
+                self.stats.device_errors += 1
+        self.stats.host_fallback_batches += 1
+        return self._evaluate_host(batch)
+
+    def _evaluate_host(self, batch: RequestBatch) -> np.ndarray:
+        """Interpreter path: the CPU engine (also the watchdog fallback)."""
+        contexts = batch_to_contexts(batch, self.lists)
+        R = len(self.plan.rules)
+        out = np.zeros((batch.size, R), dtype=bool)
+        for rule in self.plan.rules:
+            if rule.always:
+                out[:, rule.index] = True
+                continue
+            prog = rule.program
+            for i, ctx in enumerate(contexts):
+                try:
+                    out[i, rule.index] = execute_as_bool(prog, ctx)
+                except Exception:
+                    out[i, rule.index] = False  # fail-open, always
+        return out

@@ -1,0 +1,38 @@
+"""Host data plane: listeners, services, discovery, TLS, captcha, geoip.
+
+Python asyncio implementation of the reference's Rust data plane
+(pingoo/listeners, services, service_discovery, tls, captcha.rs,
+geoip.rs); the C++ native plane (pingoo_tpu/native) carries the
+shared-memory ring and high-throughput listener.
+"""
+
+from .captcha import CaptchaManager, generate_captcha_client_id
+from .discovery import ServiceRegistry
+from .geoip import GeoipDB, GeoipRecord
+from .httpd import HttpListener, Request
+from .server import Server, run
+from .services import (
+    HttpProxyService,
+    StaticSiteService,
+    TcpProxyService,
+    build_http_services,
+)
+from .tlsmgr import TlsManager, generate_self_signed
+
+__all__ = [
+    "CaptchaManager",
+    "GeoipDB",
+    "GeoipRecord",
+    "HttpListener",
+    "HttpProxyService",
+    "Request",
+    "Server",
+    "ServiceRegistry",
+    "StaticSiteService",
+    "TcpProxyService",
+    "TlsManager",
+    "build_http_services",
+    "generate_self_signed",
+    "generate_captcha_client_id",
+    "run",
+]

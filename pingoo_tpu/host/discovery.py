@@ -1,0 +1,217 @@
+"""Service discovery: static config + DNS + Docker, with a 2s refresh loop.
+
+Reference parity (pingoo/service_discovery/):
+  * ServiceRegistry (service_registry.rs:22-103): static upstreams from
+    config merged with discovered ones; background loop every 2 s;
+    diff-and-swap so readers always see a consistent snapshot; a failing
+    discoverer keeps the last known state (:112-119).
+  * DNS discoverer (dns.rs): resolve non-ip upstream hostnames; the
+    reference's IPv6-loopback workaround (::1 -> 127.0.0.1, dns.rs:73-75)
+    is preserved.
+  * Docker discoverer (docker.rs + docker/ crate): containers labeled
+    `pingoo.service` (+ optional `pingoo.port`) via the Docker Engine API
+    over the unix socket, taking the bridge-network IP (docker.rs:56-156).
+    Implemented against the same REST endpoint (/containers/json) with a
+    minimal unix-socket HTTP client — the reference's whole `docker`
+    crate collapses into _docker_list_containers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import socket
+from typing import Iterable, Optional
+
+from ..config.schema import ServiceConfig, Upstream
+
+REFRESH_INTERVAL_S = 2.0
+DOCKER_SERVICE_LABEL = "pingoo.service"
+DOCKER_PORT_LABEL = "pingoo.port"
+
+
+class ServiceRegistry:
+    def __init__(
+        self,
+        services: Iterable[ServiceConfig],
+        docker_socket: str = "/var/run/docker.sock",
+        enable_docker: bool = True,
+        enable_dns: bool = True,
+    ):
+        self._static: dict[str, list[Upstream]] = {}
+        self._dns_targets: dict[str, list[Upstream]] = {}
+        for svc in services:
+            ups = list(svc.http_proxy or ()) + list(svc.tcp_proxy or ())
+            resolved = [u for u in ups if u.ip is not None]
+            pending = [u for u in ups if u.ip is None]
+            self._static[svc.name] = resolved
+            if pending:
+                self._dns_targets[svc.name] = pending
+        self._current: dict[str, list[Upstream]] = dict(self._static)
+        self.docker_socket = docker_socket
+        self.enable_docker = enable_docker
+        self.enable_dns = enable_dns
+        self._task: Optional[asyncio.Task] = None
+        self._dns_cache: dict[tuple, list[Upstream]] = {}
+
+    # -- reads (hot path) ----------------------------------------------------
+
+    def get_upstreams(self, service: str) -> list[Upstream]:
+        return self._current.get(service, [])
+
+    # -- background loop -----------------------------------------------------
+
+    async def start_in_background(self) -> None:
+        await self.discover()  # first resolution synchronously at boot
+        if self._task is None:
+            self._task = asyncio.create_task(self._loop())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    async def _loop(self) -> None:
+        while True:
+            await asyncio.sleep(REFRESH_INTERVAL_S)
+            try:
+                await self.discover()
+            except Exception:
+                pass  # keep last state (service_registry.rs:112-119)
+
+    async def discover(self) -> None:
+        dns_result, docker_result = await asyncio.gather(
+            self._discover_dns(), self._discover_docker(),
+            return_exceptions=True)
+        merged: dict[str, list[Upstream]] = {
+            name: list(ups) for name, ups in self._static.items()
+        }
+        if isinstance(dns_result, dict):
+            for name, ups in dns_result.items():
+                merged.setdefault(name, []).extend(ups)
+        if isinstance(docker_result, dict):
+            for name, ups in docker_result.items():
+                merged.setdefault(name, []).extend(ups)
+        # Atomic swap per service (diff_upstreams + Arc swap in reference).
+        self._current = merged
+
+    # -- DNS -----------------------------------------------------------------
+
+    async def _discover_dns(self) -> dict[str, list[Upstream]]:
+        if not self.enable_dns or not self._dns_targets:
+            return {}
+        loop = asyncio.get_running_loop()
+        out: dict[str, list[Upstream]] = {}
+        for service, targets in self._dns_targets.items():
+            ups: list[Upstream] = []
+            for target in targets:
+                cache_key = (target.hostname, target.port)
+                try:
+                    infos = await loop.getaddrinfo(
+                        target.hostname, target.port, type=socket.SOCK_STREAM)
+                except OSError:
+                    # Transient resolver failure: keep the last known
+                    # addresses for this hostname rather than dropping
+                    # the upstream (reference keeps last state on
+                    # discoverer failure, service_registry.rs:112-119).
+                    ups.extend(self._dns_cache.get(cache_key, []))
+                    continue
+                resolved = []
+                seen = set()
+                for _family, _type, _proto, _canon, sockaddr in infos:
+                    ip = sockaddr[0]
+                    if ip == "::1":
+                        ip = "127.0.0.1"  # dns.rs:73-75 workaround
+                    if ip in seen:
+                        continue
+                    seen.add(ip)
+                    resolved.append(Upstream(hostname=target.hostname,
+                                             port=target.port, tls=target.tls,
+                                             ip=ip))
+                self._dns_cache[cache_key] = resolved
+                ups.extend(resolved)
+            if ups:
+                out[service] = ups
+        return out
+
+    # -- Docker --------------------------------------------------------------
+
+    async def _discover_docker(self) -> dict[str, list[Upstream]]:
+        if not self.enable_docker:
+            return {}
+        try:
+            containers = await _docker_list_containers(self.docker_socket)
+        except OSError:
+            return {}
+        out: dict[str, list[Upstream]] = {}
+        for container in containers:
+            labels = container.get("Labels") or {}
+            service = labels.get(DOCKER_SERVICE_LABEL)
+            if not service:
+                continue
+            port = None
+            if DOCKER_PORT_LABEL in labels:
+                try:
+                    port = int(labels[DOCKER_PORT_LABEL])
+                except ValueError:
+                    continue
+            else:
+                ports = container.get("Ports") or []
+                private = [p.get("PrivatePort") for p in ports
+                           if p.get("PrivatePort")]
+                if len(private) == 1:
+                    port = private[0]
+            if port is None:
+                continue
+            networks = ((container.get("NetworkSettings") or {})
+                        .get("Networks") or {})
+            ip = None
+            for net in networks.values():
+                if net.get("IPAddress"):
+                    ip = net["IPAddress"]
+                    break
+            if not ip:
+                continue
+            out.setdefault(service, []).append(
+                Upstream(hostname=ip, port=port, tls=False, ip=ip))
+        return out
+
+
+async def _docker_list_containers(socket_path: str) -> list[dict]:
+    """GET /containers/json over the Docker unix socket
+    (reference docker/src/client.rs:41-145 + containers.rs:6-12)."""
+    reader, writer = await asyncio.open_unix_connection(socket_path)
+    try:
+        writer.write(
+            b"GET /v1.43/containers/json HTTP/1.1\r\n"
+            b"Host: docker\r\nConnection: close\r\n\r\n")
+        await writer.drain()
+        raw = await reader.read()
+    finally:
+        writer.close()
+    head, _, body = raw.partition(b"\r\n\r\n")
+    status_line = head.split(b"\r\n", 1)[0]
+    if b" 200 " not in status_line:
+        raise OSError(f"docker api: {status_line!r}")
+    if b"chunked" in head.lower():
+        body = _dechunk(body)
+    return json.loads(body.decode("utf-8"))
+
+
+def _dechunk(body: bytes) -> bytes:
+    out = bytearray()
+    while body:
+        size_line, _, rest = body.partition(b"\r\n")
+        try:
+            size = int(size_line.split(b";")[0], 16)
+        except ValueError:
+            break
+        if size == 0:
+            break
+        out += rest[:size]
+        body = rest[size + 2:]
+    return bytes(out)

@@ -1,0 +1,405 @@
+"""HTTP/HTTPS listener: the WAF hot path.
+
+Reference parity (pingoo/listeners/http_listener.rs:120-282, https_
+listener.rs:98-110 — the same function drives both, TLS handled by the
+wrapping transport):
+
+  per request: host/path extraction (:140-141, 284-296) -> geoip lookup
+  with not-found -> default record (:143-157) -> user-agent trim with
+  256-byte cap (:159-165) -> captcha client id (:167) -> cookie parse
+  (:169-181) -> empty/oversized UA -> 403 (:196-198) ->
+  /__pingoo/captcha* routing (:200-204) -> captcha-verified cookie check
+  where an INVALID cookie serves the challenge page immediately
+  (:222-236) -> rules loop with per-action semantics: Block -> 403,
+  Captcha -> challenge page unless verified; NOTE the loop continues
+  through subsequent matching rules (:251-264) -> service routing loop,
+  first match handles (:266-270) -> 404 (:272).
+
+The one architectural change (the point of this framework): the rules
+loop consumes a per-request row of the batched TPU verdict bitmap
+(engine/service.py) instead of tree-walking rules inline; action
+application order is identical because the engine returns the full
+per-rule match row (SURVEY.md §7 "Exact FP/FN parity").
+
+Adds a /__pingoo/metrics endpoint (req/s, verdict latency, batch
+occupancy) — the reference has no metrics surface (SURVEY.md §5) but the
+north-star metric requires one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import h11
+
+from ..config.schema import Action
+from ..engine.batch import RequestTuple
+from ..engine.service import VerdictService
+from ..expr import Context
+from .captcha import (
+    CAPTCHA_PATH_PREFIX,
+    CAPTCHA_VERIFIED_COOKIE,
+    CaptchaManager,
+    generate_captcha_client_id,
+)
+from .geoip import AddressNotFound, GeoipDB, GeoipRecord
+from .services import Response, match_route
+
+USER_AGENT_MAX_LENGTH = 256
+HOSTNAME_MAX_LENGTH = 256
+MAX_BODY_BYTES = 16 * 1024 * 1024
+GRACEFUL_SHUTDOWN_S = 20  # listeners/mod.rs:28
+
+
+@dataclass
+class Request:
+    method: str
+    target: str  # full request target (url)
+    path: str
+    headers: list[tuple[str, str]]
+    body: bytes = b""
+
+
+@dataclass
+class RequestContext:
+    """Reference http_listener.rs RequestContext (:183-194)."""
+
+    client_ip: str
+    client_port: int
+    asn: int = 0
+    country: str = "XX"
+    geoip_enabled: bool = False
+    tls: bool = False
+    host: str = ""
+
+
+@dataclass
+class ListenerStats:
+    requests: int = 0
+    blocked: int = 0
+    captcha_served: int = 0
+    started_at: float = field(default_factory=time.time)
+
+
+def blocked_response() -> Response:
+    return Response(403, [("content-type", "text/plain"),
+                          ("server", "pingoo")], b"Forbidden")
+
+
+def not_found_response() -> Response:
+    return Response(404, [("content-type", "text/plain"),
+                          ("server", "pingoo")], b"Not Found")
+
+
+def parse_cookies(headers: list[tuple[str, str]]) -> dict[str, str]:
+    out: dict[str, str] = {}
+    for name, value in headers:
+        if name.lower() != "cookie":
+            continue
+        for part in value.split(";"):
+            k, _, v = part.strip().partition("=")
+            if k:
+                out.setdefault(k, v)
+    return out
+
+
+def _strip_port(authority: str) -> str:
+    """Drop a trailing :port, IPv6-bracket aware: "[::1]:80" -> "[::1]"."""
+    authority = authority.strip()
+    if authority.startswith("["):
+        end = authority.find("]")
+        return authority[: end + 1] if end >= 0 else authority
+    return authority.rsplit(":", 1)[0] if ":" in authority else authority
+
+
+def get_host(req: Request) -> str:
+    """Host from the request target or Host header (:284-296)."""
+    if req.target.startswith("http://") or req.target.startswith("https://"):
+        rest = req.target.split("://", 1)[1]
+        return _strip_port(rest.split("/", 1)[0])[:HOSTNAME_MAX_LENGTH]
+    for name, value in req.headers:
+        if name.lower() == "host":
+            return _strip_port(value)[:HOSTNAME_MAX_LENGTH]
+    return ""
+
+
+def request_tuple_to_context(tup: RequestTuple, lists: dict) -> Context:
+    """Interpreter context for route matching — same variable shape as
+    the verdict engine's truncated view (engine/batch.py)."""
+    return Context({
+        "http_request": {
+            "host": tup.host, "url": tup.url, "path": tup.path,
+            "method": tup.method, "user_agent": tup.user_agent,
+        },
+        "client": {
+            "ip": _ip_value(tup.ip), "remote_port": tup.remote_port,
+            "asn": tup.asn, "country": tup.country,
+        },
+        "lists": lists,
+    })
+
+
+def _ip_value(text: str):
+    from ..expr import Ip
+
+    try:
+        return Ip(text)
+    except Exception:
+        return Ip("0.0.0.0")
+
+
+class HttpListener:
+    """One HTTP(S) listener bound to an address, serving h11 connections."""
+
+    def __init__(
+        self,
+        name: str,
+        host: str,
+        port: int,
+        services: list,  # (service, is proxy/static objects with .route)
+        verdict: VerdictService,
+        lists: dict,
+        rules_meta: list,  # plan.rules (names/actions/order)
+        captcha: CaptchaManager,
+        geoip: Optional[GeoipDB] = None,
+        tls_context=None,
+        acme_challenges: Optional[dict] = None,
+    ):
+        self.name = name
+        self.host = host
+        self.port = port
+        self.services = services
+        self.verdict = verdict
+        self.lists = lists
+        self.rules_meta = rules_meta
+        self.captcha = captcha
+        self.geoip = geoip
+        self.tls_context = tls_context
+        self.acme_challenges = acme_challenges
+        self.stats = ListenerStats()
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def bind(self) -> None:
+        self._server = await asyncio.start_server(
+            self._serve_connection, self.host, self.port,
+            ssl=self.tls_context, reuse_address=True, backlog=2048)
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None
+        async with self._server:
+            await self._server.serve_forever()
+
+    async def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # -- connection loop -----------------------------------------------------
+
+    async def _serve_connection(self, reader: asyncio.StreamReader,
+                                writer: asyncio.StreamWriter) -> None:
+        conn = h11.Connection(h11.SERVER)
+        peer = writer.get_extra_info("peername") or ("0.0.0.0", 0)
+        try:
+            while True:
+                event = await self._next_event(conn, reader)
+                if event is h11.PAUSED or isinstance(
+                        event, (h11.ConnectionClosed, type(None))):
+                    break
+                if isinstance(event, h11.Request):
+                    request = await self._read_request(conn, reader, event)
+                    response = await self.handle_request(request, peer)
+                    await self._send_response(conn, writer, request, response)
+                    if conn.our_state is h11.MUST_CLOSE:
+                        break
+                    conn.start_next_cycle()
+        except (h11.RemoteProtocolError, OSError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except OSError:
+                pass
+
+    async def _next_event(self, conn, reader):
+        while True:
+            event = conn.next_event()
+            if event is h11.NEED_DATA:
+                data = await reader.read(65536)
+                conn.receive_data(data)
+                if data == b"" and conn.their_state is h11.IDLE:
+                    return None
+                continue
+            return event
+
+    async def _read_request(self, conn, reader, event: h11.Request) -> Request:
+        body = bytearray()
+        while True:
+            ev = await self._next_event(conn, reader)
+            if isinstance(ev, h11.Data):
+                body += ev.data
+                if len(body) > MAX_BODY_BYTES:
+                    raise h11.RemoteProtocolError("body too large")
+            elif isinstance(ev, h11.EndOfMessage) or ev is None:
+                break
+        target = event.target.decode("latin-1")
+        path = target.split("?", 1)[0]
+        headers = [(n.decode("latin-1"), v.decode("latin-1"))
+                   for n, v in event.headers]
+        return Request(method=event.method.decode("ascii"), target=target,
+                       path=path, headers=headers, body=bytes(body))
+
+    async def _send_response(self, conn, writer, request: Request,
+                             response: Response) -> None:
+        headers = [(k, v) for k, v in response.headers]
+        if response.stream_path is not None and request.method != "HEAD":
+            # Large static files stream in chunks — never slurped
+            # (http_static_site_service.rs:238-256 ReaderStream parity).
+            size = os.path.getsize(response.stream_path)
+            headers.append(("content-length", str(size)))
+            writer.write(conn.send(h11.Response(
+                status_code=response.status,
+                headers=[(k.encode(), v.encode()) for k, v in headers])))
+            with open(response.stream_path, "rb") as f:
+                while True:
+                    chunk = f.read(65536)
+                    if not chunk:
+                        break
+                    writer.write(conn.send(h11.Data(data=chunk)))
+                    await writer.drain()
+            writer.write(conn.send(h11.EndOfMessage()))
+            await writer.drain()
+            return
+        if response.stream_path is not None:  # HEAD on a streamed file
+            body = b""
+            headers.append(
+                ("content-length", str(os.path.getsize(response.stream_path))))
+        else:
+            body = b"" if request.method == "HEAD" else response.body
+            headers.append(("content-length", str(len(response.body))))
+        writer.write(conn.send(h11.Response(
+            status_code=response.status,
+            headers=[(k.encode(), v.encode()) for k, v in headers])))
+        if body:
+            writer.write(conn.send(h11.Data(data=body)))
+        writer.write(conn.send(h11.EndOfMessage()))
+        await writer.drain()
+
+    # -- the hot path --------------------------------------------------------
+
+    async def handle_request(self, req: Request, peer) -> Response:
+        self.stats.requests += 1
+        client_ip, client_port = str(peer[0]), int(peer[1])
+        host = get_host(req)
+
+        geoip_record = GeoipRecord()
+        if self.geoip is not None:
+            try:
+                geoip_record = self.geoip.lookup(client_ip)
+            except (AddressNotFound, ValueError):
+                pass
+
+        user_agent = ""
+        for name, value in req.headers:
+            if name.lower() == "user-agent":
+                user_agent = value.strip()
+                break
+        if len(user_agent) >= USER_AGENT_MAX_LENGTH:
+            user_agent = ""  # heapless from_str overflow -> default empty
+
+        client_id = generate_captcha_client_id(client_ip, user_agent, host)
+        cookies = parse_cookies(req.headers)
+
+        request_ctx = RequestContext(
+            client_ip=client_ip, client_port=client_port,
+            asn=geoip_record.asn, country=geoip_record.country,
+            geoip_enabled=self.geoip is not None,
+            tls=self.tls_context is not None, host=host)
+
+        # Empty/oversized UA -> 403 (:196-198).
+        if not user_agent:
+            self.stats.blocked += 1
+            return blocked_response()
+
+        # ACME http-01 (host/acme.py; the reference answers challenges at
+        # TLS-accept time instead, listeners/mod.rs:130-141).
+        if self.acme_challenges is not None and req.path.startswith(
+                "/.well-known/acme-challenge/"):
+            token = req.path.rsplit("/", 1)[-1]
+            keyauth = self.acme_challenges.get(token)
+            if keyauth:
+                return Response(200, [("content-type", "text/plain")],
+                                keyauth.encode())
+            return not_found_response()
+
+        if req.path.startswith(CAPTCHA_PATH_PREFIX):
+            status, headers, body = self.captcha.serve(
+                req.method, req.path, req.body, cookies, client_id)
+            return Response(status, headers, body)
+
+        if req.path == "/__pingoo/metrics":
+            return self._metrics_response()
+
+        # Captcha-verified cookie: invalid -> challenge page (:222-236).
+        captcha_verified = False
+        verified_cookie = cookies.get(CAPTCHA_VERIFIED_COOKIE)
+        if verified_cookie is not None:
+            if self.captcha.is_verified(verified_cookie, client_id):
+                captcha_verified = True
+            else:
+                return self._serve_captcha()
+
+        tup = RequestTuple(
+            host=host, url=req.target, path=req.path, method=req.method,
+            user_agent=user_agent, ip=client_ip, remote_port=client_port,
+            asn=geoip_record.asn, country=geoip_record.country)
+
+        # RULES LOOP (:251-264) over the batched verdict row.
+        verdict = await self.verdict.evaluate(tup)
+        for rule in self.rules_meta:
+            if not verdict.matched[rule.index]:
+                continue
+            for action in rule.actions:
+                if action == Action.BLOCK:
+                    self.stats.blocked += 1
+                    return blocked_response()
+                if action == Action.CAPTCHA and not captcha_verified:
+                    return self._serve_captcha()
+
+        # ROUTING LOOP (:266-270).
+        route_ctx = request_tuple_to_context(tup, self.lists)
+        for service in self.services:
+            if match_route(service.route, route_ctx):
+                return await service.handle(req, request_ctx)
+        return not_found_response()
+
+    def _serve_captcha(self) -> Response:
+        from .captcha import CAPTCHA_PAGE
+
+        self.stats.captcha_served += 1
+        return Response(403, [("content-type", "text/html; charset=utf-8"),
+                              ("server", "pingoo")], CAPTCHA_PAGE.encode())
+
+    def _metrics_response(self) -> Response:
+        uptime = time.time() - self.stats.started_at
+        payload = {
+            "listener": self.name,
+            "uptime_s": round(uptime, 1),
+            "requests": self.stats.requests,
+            "blocked": self.stats.blocked,
+            "captcha_served": self.stats.captcha_served,
+            "req_per_s": round(self.stats.requests / uptime, 2) if uptime else 0,
+            "verdict": self.verdict.stats.snapshot(),
+        }
+        return Response(200, [("content-type", "application/json")],
+                        json.dumps(payload).encode())

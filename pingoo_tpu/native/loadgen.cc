@@ -1,0 +1,135 @@
+// Native load generator / data-plane stand-in for the verdict ring.
+//
+// Role of pong/pong.rs in the reference ("a Simple HTTP server to test
+// Pingoo's capabilities") but for the ring transport: produce synthetic
+// request tuples at full speed, await verdicts, report throughput +
+// latency. This is the C++ side of the host<->sidecar seam until the
+// native listener lands; it doubles as the transport benchmark.
+//
+// Usage: loadgen <ring-file> <num-requests> [attack_permille]
+// Writes one JSON line with results to stdout; exits nonzero on error.
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "pingoo_ring.h"
+
+namespace {
+
+struct Sample {
+  const char* method;
+  const char* path;
+  const char* url;
+  const char* ua;
+  bool attack;
+};
+
+const Sample kClean[] = {
+    {"GET", "/", "/", "Mozilla/5.0 (X11; Linux x86_64)", false},
+    {"GET", "/index.html", "/index.html?utm=1", "Mozilla/5.0 (Macintosh)",
+     false},
+    {"GET", "/api/v1/users", "/api/v1/users?page=2", "Mozilla/5.0 (iPhone)",
+     false},
+    {"POST", "/api/v1/orders", "/api/v1/orders", "Mozilla/5.0 (Windows NT)",
+     false},
+};
+const Sample kAttack[] = {
+    {"GET", "/.env", "/.env", "Mozilla/5.0 (X11)", true},
+    {"GET", "/search", "/search?q=1%27%20UNION%20SELECT%20pass", "sqlmap/1.8",
+     true},
+    {"GET", "/dl", "/dl?f=../../../etc/passwd", "Mozilla/5.0", true},
+};
+
+uint64_t splitmix(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ull);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ull;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebull;
+  return z ^ (z >> 31);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 3) {
+    std::fprintf(stderr, "usage: %s <ring-file> <num-requests> [permille]\n",
+                 argv[0]);
+    return 2;
+  }
+  const char* ring_path = argv[1];
+  long total = std::strtol(argv[2], nullptr, 10);
+  long attack_permille = argc > 3 ? std::strtol(argv[3], nullptr, 10) : 50;
+
+  int fd = open(ring_path, O_RDWR);
+  if (fd < 0) {
+    std::perror("open ring");
+    return 1;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    std::perror("fstat");
+    return 1;
+  }
+  void* mem =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  if (mem == MAP_FAILED) {
+    std::perror("mmap");
+    return 1;
+  }
+  uint32_t capacity = 0;
+  if (pingoo_ring_attach(mem, &capacity) != 0) {
+    std::fprintf(stderr, "ring attach failed\n");
+    return 1;
+  }
+
+  uint64_t rng = 0x1234;
+  long sent = 0, received = 0, blocked = 0, captcha = 0;
+  auto t0 = std::chrono::steady_clock::now();
+
+  std::vector<uint64_t> outstanding;
+  outstanding.reserve(1024);
+  while (received < total) {
+    // Fill the ring as far as possible.
+    while (sent < total) {
+      bool attack = (splitmix(&rng) % 1000) < (uint64_t)attack_permille;
+      const Sample& s = attack ? kAttack[splitmix(&rng) % 3]
+                               : kClean[splitmix(&rng) % 4];
+      uint8_t ip[16] = {0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0xff, 0xff, 0, 0, 0, 0};
+      uint32_t v4 = static_cast<uint32_t>(splitmix(&rng));
+      std::memcpy(ip + 12, &v4, 4);
+      char country[2] = {'U', 'S'};
+      uint64_t ticket = pingoo_ring_enqueue_request(
+          mem, s.method, std::strlen(s.method), "bench.local", 11, s.path,
+          std::strlen(s.path), s.url, std::strlen(s.url), s.ua,
+          std::strlen(s.ua), ip, 40000, 15169, country);
+      if (ticket == UINT64_MAX) break;  // ring full
+      ++sent;
+    }
+    // Drain verdicts.
+    uint64_t ticket;
+    uint8_t action;
+    float score;
+    while (pingoo_ring_poll_verdict(mem, &ticket, &action, &score) == 0) {
+      ++received;
+      if (action == 1) ++blocked;
+      if (action == 2) ++captcha;
+    }
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  double secs = std::chrono::duration<double>(t1 - t0).count();
+  std::printf(
+      "{\"sent\": %ld, \"received\": %ld, \"blocked\": %ld, "
+      "\"captcha\": %ld, \"seconds\": %.3f, \"req_per_s\": %.0f}\n",
+      sent, received, blocked, captcha, secs, received / secs);
+  munmap(mem, st.st_size);
+  close(fd);
+  return 0;
+}

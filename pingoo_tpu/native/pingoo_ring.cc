@@ -1,0 +1,203 @@
+// Vyukov bounded MPMC rings over a shared mapping. See pingoo_ring.h.
+
+#include "pingoo_ring.h"
+
+#include <atomic>
+#include <cstring>
+
+namespace {
+
+inline std::atomic<uint64_t>* as_atomic(uint64_t* p) {
+  return reinterpret_cast<std::atomic<uint64_t>*>(p);
+}
+
+struct Layout {
+  PingooRingHeader* header;
+  PingooRequestSlot* req;
+  PingooVerdictSlot* ver;
+};
+
+Layout layout(void* mem, uint32_t capacity) {
+  Layout l;
+  l.header = static_cast<PingooRingHeader*>(mem);
+  l.req = reinterpret_cast<PingooRequestSlot*>(
+      static_cast<char*>(mem) + sizeof(PingooRingHeader));
+  l.ver = reinterpret_cast<PingooVerdictSlot*>(
+      reinterpret_cast<char*>(l.req) + sizeof(PingooRequestSlot) * capacity);
+  return l;
+}
+
+inline void copy_capped(char* dst, uint32_t cap, const char* src, uint32_t len,
+                        uint16_t* len_out) {
+  uint32_t n = len < cap ? len : cap;
+  std::memcpy(dst, src, n);
+  if (n < cap) std::memset(dst + n, 0, cap - n);
+  *len_out = static_cast<uint16_t>(n);
+}
+
+}  // namespace
+
+extern "C" {
+
+size_t pingoo_ring_bytes(uint32_t capacity) {
+  return sizeof(PingooRingHeader) +
+         capacity * (sizeof(PingooRequestSlot) + sizeof(PingooVerdictSlot));
+}
+
+void pingoo_ring_init(void* mem, uint32_t capacity) {
+  std::memset(mem, 0, pingoo_ring_bytes(capacity));
+  Layout l = layout(mem, capacity);
+  l.header->magic = PINGOO_RING_MAGIC;
+  l.header->version = PINGOO_RING_VERSION;
+  l.header->capacity = capacity;
+  l.header->request_slot_size = sizeof(PingooRequestSlot);
+  l.header->verdict_slot_size = sizeof(PingooVerdictSlot);
+  for (uint32_t i = 0; i < capacity; ++i) {
+    as_atomic(&l.req[i].seq)->store(i, std::memory_order_relaxed);
+    as_atomic(&l.ver[i].seq)->store(i, std::memory_order_relaxed);
+  }
+  std::atomic_thread_fence(std::memory_order_seq_cst);
+}
+
+int pingoo_ring_attach(void* mem, uint32_t* capacity_out) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  if (header->magic != PINGOO_RING_MAGIC ||
+      header->version != PINGOO_RING_VERSION ||
+      header->request_slot_size != sizeof(PingooRequestSlot) ||
+      header->verdict_slot_size != sizeof(PingooVerdictSlot)) {
+    return -1;
+  }
+  if (capacity_out) *capacity_out = header->capacity;
+  return 0;
+}
+
+uint64_t pingoo_ring_enqueue_request(
+    void* mem, const char* method, uint32_t method_len, const char* host,
+    uint32_t host_len, const char* path, uint32_t path_len, const char* url,
+    uint32_t url_len, const char* ua, uint32_t ua_len, const uint8_t ip[16],
+    uint16_t remote_port, uint32_t asn, const char country[2]) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  uint32_t cap = header->capacity;
+  Layout l = layout(mem, cap);
+  auto* head = as_atomic(&header->req_head);
+
+  uint64_t pos = head->load(std::memory_order_relaxed);
+  for (;;) {
+    PingooRequestSlot* slot = &l.req[pos & (cap - 1)];
+    uint64_t seq = as_atomic(&slot->seq)->load(std::memory_order_acquire);
+    intptr_t diff = static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos);
+    if (diff == 0) {
+      if (head->compare_exchange_weak(pos, pos + 1,
+                                      std::memory_order_relaxed)) {
+        slot->ticket = pos;
+        copy_capped(slot->method, PINGOO_METHOD_CAP, method, method_len,
+                    &slot->method_len);
+        copy_capped(slot->host, PINGOO_HOST_CAP, host, host_len,
+                    &slot->host_len);
+        copy_capped(slot->path, PINGOO_PATH_CAP, path, path_len,
+                    &slot->path_len);
+        copy_capped(slot->url, PINGOO_URL_CAP, url, url_len, &slot->url_len);
+        copy_capped(slot->user_agent, PINGOO_UA_CAP, ua, ua_len,
+                    &slot->ua_len);
+        std::memcpy(slot->ip, ip, 16);
+        slot->remote_port = remote_port;
+        slot->asn = asn;
+        slot->country[0] = country[0];
+        slot->country[1] = country[1];
+        as_atomic(&slot->seq)->store(pos + 1, std::memory_order_release);
+        return pos;
+      }
+    } else if (diff < 0) {
+      return UINT64_MAX;  // full
+    } else {
+      pos = head->load(std::memory_order_relaxed);
+    }
+  }
+}
+
+uint32_t pingoo_ring_dequeue_requests(void* mem, PingooRequestSlot* out,
+                                      uint32_t max) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  uint32_t cap = header->capacity;
+  Layout l = layout(mem, cap);
+  auto* tail = as_atomic(&header->req_tail);
+
+  uint32_t count = 0;
+  while (count < max) {
+    uint64_t pos = tail->load(std::memory_order_relaxed);
+    PingooRequestSlot* slot = &l.req[pos & (cap - 1)];
+    uint64_t seq = as_atomic(&slot->seq)->load(std::memory_order_acquire);
+    intptr_t diff =
+        static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos + 1);
+    if (diff == 0) {
+      if (tail->compare_exchange_weak(pos, pos + 1,
+                                      std::memory_order_relaxed)) {
+        std::memcpy(&out[count], slot, sizeof(PingooRequestSlot));
+        as_atomic(&slot->seq)->store(pos + cap, std::memory_order_release);
+        ++count;
+      }
+    } else {
+      break;  // empty
+    }
+  }
+  return count;
+}
+
+int pingoo_ring_post_verdict(void* mem, uint64_t ticket, uint8_t action,
+                             float bot_score) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  uint32_t cap = header->capacity;
+  Layout l = layout(mem, cap);
+  auto* head = as_atomic(&header->ver_head);
+
+  uint64_t pos = head->load(std::memory_order_relaxed);
+  for (;;) {
+    PingooVerdictSlot* slot = &l.ver[pos & (cap - 1)];
+    uint64_t seq = as_atomic(&slot->seq)->load(std::memory_order_acquire);
+    intptr_t diff = static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos);
+    if (diff == 0) {
+      if (head->compare_exchange_weak(pos, pos + 1,
+                                      std::memory_order_relaxed)) {
+        slot->ticket = ticket;
+        slot->action = action;
+        slot->bot_score = bot_score;
+        as_atomic(&slot->seq)->store(pos + 1, std::memory_order_release);
+        return 0;
+      }
+    } else if (diff < 0) {
+      return -1;  // full
+    } else {
+      pos = head->load(std::memory_order_relaxed);
+    }
+  }
+}
+
+int pingoo_ring_poll_verdict(void* mem, uint64_t* ticket_out,
+                             uint8_t* action_out, float* score_out) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  uint32_t cap = header->capacity;
+  Layout l = layout(mem, cap);
+  auto* tail = as_atomic(&header->ver_tail);
+
+  for (;;) {
+    uint64_t pos = tail->load(std::memory_order_relaxed);
+    PingooVerdictSlot* slot = &l.ver[pos & (cap - 1)];
+    uint64_t seq = as_atomic(&slot->seq)->load(std::memory_order_acquire);
+    intptr_t diff =
+        static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos + 1);
+    if (diff == 0) {
+      if (tail->compare_exchange_weak(pos, pos + 1,
+                                      std::memory_order_relaxed)) {
+        *ticket_out = slot->ticket;
+        *action_out = slot->action;
+        *score_out = slot->bot_score;
+        as_atomic(&slot->seq)->store(pos + cap, std::memory_order_release);
+        return 0;
+      }
+    } else {
+      return -1;  // empty
+    }
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,115 @@
+// Shared-memory verdict ring: the host-data-plane <-> TPU-sidecar
+// transport (SURVEY.md §7 architecture split item 4: "lock-free
+// shared-memory ring (fixed-size slots mirroring RequestData/ClientData,
+// pingoo/rules.rs:17-34) ... batching window tuned against the 2ms p99
+// budget; verdict bitmap return").
+//
+// Layout: one file mapping = [RingHeader][request slots][verdict slots].
+// Both rings are Vyukov bounded MPMC queues (per-slot sequence numbers),
+// so any number of data-plane threads can enqueue requests while the
+// sidecar drains batches, and verdicts flow back keyed by ticket id.
+//
+// The slot field layout mirrors pingoo_tpu/engine/batch.py field specs
+// (method 16 / host 128 / path 256 / url 512 / user_agent 256 bytes,
+// v6-mapped ip words, asn/port columns) so the Python side can decode a
+// whole batch with one numpy structured view, no per-field parsing.
+
+#ifndef PINGOO_RING_H_
+#define PINGOO_RING_H_
+
+#include <stdint.h>
+#include <stddef.h>
+
+#ifdef __cplusplus
+#define PINGOO_ALIGN8 alignas(8)
+#define PINGOO_ALIGN64 alignas(64)
+#else
+#define PINGOO_ALIGN8 _Alignas(8)
+#define PINGOO_ALIGN64 _Alignas(64)
+#endif
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#define PINGOO_RING_MAGIC 0x50474f52u  // "PGOR"
+#define PINGOO_RING_VERSION 1u
+
+#define PINGOO_METHOD_CAP 16
+#define PINGOO_HOST_CAP 128
+#define PINGOO_PATH_CAP 256
+#define PINGOO_URL_CAP 512
+#define PINGOO_UA_CAP 256
+
+typedef struct {
+  // Vyukov slot sequence: slot is writable when seq == pos, readable
+  // when seq == pos + 1.
+  PINGOO_ALIGN8 uint64_t seq;
+  uint64_t ticket;  // request id chosen by the producer
+  uint16_t method_len, host_len, path_len, url_len, ua_len;
+  uint16_t remote_port;
+  uint8_t ip[16];  // big-endian, v4 addresses v6-mapped (::ffff:a.b.c.d)
+  uint32_t asn;
+  char country[2];
+  char _pad[2];
+  char method[PINGOO_METHOD_CAP];
+  char host[PINGOO_HOST_CAP];
+  char path[PINGOO_PATH_CAP];
+  char url[PINGOO_URL_CAP];
+  char user_agent[PINGOO_UA_CAP];
+} PingooRequestSlot;
+
+typedef struct {
+  PINGOO_ALIGN8 uint64_t seq;
+  uint64_t ticket;
+  uint8_t action;  // 0 none, 1 block, 2 captcha
+  uint8_t _pad[3];
+  float bot_score;
+} PingooVerdictSlot;
+
+typedef struct {
+  uint32_t magic;
+  uint32_t version;
+  uint32_t capacity;  // power of two, same for both rings
+  uint32_t request_slot_size;
+  uint32_t verdict_slot_size;
+  uint32_t _pad;
+  PINGOO_ALIGN64 uint64_t req_head;  // producer ticket counter
+  PINGOO_ALIGN64 uint64_t req_tail;  // consumer counter
+  PINGOO_ALIGN64 uint64_t ver_head;
+  PINGOO_ALIGN64 uint64_t ver_tail;
+} PingooRingHeader;
+
+// Size of the full mapping for a given capacity.
+size_t pingoo_ring_bytes(uint32_t capacity);
+
+// Initialize a fresh ring inside `mem` (caller maps the file/shm).
+void pingoo_ring_init(void* mem, uint32_t capacity);
+
+// Validate an existing mapping; returns 0 on success.
+int pingoo_ring_attach(void* mem, uint32_t* capacity_out);
+
+// Enqueue one request; returns the ticket id, or UINT64_MAX if full.
+uint64_t pingoo_ring_enqueue_request(
+    void* mem, const char* method, uint32_t method_len, const char* host,
+    uint32_t host_len, const char* path, uint32_t path_len, const char* url,
+    uint32_t url_len, const char* ua, uint32_t ua_len, const uint8_t ip[16],
+    uint16_t remote_port, uint32_t asn, const char country[2]);
+
+// Dequeue up to `max` requests into `out`; returns the count.
+uint32_t pingoo_ring_dequeue_requests(void* mem, PingooRequestSlot* out,
+                                      uint32_t max);
+
+// Post a verdict; returns 0 on success, -1 if the verdict ring is full.
+int pingoo_ring_post_verdict(void* mem, uint64_t ticket, uint8_t action,
+                             float bot_score);
+
+// Poll one verdict; returns 0 on success, -1 if empty.
+int pingoo_ring_poll_verdict(void* mem, uint64_t* ticket_out,
+                             uint8_t* action_out, float* score_out);
+
+#ifdef __cplusplus
+}  // extern "C"
+#endif
+
+#endif  // PINGOO_RING_H_

@@ -1,0 +1,238 @@
+"""Python binding for the native shared-memory verdict ring.
+
+The C++ side (pingoo_tpu/native/pingoo_ring.{h,cc}) owns the queue
+algebra; this module maps the ring file, exposes enqueue/dequeue via
+ctypes, and — the part that matters for throughput — decodes a whole
+dequeued batch into engine arrays with one numpy structured view (the
+slot layout mirrors engine/batch.py field specs by construction).
+
+`RingSidecar` is the TPU-side drain loop: dequeue a batch, run the
+jitted verdict, post (ticket, action, bot_score) back. Together with
+native/loadgen.cc this is the host<->device transport of SURVEY.md §7
+item 4 running end-to-end.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import os
+import subprocess
+import time
+from typing import Optional
+
+import numpy as np
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+LIB_PATH = os.path.join(NATIVE_DIR, "libpingoo_ring.so")
+
+FIELD_CAPS = {"method": 16, "host": 128, "path": 256, "url": 512,
+              "user_agent": 256}
+
+# numpy mirror of PingooRequestSlot (natural alignment, no padding holes
+# beyond the explicit _pad).
+REQUEST_SLOT_DTYPE = np.dtype([
+    ("seq", "<u8"),
+    ("ticket", "<u8"),
+    ("method_len", "<u2"), ("host_len", "<u2"), ("path_len", "<u2"),
+    ("url_len", "<u2"), ("ua_len", "<u2"),
+    ("remote_port", "<u2"),
+    ("ip", "u1", 16),
+    ("asn", "<u4"),
+    ("country", "S2"),
+    ("_pad", "S2"),
+    ("method", "u1", 16),
+    ("host", "u1", 128),
+    ("path", "u1", 256),
+    ("url", "u1", 512),
+    ("user_agent", "u1", 256),
+    ("_tail_pad", "S4"),  # C struct pads to 8-byte alignment (1224 bytes)
+])
+assert REQUEST_SLOT_DTYPE.itemsize == 1224, REQUEST_SLOT_DTYPE.itemsize
+
+
+def ensure_built() -> bool:
+    """Build the native library if missing; False if no toolchain."""
+    if os.path.exists(LIB_PATH):
+        return True
+    try:
+        subprocess.run(["make", "-C", NATIVE_DIR], check=True,
+                       capture_output=True)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        return False
+
+
+def _load_lib():
+    lib = ctypes.CDLL(LIB_PATH)
+    lib.pingoo_ring_bytes.restype = ctypes.c_size_t
+    lib.pingoo_ring_bytes.argtypes = [ctypes.c_uint32]
+    lib.pingoo_ring_init.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.pingoo_ring_attach.argtypes = [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_uint32)]
+    lib.pingoo_ring_attach.restype = ctypes.c_int
+    lib.pingoo_ring_enqueue_request.restype = ctypes.c_uint64
+    lib.pingoo_ring_enqueue_request.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_char_p, ctypes.c_uint32,  # method
+        ctypes.c_char_p, ctypes.c_uint32,  # host
+        ctypes.c_char_p, ctypes.c_uint32,  # path
+        ctypes.c_char_p, ctypes.c_uint32,  # url
+        ctypes.c_char_p, ctypes.c_uint32,  # ua
+        ctypes.c_char_p,                   # ip[16]
+        ctypes.c_uint16, ctypes.c_uint32, ctypes.c_char_p,
+    ]
+    lib.pingoo_ring_dequeue_requests.restype = ctypes.c_uint32
+    lib.pingoo_ring_dequeue_requests.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32]
+    lib.pingoo_ring_post_verdict.restype = ctypes.c_int
+    lib.pingoo_ring_post_verdict.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint8, ctypes.c_float]
+    lib.pingoo_ring_poll_verdict.restype = ctypes.c_int
+    lib.pingoo_ring_poll_verdict.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float)]
+    return lib
+
+
+class Ring:
+    """A mapped ring file."""
+
+    def __init__(self, path: str, capacity: int = 4096, create: bool = False):
+        if not ensure_built():
+            raise RuntimeError("native ring library unavailable (no g++?)")
+        if capacity & (capacity - 1) or capacity <= 0:
+            # The C ring masks with `pos & (cap - 1)`; a non-pow2
+            # capacity would silently alias slots and corrupt the queue.
+            raise ValueError(f"ring capacity must be a power of two, got {capacity}")
+        self.lib = _load_lib()
+        self.capacity = capacity
+        nbytes = self.lib.pingoo_ring_bytes(capacity)
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        self.fd = os.open(path, flags, 0o600)
+        if create:
+            os.ftruncate(self.fd, nbytes)
+        self.map = mmap.mmap(self.fd, nbytes)
+        self.addr = ctypes.addressof(
+            (ctypes.c_char * nbytes).from_buffer(self.map))
+        if create:
+            self.lib.pingoo_ring_init(self.addr, capacity)
+        cap_out = ctypes.c_uint32()
+        if self.lib.pingoo_ring_attach(self.addr, ctypes.byref(cap_out)) != 0:
+            raise RuntimeError("ring attach failed (layout mismatch?)")
+        self.capacity = int(cap_out.value)
+        self._scratch = np.zeros(self.capacity, dtype=REQUEST_SLOT_DTYPE)
+
+    def close(self) -> None:
+        self._scratch = None
+        self.map.close()
+        os.close(self.fd)
+
+    # -- producer side (tests / python data plane) ---------------------------
+
+    def enqueue(self, method=b"GET", host=b"", path=b"/", url=b"/",
+                user_agent=b"", ip: bytes = b"\x00" * 16, port: int = 0,
+                asn: int = 0, country: bytes = b"XX") -> Optional[int]:
+        ticket = self.lib.pingoo_ring_enqueue_request(
+            self.addr, method, len(method), host, len(host), path, len(path),
+            url, len(url), user_agent, len(user_agent), ip, port, asn,
+            country)
+        return None if ticket == 2**64 - 1 else int(ticket)
+
+    # -- consumer side (sidecar) ---------------------------------------------
+
+    def dequeue_batch(self, max_batch: int = 1024) -> np.ndarray:
+        """-> structured array view of up to max_batch request slots."""
+        n = self.lib.pingoo_ring_dequeue_requests(
+            self.addr, self._scratch.ctypes.data_as(ctypes.c_void_p),
+            min(max_batch, self.capacity))
+        return self._scratch[:n].copy()
+
+    def post_verdict(self, ticket: int, action: int, score: float = 0.0) -> bool:
+        return self.lib.pingoo_ring_post_verdict(
+            self.addr, ticket, action, score) == 0
+
+    def poll_verdict(self) -> Optional[tuple[int, int, float]]:
+        ticket = ctypes.c_uint64()
+        action = ctypes.c_uint8()
+        score = ctypes.c_float()
+        if self.lib.pingoo_ring_poll_verdict(
+                self.addr, ctypes.byref(ticket), ctypes.byref(action),
+                ctypes.byref(score)) != 0:
+            return None
+        return int(ticket.value), int(action.value), float(score.value)
+
+
+def slots_to_arrays(slots: np.ndarray) -> dict:
+    """Structured slots -> engine batch arrays (zero-parse bulk decode)."""
+    arrays: dict = {}
+    for field, cap in FIELD_CAPS.items():
+        arrays[f"{field}_bytes"] = np.ascontiguousarray(slots[field])
+        arrays[f"{field}_len"] = slots[f"{field}_len" if field != "user_agent"
+                                       else "ua_len"].astype(np.int32)
+    country = np.frombuffer(
+        slots["country"].tobytes(), dtype=np.uint8).reshape(-1, 2)
+    arrays["country_bytes"] = np.ascontiguousarray(country)
+    arrays["country_len"] = np.full(len(slots), 2, dtype=np.int32)
+    ip = slots["ip"].reshape(-1, 16)
+    arrays["ip"] = np.ascontiguousarray(
+        ip.view(">u4").reshape(-1, 4).astype(np.uint32))
+    arrays["asn"] = slots["asn"].astype(np.int64)
+    arrays["remote_port"] = slots["remote_port"].astype(np.int64)
+    return arrays
+
+
+class RingSidecar:
+    """Drain loop: ring batches -> jitted verdict -> verdict ring."""
+
+    def __init__(self, ring: Ring, plan, lists, max_batch: int = 1024,
+                 idle_sleep_s: float = 0.0002):
+        from .engine.verdict import first_action, make_verdict_fn
+
+        self.ring = ring
+        self.plan = plan
+        self.lists = lists
+        self.max_batch = max_batch
+        self.idle_sleep_s = idle_sleep_s
+        self._verdict_fn = make_verdict_fn(plan)
+        self._first_action = first_action
+        self._tables = plan.device_tables()
+        self.processed = 0
+        self._stop = False
+
+    def run(self, max_requests: Optional[int] = None) -> int:
+        """Blocking drain loop; returns requests processed."""
+        from .engine.batch import RequestBatch, pad_batch
+        from .engine.verdict import evaluate_batch
+
+        while not self._stop:
+            slots = self.ring.dequeue_batch(self.max_batch)
+            if len(slots) == 0:
+                if max_requests is not None and self.processed >= max_requests:
+                    break
+                time.sleep(self.idle_sleep_s)
+                continue
+            n = len(slots)
+            # Fixed batch shape: a partial batch would otherwise be a new
+            # XLA program (compile stall on the serving path). Length
+            # bucketing is skipped here for the same reason — the ring
+            # path prefers one stable shape over minimal scan length.
+            batch = pad_batch(
+                RequestBatch(size=n, arrays=slots_to_arrays(slots)),
+                self.max_batch)
+            matched = evaluate_batch(
+                self.plan, self._verdict_fn, self._tables, batch,
+                self.lists)[:n]
+            actions = self._first_action(self.plan, matched)
+            tickets = slots["ticket"]
+            for i in range(n):
+                while not self.ring.post_verdict(
+                        int(tickets[i]), int(actions[i])):
+                    time.sleep(self.idle_sleep_s)
+            self.processed += n
+            if max_requests is not None and self.processed >= max_requests:
+                break
+        return self.processed
+
+    def stop(self) -> None:
+        self._stop = True

@@ -23,3 +23,40 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# -- async test harness ------------------------------------------------------
+# pytest-asyncio isn't available in this image; host-plane integration
+# tests instead run against a shared event loop in a background thread.
+
+import asyncio  # noqa: E402
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+class LoopRunner:
+    """Run coroutines on a dedicated background event loop."""
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    def run(self, coro, timeout=60):
+        return asyncio.run_coroutine_threadsafe(
+            coro, self.loop).result(timeout)
+
+    def close(self):
+        self.loop.call_soon_threadsafe(self.loop.stop)
+        self._thread.join(timeout=5)
+
+
+@pytest.fixture(scope="session")
+def loop_runner():
+    runner = LoopRunner()
+    yield runner
+    runner.close()

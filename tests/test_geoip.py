@@ -1,0 +1,90 @@
+"""GeoIP mmdb decoder tests (reference pingoo/geoip.rs behaviors)."""
+
+import pytest
+
+from pingoo_tpu.host.geoip import (
+    AddressNotFound,
+    GeoipDB,
+    GeoipRecord,
+    MmdbReader,
+    build_mmdb,
+    parse_asn,
+    record_from_raw,
+)
+
+ENTRIES = {
+    "8.8.8.0/24": {"asn": "AS15169", "country": "US"},
+    "203.0.113.0/24": {"asn": 64500, "country": "FR"},
+    "10.0.0.0/8": {"asn": "AS0", "country": "XX"},
+}
+
+
+@pytest.fixture(scope="module")
+def db(tmp_path_factory):
+    raw = build_mmdb(ENTRIES)
+    path = tmp_path_factory.mktemp("geoip") / "geoip.mmdb"
+    path.write_bytes(raw)
+    db = GeoipDB.load(paths=(str(path),))
+    assert db is not None
+    return db
+
+
+class TestDecoder:
+    def test_lookup_hits(self, db):
+        assert db.lookup("8.8.8.8") == GeoipRecord(15169, "US")
+        assert db.lookup("8.8.8.255") == GeoipRecord(15169, "US")
+        assert db.lookup("203.0.113.77") == GeoipRecord(64500, "FR")
+        assert db.lookup("10.200.1.1") == GeoipRecord(0, "XX")
+
+    def test_miss_raises(self, db):
+        with pytest.raises(AddressNotFound):
+            db.lookup("9.9.9.9")
+        with pytest.raises(AddressNotFound):
+            db.lookup("2001:db8::1")
+
+    def test_loopback_multicast_short_circuit(self, db):
+        # geoip.rs:74-77
+        with pytest.raises(AddressNotFound):
+            db.lookup("127.0.0.1")
+        with pytest.raises(AddressNotFound):
+            db.lookup("224.0.0.1")
+
+    def test_cache(self, db):
+        r1 = db.lookup("8.8.8.8")
+        r2 = db.lookup("8.8.8.8")
+        assert r1 == r2
+
+    def test_metadata(self, db):
+        assert db.reader.metadata["database_type"] == "pingoo-tpu-test"
+
+    def test_zst_loading(self, tmp_path):
+        import zstandard
+
+        raw = build_mmdb(ENTRIES)
+        path = tmp_path / "geoip.mmdb.zst"
+        path.write_bytes(zstandard.ZstdCompressor().compress(raw))
+        db = GeoipDB.load(paths=(str(path),))
+        assert db.lookup("8.8.8.8").asn == 15169
+
+    def test_missing_db_disables(self, tmp_path):
+        assert GeoipDB.load(paths=(str(tmp_path / "none.mmdb"),)) is None
+
+
+class TestSchemas:
+    def test_parse_asn(self):
+        # serde_utils.rs:1-9: "AS123" -> 123
+        assert parse_asn("AS15169") == 15169
+        assert parse_asn("as15169") == 15169
+        assert parse_asn(15169) == 15169
+        assert parse_asn("junk") == 0
+
+    def test_geolite2_schema(self):
+        rec = record_from_raw(
+            {"country": {"iso_code": "de"}, "autonomous_system_number": 3320})
+        assert rec == GeoipRecord(3320, "DE")
+
+    def test_flat_schema(self):
+        assert record_from_raw({"asn": "AS1", "country": "jp"}) == GeoipRecord(1, "JP")
+
+    def test_bad_country_falls_back(self):
+        assert record_from_raw({"country": "LONG"}).country == "XX"
