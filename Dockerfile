@@ -1,0 +1,27 @@
+# Container image (reference parity: multi-stage Dockerfile; the
+# reference builds the captcha frontend then a static Rust binary into a
+# scratch image. Ours needs the Python/JAX runtime, so the final stage is
+# a slim python base with the native ring built in-stage.)
+#
+# The geoip database is expected at /etc/pingoo/geoip.mmdb[.zst]
+# (mounted or copied at deploy time, as in the reference's image which
+# fetches geoip.mmdb.zst at build).
+
+FROM python:3.12-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ make && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY pingoo_tpu ./pingoo_tpu
+RUN make -C pingoo_tpu/native && pip wheel --no-deps -w /wheels .
+
+FROM python:3.12-slim
+RUN useradd -r -u 10001 pingoo && mkdir -p /etc/pingoo/tls && \
+    chown -R pingoo /etc/pingoo
+COPY --from=build /wheels /wheels
+RUN pip install --no-cache-dir /wheels/*.whl "jax[cpu]" && rm -rf /wheels
+# TPU deployments: swap the jax extra for the libtpu wheel of the target
+# runtime (e.g. pip install jax[tpu] -f https://storage.googleapis.com/jax-releases/libtpu_releases.html)
+USER pingoo
+EXPOSE 80 443
+ENTRYPOINT ["python", "-m", "pingoo_tpu"]

@@ -1,0 +1,24 @@
+# Build/packaging (reference parity: Makefile `make build` / `make check`).
+
+PY ?= python
+
+.PHONY: all native test check bench clean
+
+all: native
+
+native:
+	$(MAKE) -C pingoo_tpu/native
+
+test: native
+	$(PY) -m pytest tests/ -x -q
+
+check:
+	$(PY) -m compileall -q pingoo_tpu
+	$(PY) -c "import pingoo_tpu.config, pingoo_tpu.compiler, pingoo_tpu.engine"
+
+bench: native
+	$(PY) bench.py
+
+clean:
+	$(MAKE) -C pingoo_tpu/native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

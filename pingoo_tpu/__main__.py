@@ -26,6 +26,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--no-device", action="store_true",
                         help="CPU-interpreter rules engine only")
     parser.add_argument("--no-docker", action="store_true")
+    parser.add_argument("--cache-dir", default=None,
+                        help="compiled-ruleset artifact cache directory")
     args = parser.parse_args(argv)
 
     init_logging()
@@ -53,7 +55,8 @@ def main(argv: list[str] | None = None) -> int:
     }})
     try:
         asyncio.run(run(config, use_device=not args.no_device,
-                        enable_docker=not args.no_docker))
+                        enable_docker=not args.no_docker,
+                        cache_dir=args.cache_dir))
     except KeyboardInterrupt:
         pass
     finally:

@@ -1,0 +1,94 @@
+"""Compiled-ruleset artifact cache.
+
+The reference's persistent state is all auto-managed files re-read at
+boot (SURVEY.md §5 checkpoint/resume). The TPU equivalent called for
+there: a compiled-ruleset artifact cache — ruleset hash -> lowered plan
+(device tables + predicate bindings + boolean IR) — so a restart skips
+recompilation of large rulesets (regex parsing, NFA packing, bitset
+construction for 1M-entry lists).
+
+Artifacts are pickles of the RulesetPlan's numpy/static state keyed by a
+fingerprint of (rule sources, actions, list contents, format version).
+The cache directory is private to the server (like /etc/pingoo's
+auto-managed files); artifacts are only ever loaded if their fingerprint
+matches, so a stale or foreign file is simply ignored.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Optional
+
+from ..config.schema import RuleConfig
+from ..expr.values import Ip
+from .plan import RulesetPlan, compile_ruleset
+
+FORMAT_VERSION = 3  # bump when plan/table layout changes
+
+
+def ruleset_fingerprint(rules: list[RuleConfig], lists: dict,
+                        field_specs=None) -> str:
+    from .lowering import DEFAULT_FIELD_SPECS
+
+    h = hashlib.sha256()
+    h.update(str(FORMAT_VERSION).encode())
+    h.update(repr(sorted((field_specs or DEFAULT_FIELD_SPECS).items())).encode())
+    for rule in rules:
+        h.update(rule.name.encode())
+        h.update((rule.expression.source if rule.expression else "").encode())
+        h.update(",".join(a.value for a in rule.actions).encode())
+        h.update(b"\x00")
+    for name in sorted(lists):
+        h.update(name.encode())
+        for item in lists[name]:
+            if isinstance(item, Ip):
+                h.update(str(item).encode())
+            else:
+                h.update(repr(item).encode())
+            h.update(b"\x01")
+    return h.hexdigest()
+
+
+def compile_ruleset_cached(
+    rules: list[RuleConfig],
+    lists: dict,
+    cache_dir: Optional[str] = None,
+    field_specs=None,
+) -> RulesetPlan:
+    """compile_ruleset with a transparent on-disk artifact cache."""
+    if cache_dir is None:
+        return compile_ruleset(rules, lists, field_specs)
+    fingerprint = ruleset_fingerprint(rules, lists, field_specs)
+    path = os.path.join(cache_dir, f"ruleset-{fingerprint[:32]}.plan")
+    plan = _load(path, fingerprint)
+    if plan is not None:
+        return plan
+    plan = compile_ruleset(rules, lists, field_specs)
+    _save(path, fingerprint, plan)
+    return plan
+
+
+def _save(path: str, fingerprint: str, plan: RulesetPlan) -> None:
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump({"fingerprint": fingerprint, "plan": plan}, f,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)  # atomic install (acme.rs-style persistence)
+    except (OSError, pickle.PicklingError):
+        pass  # cache is best-effort
+
+
+def _load(path: str, fingerprint: str) -> Optional[RulesetPlan]:
+    try:
+        with open(path, "rb") as f:
+            doc = pickle.load(f)
+        if doc.get("fingerprint") != fingerprint:
+            return None
+        plan = doc.get("plan")
+        return plan if isinstance(plan, RulesetPlan) else None
+    except Exception:
+        return None

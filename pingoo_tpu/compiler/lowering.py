@@ -436,7 +436,13 @@ class Lowerer:
         if node.func == "matches":
             if isinstance(recv, LStrField) and isinstance(arg, LStrLit):
                 try:
-                    repat.compile_regex(arg.value)
+                    alts = repat.compile_regex(arg.value)
+                    from .nfa import WORD_BITS, scan_bits_needed
+
+                    for lp in alts:
+                        if scan_bits_needed(lp) > WORD_BITS:
+                            raise repat.Unsupported(
+                                "expanded pattern exceeds one state word")
                 except repat.Unsupported as exc:
                     raise LowerError(f"regex outside device subset: {exc}")
                 except Exception:
@@ -473,7 +479,9 @@ class Lowerer:
                 lit = _lit_bytes(arg.value)
                 if lit is None:
                     return LBool(BConst(False))  # >0xFF chars never match
-                if len(lit) > repat.MAX_POSITIONS:
+                from .nfa import WORD_BITS
+
+                if len(lit) + 2 > WORD_BITS:  # guard + positions + sticky
                     raise LowerError("contains literal too long for NFA word")
                 leaf = self.reg.add(
                     NfaPred(field=recv.field, kind="contains", pattern=arg.value))

@@ -44,13 +44,23 @@ def _repeatable(p: Pos) -> bool:
     return p.quant in (Quant.STAR, Quant.PLUS)
 
 
+def _is_word(c: int) -> bool:
+    from .repat import is_word_byte
+
+    return is_word_byte(c)
+
+
 def simulate(lp: LinearPattern, data: bytes) -> bool:
     """Pure-Python Glushkov simulation of one linear pattern (oracle).
 
     `$` semantics follow Python `re` in bytes mode (the interpreter's
     engine, expr/values.py): it accepts at the end of input AND just
-    before one trailing newline.
+    before one trailing newline. Leading/trailing \\b gate injection and
+    delay acceptance by one byte (confirmed by the next byte's word-ness
+    or end of input).
     """
+    if lp.never_match:
+        return False
     m = len(lp.positions)
     if m == 0 or lp.min_len == 0:
         if not (lp.anchor_start and lp.anchor_end):
@@ -61,12 +71,24 @@ def simulate(lp: LinearPattern, data: bytes) -> bool:
             return True
         if m == 0:
             return False
+    first_word = _is_word(next(iter(lp.positions[0].bytes))) if m else False
+    last_word = _is_word(next(iter(lp.positions[-1].bytes))) if m else False
+    if lp.anchor_end and lp.boundary_end and not last_word:
+        return False  # boundary can never hold at end-of-input
     last_set = _last_set(lp)
     active: set[int] = set()
     matched = False
+    pend = False  # boundary_end accept awaiting confirmation
+    prev_word = False  # start of input counts as non-word
     ends_nl = len(data) > 0 and data[-1] == 0x0A
     for t, c in enumerate(data):
+        cur_word = _is_word(c)
+        if lp.boundary_end and not lp.anchor_end and pend and \
+                cur_word != last_word:
+            matched = True
         inject = (t == 0) or not lp.anchor_start
+        if lp.boundary_start and inject:
+            inject = prev_word != first_word
         nxt: set[int] = set()
         candidates: set[int] = set()
         if inject:
@@ -80,10 +102,18 @@ def simulate(lp: LinearPattern, data: bytes) -> bool:
             if c in lp.positions[i].bytes:
                 nxt.add(i)
         active = nxt
-        if not lp.anchor_end and active & last_set:
+        hit = bool(active & last_set)
+        if lp.boundary_end:
+            pend = hit
+        elif not lp.anchor_end and hit:
             matched = True
-        if lp.anchor_end and ends_nl and t == len(data) - 2 and active & last_set:
+        if lp.anchor_end and ends_nl and t == len(data) - 2 and hit:
             matched = True  # accept just before the trailing newline
+        prev_word = cur_word
+    if lp.boundary_end and not lp.anchor_end:
+        # End of input confirms a pending accept when the last consumed
+        # char is a word char (EOS is the non-word side).
+        return matched or (pend and last_word)
     if lp.anchor_end:
         return matched or bool(active & last_set)
     return matched
@@ -116,18 +146,41 @@ def _last_set(lp: LinearPattern) -> set[int]:
 
 @dataclass(frozen=True)
 class PatternSlot:
-    """Where one pattern lives in the bank + its accept metadata."""
+    """Where one input pattern lives in the bank + accept metadata.
+
+    With sticky-accept compilation every accept is read from the FINAL
+    scan state: `hit = (S_final[word] & accept_mask) != 0`, plus the
+    always/empty flags. There is no float/end distinction at scan time —
+    `$`, trailing newlines, and \\b variants were compiled into extra
+    positions/alternatives (see _expand_scan_patterns).
+    """
 
     word: int
-    accept_mask: int  # last-set bits
-    end_anchored: bool
-    always_match: bool  # min_len == 0 and not (^ and $)
-    empty_ok: bool  # ^...$ with min_len == 0: matches empty input
+    accept_mask: int
+    always_match: bool
+    empty_ok: bool  # additionally accept empty input (lengths == 0)
 
 
 @dataclass
 class NfaBank:
-    """Packed bit-parallel tables for one field's pattern group."""
+    """Packed bit-parallel tables for one field's pattern group.
+
+    The scan algebra is minimal — a single carried state word vector:
+
+        inj  = t == 0 ? init_anchored | init_unanchored : init_unanchored
+        adv  = (S << 1) | inj
+        adv |= ((adv & OPT) + OPT) ^ OPT     # skip optional runs
+        S'   = (adv | (S & REP)) & B[c]      # self-loops + byte classes
+
+    Accept state is *inside* S: each floating subpattern has a sticky
+    bit (byte class = ALL, REP self-loop) fed by its last position, so a
+    match anywhere survives to the end of the scan; `$` compiles into an
+    extra accept position (and an optional-\\n alternative for Python
+    re's trailing-newline semantics); \\b compiles into prepended/
+    appended word-class positions and/or anchored alternatives. One
+    HBM-resident carry instead of four makes the lax.scan loop ~3x
+    cheaper (each carry round-trips HBM per step under XLA).
+    """
 
     num_words: int = 0
     byte_table: np.ndarray = field(
@@ -141,12 +194,6 @@ class NfaBank:
     )  # [W] injected every step
     opt: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.uint32))
     rep: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.uint32))
-    last_float: np.ndarray = field(
-        default_factory=lambda: np.zeros(0, dtype=np.uint32)
-    )  # accept bits of patterns without $
-    last_end: np.ndarray = field(
-        default_factory=lambda: np.zeros(0, dtype=np.uint32)
-    )  # accept bits of $-anchored patterns
     slots: list[PatternSlot] = field(default_factory=list)
 
     @property
@@ -154,36 +201,139 @@ class NfaBank:
         return len(self.slots)
 
 
-def build_bank(patterns: list[LinearPattern]) -> NfaBank:
-    """Pack linear patterns into an NfaBank (first-fit into uint32 words)."""
-    bank = NfaBank()
-    word_used: list[int] = []  # bits used per word
+@dataclass(frozen=True)
+class _ScanPattern:
+    """One compiled alternative: positions + static accept positions."""
 
-    byte_rows: list[dict[int, int]] = []  # per word: byte -> mask
+    positions: tuple[Pos, ...]
+    accept: frozenset[int]  # relative indices accepting at final state
+    sticky: bool  # add a sticky accept bit after the last position
+    anchored: bool
+
+
+from .repat import _WORD as _WORDSET  # noqa: E402
+
+_NONWORD = frozenset(range(256)) - _WORDSET
+_NEWLINE = frozenset([0x0A])
+
+
+def _expand_scan_patterns(lp: LinearPattern) -> list[_ScanPattern]:
+    """Compile anchors/boundaries into plain scan alternatives.
+
+    `X$` -> positions X + required '\n' with accepts at last_set(X) (abs
+    end) and at the \n position (end just before a trailing newline).
+    Trailing \b -> an appended opposite-word-class position (+ the
+    absolute-end accept when the last class is word). Leading \b -> a
+    prepended opposite-word-class required position, plus an anchored
+    alternative for matches at position 0.
+    """
+    from .repat import Quant, is_word_byte
+
+    base = tuple(lp.positions)
+    m = len(base)
+    base_last = frozenset(_last_set(lp))
+
+    if lp.anchor_end and lp.boundary_end and m and not is_word_byte(
+            next(iter(base[-1].bytes))):
+        # \b$ with a non-word last class: the boundary can never hold at
+        # end-of-input (simulate() has the same early-out).
+        return []
+
+    variants: list[tuple[tuple[Pos, ...], frozenset[int], bool]] = []
+    if lp.anchor_end:
+        pos = base + (Pos(bytes=_NEWLINE),)
+        variants.append((pos, base_last | {m}, False))
+    elif lp.boundary_end:
+        last_word = is_word_byte(next(iter(base[-1].bytes)))
+        if last_word:
+            pos = base + (Pos(bytes=_NONWORD),)
+            variants.append((pos, base_last | {m}, True))
+        else:
+            pos = base + (Pos(bytes=_WORDSET),)
+            variants.append((pos, frozenset({m}), True))
+    else:
+        variants.append((base, base_last, True))
+
+    out: list[_ScanPattern] = []
+    for pos, accept, sticky in variants:
+        if lp.boundary_start:
+            first_word = is_word_byte(next(iter(base[0].bytes)))
+            if not lp.anchor_start:
+                prefix_cls = _NONWORD if first_word else _WORDSET
+                shifted = frozenset(i + 1 for i in accept)
+                out.append(_ScanPattern(
+                    positions=(Pos(bytes=prefix_cls),) + pos,
+                    accept=shifted, sticky=sticky, anchored=False))
+            if first_word:
+                # Boundary holds at position 0 (start is the non-word
+                # side) -> anchored alternative. Non-word first class can
+                # never have a boundary at position 0.
+                out.append(_ScanPattern(positions=pos, accept=accept,
+                                        sticky=sticky, anchored=True))
+        else:
+            out.append(_ScanPattern(positions=pos, accept=accept,
+                                    sticky=sticky,
+                                    anchored=lp.anchor_start))
+    return out
+
+
+def scan_bits_needed(lp: LinearPattern) -> int:
+    """Bits one input pattern occupies after expansion (guards + sticky
+    included). Must be <= WORD_BITS for device residency."""
+    if lp.never_match:
+        return 0
+    if lp.min_len == 0 and not (lp.anchor_start and lp.anchor_end):
+        return 0  # always-match: no device state
+    total = 0
+    for sp in _expand_scan_patterns(lp):
+        total += 1 + len(sp.positions) + (1 if sp.sticky else 0)
+    return total
+
+
+def build_bank(patterns: list[LinearPattern]) -> NfaBank:
+    """Pack linear patterns into an NfaBank (first-fit into uint32 words).
+
+    All expanded alternatives of one input pattern are packed contiguously
+    in a single word so each pattern keeps one (word, accept_mask) slot.
+    """
+    from .repat import Unsupported
+
+    bank = NfaBank()
+    word_used: list[int] = []
+    byte_rows: list[dict[int, int]] = []
     init_a: list[int] = []
     init_u: list[int] = []
     opt: list[int] = []
     rep: list[int] = []
-    last_f: list[int] = []
-    last_e: list[int] = []
 
     for lp in patterns:
         m = len(lp.positions)
         always = lp.min_len == 0 and not (lp.anchor_start and lp.anchor_end)
         empty_ok = lp.min_len == 0 and lp.anchor_start and lp.anchor_end
-        if m == 0 or always:
-            # Constant or empty-only patterns carry no device state: "" or
-            # "a*" unanchored match everything (always); "^$" matches only
-            # empty input (empty_ok with accept_mask 0).
-            bank.slots.append(
-                PatternSlot(word=0, accept_mask=0, end_anchored=lp.anchor_end,
-                            always_match=always, empty_ok=empty_ok)
-            )
+        if lp.never_match:
+            bank.slots.append(PatternSlot(word=0, accept_mask=0,
+                                          always_match=False, empty_ok=False))
             continue
-        need = m + 1  # one guard bit
+        if m == 0 and not (lp.anchor_start and lp.anchor_end):
+            bank.slots.append(PatternSlot(word=0, accept_mask=0,
+                                          always_match=True, empty_ok=False))
+            continue
+        if always:
+            bank.slots.append(PatternSlot(word=0, accept_mask=0,
+                                          always_match=True, empty_ok=False))
+            continue
+
+        subs = _expand_scan_patterns(lp)
+        need = sum(1 + len(s.positions) + (1 if s.sticky else 0)
+                   for s in subs)
+        if not subs or need == 0:
+            # e.g. ^\b with non-word first class only: unsatisfiable.
+            bank.slots.append(PatternSlot(word=0, accept_mask=0,
+                                          always_match=False,
+                                          empty_ok=empty_ok))
+            continue
         if need > WORD_BITS:
             raise Unsupported(f"pattern needs {need} bits > {WORD_BITS}")
-        # First-fit placement.
         w = -1
         for idx, used in enumerate(word_used):
             if used + need <= WORD_BITS:
@@ -196,36 +346,38 @@ def build_bank(patterns: list[LinearPattern]) -> NfaBank:
             init_u.append(0)
             opt.append(0)
             rep.append(0)
-            last_f.append(0)
-            last_e.append(0)
             w = len(word_used) - 1
-        base = word_used[w] + 1  # skip guard bit at word_used[w]
-        word_used[w] += need
 
-        bit = lambda i: 1 << (base + i)  # noqa: E731
-        for i, pos in enumerate(lp.positions):
-            for b in pos.bytes:
-                byte_rows[w][b] = byte_rows[w].get(b, 0) | bit(i)
-            if _skippable(pos):
-                opt[w] |= bit(i)
-            if _repeatable(pos):
-                rep[w] |= bit(i)
-        if lp.anchor_start:
-            init_a[w] |= bit(0)
-        else:
-            init_u[w] |= bit(0)
         accept_mask = 0
-        for i in _last_set(lp):
-            accept_mask |= bit(i)
-        if lp.anchor_end:
-            last_e[w] |= accept_mask
-        else:
-            last_f[w] |= accept_mask
-        bank.slots.append(
-            PatternSlot(word=w, accept_mask=accept_mask,
-                        end_anchored=lp.anchor_end, always_match=False,
-                        empty_ok=empty_ok)
-        )
+        for sub in subs:
+            base = word_used[w] + 1  # skip the guard bit
+            bit = lambda i: 1 << (base + i)  # noqa: E731
+            for i, pos in enumerate(sub.positions):
+                for b in pos.bytes:
+                    byte_rows[w][b] = byte_rows[w].get(b, 0) | bit(i)
+                if _skippable(pos):
+                    opt[w] |= bit(i)
+                if _repeatable(pos):
+                    rep[w] |= bit(i)
+            if sub.anchored:
+                init_a[w] |= bit(0)
+            else:
+                init_u[w] |= bit(0)
+            for i in sub.accept:
+                accept_mask |= bit(i)
+            n = len(sub.positions)
+            if sub.sticky:
+                # Sticky accept bit: matches any byte, self-loops, fed by
+                # the last position's shift/opt-propagation.
+                for b in range(256):
+                    byte_rows[w][b] = byte_rows[w].get(b, 0) | bit(n)
+                rep[w] |= bit(n)
+                accept_mask |= bit(n)
+                n += 1
+            word_used[w] += 1 + n
+
+        bank.slots.append(PatternSlot(word=w, accept_mask=accept_mask,
+                                      always_match=False, empty_ok=empty_ok))
 
     W = len(word_used)
     bank.num_words = W
@@ -238,8 +390,6 @@ def build_bank(patterns: list[LinearPattern]) -> NfaBank:
     bank.init_unanchored = np.array(init_u, dtype=np.uint32)
     bank.opt = np.array(opt, dtype=np.uint32)
     bank.rep = np.array(rep, dtype=np.uint32)
-    bank.last_float = np.array(last_f, dtype=np.uint32)
-    bank.last_end = np.array(last_e, dtype=np.uint32)
     return bank
 
 
@@ -251,14 +401,6 @@ def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarr
     B, L = data.shape
     W = bank.num_words
     S = np.zeros((B, W), dtype=np.uint32)
-    float_acc = np.zeros((B, W), dtype=np.uint32)
-    end_acc = np.zeros((B, W), dtype=np.uint32)
-    # `$` accepts at end of input or just before one trailing newline
-    # (Python-re semantics; see simulate()).
-    ends_nl = np.zeros(B, dtype=bool)
-    if L > 0:
-        last_byte = data[np.arange(B), np.maximum(lengths - 1, 0)]
-        ends_nl = (lengths > 0) & (last_byte == 0x0A)
     for t in range(L):
         c = data[:, t].astype(np.int64)
         bc = bank.byte_table[c]  # [B, W]
@@ -267,28 +409,18 @@ def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarr
             inj = inj | bank.init_anchored[None, :]
         adv = ((S << np.uint32(1)) | inj).astype(np.uint32)
         adv |= ((adv & bank.opt) + bank.opt) ^ bank.opt
-        pre = adv | (S & bank.rep)
-        S_new = (pre & bc).astype(np.uint32)
-        active = (t < lengths)[:, None]
-        S = np.where(active, S_new, S)
-        float_acc |= np.where(active, S_new & bank.last_float, 0).astype(np.uint32)
-        before_nl = (ends_nl & (t == lengths - 2))[:, None]
-        end_acc |= np.where(before_nl, S_new & bank.last_end, 0).astype(np.uint32)
-    end_acc |= S & bank.last_end
+        S_new = ((adv | (S & bank.rep)) & bc).astype(np.uint32)
+        S = np.where((t < lengths)[:, None], S_new, S)
     out = np.zeros((B, bank.num_patterns), dtype=bool)
-    empty_like = (lengths == 0) | (ends_nl & (lengths == 1))
+    empty = lengths == 0
     for p, slot in enumerate(bank.slots):
         if slot.always_match:
             out[:, p] = True
             continue
-        if slot.end_anchored:
-            if bank.num_words == 0:
-                hit = np.zeros(B, dtype=bool)
-            else:
-                hit = (end_acc[:, slot.word] & np.uint32(slot.accept_mask)) != 0
-            if slot.empty_ok:
-                hit = hit | empty_like
-        else:
-            hit = (float_acc[:, slot.word] & np.uint32(slot.accept_mask)) != 0
+        hit = np.zeros(B, dtype=bool)
+        if W and slot.accept_mask:
+            hit = (S[:, slot.word] & np.uint32(slot.accept_mask)) != 0
+        if slot.empty_ok:
+            hit |= empty
         out[:, p] = hit
     return out

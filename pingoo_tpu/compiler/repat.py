@@ -54,11 +54,22 @@ class Pos:
 
 @dataclass
 class LinearPattern:
-    """A linear NFA: positions consumed left to right."""
+    """A linear NFA: positions consumed left to right.
+
+    boundary_start/_end implement leading/trailing \\b (the CRS staple
+    `\\bunion\\b`): a leading \\b admits a match only when the byte before
+    the first consumed position has the opposite word-ness of that
+    position's class; a trailing \\b requires the byte after the last
+    consumed position (or end of input) to flip word-ness. Mid-pattern
+    \\b stays Unsupported (host fallback).
+    """
 
     positions: list[Pos] = field(default_factory=list)
     anchor_start: bool = False
     anchor_end: bool = False
+    boundary_start: bool = False
+    boundary_end: bool = False
+    never_match: bool = False  # statically unsatisfiable (e.g. a\bb)
 
     @property
     def min_len(self) -> int:
@@ -164,6 +175,7 @@ class _Item:
 def _to_linear(items: list[_Item]) -> LinearPattern:
     lp = LinearPattern()
     flat = _flatten(items)
+    pending_mid = False
     for idx, item in enumerate(flat):
         if item.anchor == "^":
             if idx != 0:
@@ -175,11 +187,74 @@ def _to_linear(items: list[_Item]) -> LinearPattern:
                 raise Unsupported("$ not at pattern end")
             lp.anchor_end = True
             continue
+        if item.anchor == "b":
+            # \b is "leading" before any position (e.g. ^\bfoo) and
+            # "trailing" when only anchors follow (e.g. foo\b$).
+            if not lp.positions:
+                lp.boundary_start = True
+                continue
+            if all(it.anchor is not None for it in flat[idx + 1:]):
+                lp.boundary_end = True
+                continue
+            pending_mid = True
+            continue
         assert item.pos is not None
-        lp.positions.extend(_expand_quant(item))
+        new_positions = _expand_quant(item)
+        if pending_mid and new_positions:
+            # Mid-pattern \b between uniform-wordness neighbors is
+            # statically decidable: opposite word-ness -> the boundary
+            # always holds (drop it); same word-ness -> unsatisfiable.
+            prev = lp.positions[-1]
+            nxt = new_positions[0]
+            if prev.quant in (Quant.OPT, Quant.STAR) or nxt.quant in (
+                    Quant.OPT, Quant.STAR):
+                raise Unsupported("\\b next to optional position")
+            if not (_uniform_wordness(prev.bytes)
+                    and _uniform_wordness(nxt.bytes)):
+                raise Unsupported("\\b between mixed word/non-word classes")
+            prev_word = next(iter(prev.bytes)) in _WORD
+            next_word = next(iter(nxt.bytes)) in _WORD
+            if prev_word == next_word:
+                lp.never_match = True
+            pending_mid = False
+        lp.positions.extend(new_positions)
         if len(lp.positions) > MAX_POSITIONS:
             raise Unsupported(f"pattern expands to >{MAX_POSITIONS} positions")
+    if pending_mid:
+        raise Unsupported("dangling \\b")
+    _validate_boundaries(lp)
     return lp
+
+
+def _validate_boundaries(lp: LinearPattern) -> None:
+    """Boundary patterns need unambiguous word-ness at the edges, and
+    edge positions must be required (a skippable edge changes which
+    class sits at the boundary)."""
+    if not (lp.boundary_start or lp.boundary_end):
+        return
+    if not lp.positions:
+        raise Unsupported("bare \\b")
+    if lp.boundary_start:
+        first = lp.positions[0]
+        if first.quant != Quant.ONE and first.quant != Quant.PLUS:
+            raise Unsupported("\\b before optional position")
+        if not _uniform_wordness(first.bytes):
+            raise Unsupported("\\b before mixed word/non-word class")
+    if lp.boundary_end:
+        last = lp.positions[-1]
+        if last.quant != Quant.ONE and last.quant != Quant.PLUS:
+            raise Unsupported("\\b after optional position")
+        if not _uniform_wordness(last.bytes):
+            raise Unsupported("\\b after mixed word/non-word class")
+
+
+def is_word_byte(b: int) -> bool:
+    return b in _WORD
+
+
+def _uniform_wordness(cls: frozenset[int]) -> bool:
+    kinds = {b in _WORD for b in cls}
+    return len(kinds) == 1
 
 
 def _flatten(items: list[_Item]) -> list[_Item]:
@@ -291,6 +366,9 @@ class _Parser:
         if c == ord("$"):
             self.i += 1
             return _Item(anchor="$")
+        if self.data[self.i : self.i + 2] == rb"\b":
+            self.i += 2
+            return _Item(anchor="b")
         if c == ord("("):
             return self._parse_group()
         atom = self._parse_atom()
@@ -447,7 +525,11 @@ class _Parser:
                 raise Unsupported("bad \\x escape")
             self.i += 2
             return frozenset([int(digits, 16)])
-        if c in b"bBAZz":
+        if c == ord("b"):
+            # Only reachable from class context ([\b] is backspace in re);
+            # top-level \b is handled as a boundary item in parse_item.
+            return frozenset([0x08])
+        if c in b"BAZz":
             raise Unsupported(f"\\{chr(c)} boundary assertion")
         if c in b"123456789":
             raise Unsupported("backreference")

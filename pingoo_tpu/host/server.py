@@ -38,6 +38,7 @@ class Server:
         captcha_jwks_path: str = "/etc/pingoo/captcha_jwks.json",
         tls_dir: str = "/etc/pingoo/tls",
         enable_docker: bool = True,
+        cache_dir: Optional[str] = None,
     ):
         self.config = config
         self.use_device = use_device
@@ -45,6 +46,7 @@ class Server:
         self.captcha_jwks_path = captcha_jwks_path
         self.tls_dir = tls_dir
         self.enable_docker = enable_docker
+        self.cache_dir = cache_dir
         self.registry: Optional[ServiceRegistry] = None
         self.verdict: Optional[VerdictService] = None
         self.http_listeners: list[HttpListener] = []
@@ -69,7 +71,10 @@ class Server:
         from ..engine.service import ensure_jax_backend
 
         use_device = self.use_device and ensure_jax_backend()
-        plan = compile_ruleset(list(config.rules), lists)
+        from ..compiler.cache import compile_ruleset_cached
+
+        plan = compile_ruleset_cached(
+            list(config.rules), lists, cache_dir=self.cache_dir)
         self.verdict = VerdictService(plan, lists, use_device=use_device)
         await self.verdict.start()
 

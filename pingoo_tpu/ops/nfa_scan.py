@@ -1,17 +1,19 @@
 """JAX bit-parallel NFA scan — the device hot op of the verdict engine.
 
-Executes the extended Shift-And algebra built by compiler/nfa.py
-(build_bank) over a byte tensor [B, L]: a `lax.scan` over the length
-dimension carrying [B, W] uint32 state lanes. All ops are elementwise
-uint32 (VPU-friendly); the only memory op per step is an embedding-style
-row gather of the [256, W] byte-class table. See compiler/nfa.py for the
-algebra derivation and the numpy reference implementation this op is
-differentially tested against.
+Executes the sticky-accept algebra built by compiler/nfa.py (build_bank)
+over a byte tensor [B, L]: a `lax.scan` over the length dimension
+carrying a SINGLE [B, W] uint32 state vector. Everything — floating
+matches (sticky bits), `$` (expanded to an extra accept position with an
+optional-\\n alternative), and \\b (expanded to word-class positions /
+anchored alternatives) — lives inside the state word, so per step the
+loop does one embedding-style row gather of the [256, W] byte-class
+table plus ~7 elementwise uint32 ops, and only S round-trips HBM
+between scan iterations (four carried accumulator lanes in an earlier
+design tripled the scan's HBM traffic).
 
 The reference behavior this replaces: per-request sequential regex
 execution inside the rules loop (reference pingoo/listeners/
-http_listener.rs:251-264 -> bel tree-walk with Rust regex). Here a whole
-batch advances through all patterns simultaneously, one byte per step.
+http_listener.rs:251-264 -> bel tree-walk with Rust regex).
 """
 
 from __future__ import annotations
@@ -29,16 +31,13 @@ class NfaTables(NamedTuple):
     """Device-resident tables for one field's NFA bank (a pytree)."""
 
     byte_table: jax.Array  # [256, W] uint32
-    init_anchored: jax.Array  # [W]
-    init_unanchored: jax.Array  # [W]
+    init_anchored: jax.Array  # [W] injected at t == 0 only
+    init_unanchored: jax.Array  # [W] injected every step
     opt: jax.Array  # [W]
     rep: jax.Array  # [W]
-    last_float: jax.Array  # [W]
-    last_end: jax.Array  # [W]
     # Per-pattern slot extraction data:
     slot_word: jax.Array  # [P] int32
     slot_mask: jax.Array  # [P] uint32
-    slot_end: jax.Array  # [P] bool ($-anchored)
     slot_always: jax.Array  # [P] bool
     slot_empty_ok: jax.Array  # [P] bool
 
@@ -65,21 +64,13 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
         init_unanchored=jnp.asarray(pad(bank.init_unanchored)),
         opt=jnp.asarray(pad(bank.opt)),
         rep=jnp.asarray(pad(bank.rep)),
-        last_float=jnp.asarray(pad(bank.last_float)),
-        last_end=jnp.asarray(pad(bank.last_end)),
-        slot_word=jnp.asarray(
-            np.array([s.word for s in slots], dtype=np.int32)
-        ),
+        slot_word=jnp.asarray(np.array([s.word for s in slots], dtype=np.int32)),
         slot_mask=jnp.asarray(
-            np.array([s.accept_mask for s in slots], dtype=np.uint32)
-        ),
-        slot_end=jnp.asarray(np.array([s.end_anchored for s in slots], dtype=bool)),
+            np.array([s.accept_mask for s in slots], dtype=np.uint32)),
         slot_always=jnp.asarray(
-            np.array([s.always_match for s in slots], dtype=bool)
-        ),
+            np.array([s.always_match for s in slots], dtype=bool)),
         slot_empty_ok=jnp.asarray(
-            np.array([s.empty_ok for s in slots], dtype=bool)
-        ),
+            np.array([s.empty_ok for s in slots], dtype=bool)),
     )
 
 
@@ -88,15 +79,12 @@ def scan_chunk(
     data: jax.Array,
     lengths: jax.Array,
     state: jax.Array,
-    float_acc: jax.Array,
-    end_acc: jax.Array,
-    ends_nl: jax.Array,
     t_offset,
-):
+) -> jax.Array:
     """Advance the NFA over one [B, Lc] byte chunk whose first column sits
-    at global position `t_offset`. Carries (state, float_acc, end_acc) so
-    chunks compose — used by the plain scan and by the sp ring scan
-    (parallel/ring.py), which passes state between devices via ppermute.
+    at global position `t_offset`; returns the new [B, W] state. Chunks
+    compose — the sp ring (parallel/ring.py) passes the state between
+    devices via ppermute.
     """
     Lc = data.shape[1]
     one = jnp.uint32(1)
@@ -104,8 +92,7 @@ def scan_chunk(
     rep = tables.rep
     lengths = lengths.astype(jnp.int32)
 
-    def step(carry, xs):
-        S, fa, ea = carry
+    def step(S, xs):
         c, t_local = xs  # c: [B] uint8
         t = t_local + t_offset  # global byte position
         bc = jnp.take(tables.byte_table, c.astype(jnp.int32), axis=0)  # [B, W]
@@ -113,47 +100,27 @@ def scan_chunk(
                         tables.init_unanchored)
         adv = (S << one) | inj[None, :]
         adv = adv | (((adv & opt) + opt) ^ opt)
-        pre = adv | (S & rep)
-        S_new = pre & bc
-        active = (t < lengths)[:, None]
-        S = jnp.where(active, S_new, S)
-        fa = fa | jnp.where(active, S_new & tables.last_float, 0)
-        before_nl = (ends_nl & (t == lengths - 2))[:, None]
-        ea = ea | jnp.where(before_nl, S_new & tables.last_end, 0)
-        return (S, fa, ea), None
+        S_new = (adv | (S & rep)) & bc
+        S = jnp.where((t < lengths)[:, None], S_new, S)
+        return S, None
 
-    (state, float_acc, end_acc), _ = jax.lax.scan(
-        step,
-        (state, float_acc, end_acc),
-        (data.T, jnp.arange(Lc, dtype=jnp.int32)),
-    )
-    return state, float_acc, end_acc
+    state, _ = jax.lax.scan(
+        step, state, (data.T, jnp.arange(Lc, dtype=jnp.int32)))
+    return state
 
 
-def trailing_newline_mask(data: jax.Array, lengths: jax.Array) -> jax.Array:
-    B = data.shape[0]
+def init_scan_state(B: int, W: int) -> jax.Array:
+    return jnp.zeros((B, W), dtype=jnp.uint32)
+
+
+def extract_slots(tables: NfaTables, state: jax.Array,
+                  lengths: jax.Array) -> jax.Array:
+    """Per-pattern verdicts [B, P] from the final state."""
     lengths = lengths.astype(jnp.int32)
-    last_byte = data[jnp.arange(B), jnp.maximum(lengths - 1, 0)]
-    return (lengths > 0) & (last_byte == 0x0A)
-
-
-def extract_slots(
-    tables: NfaTables,
-    float_acc: jax.Array,
-    end_acc: jax.Array,
-    lengths: jax.Array,
-    ends_nl: jax.Array,
-) -> jax.Array:
-    """Per-pattern verdict columns [B, P] from accumulated word lanes."""
-    lengths = lengths.astype(jnp.int32)
-    fa = jnp.take(float_acc, tables.slot_word, axis=1)  # [B, P]
-    ea = jnp.take(end_acc, tables.slot_word, axis=1)
-    lanes = jnp.where(tables.slot_end[None, :], ea, fa)
+    lanes = jnp.take(state, tables.slot_word, axis=1)  # [B, P]
     hit = (lanes & tables.slot_mask[None, :]) != 0
-    empty_like = ((lengths == 0) | (ends_nl & (lengths == 1)))[:, None]
-    hit = hit | (tables.slot_end & tables.slot_empty_ok)[None, :] & empty_like
-    hit = hit | tables.slot_always[None, :]
-    return hit
+    hit = hit | (tables.slot_empty_ok[None, :] & (lengths == 0)[:, None])
+    return hit | tables.slot_always[None, :]
 
 
 def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array) -> jax.Array:
@@ -163,11 +130,6 @@ def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array) -> jax.Arra
     returns: matched [B, P] bool  (P = number of packed patterns)
     """
     B, L = data.shape
-    state0 = jnp.zeros((B, tables.opt.shape[0]), dtype=jnp.uint32)
-    acc0 = jnp.zeros_like(state0)
-    endacc0 = jnp.zeros_like(state0)
-    ends_nl = trailing_newline_mask(data, lengths)
-    state, float_acc, end_acc = scan_chunk(
-        tables, data, lengths, state0, acc0, endacc0, ends_nl, 0)
-    end_acc = end_acc | (state & tables.last_end)
-    return extract_slots(tables, float_acc, end_acc, lengths, ends_nl)
+    state = scan_chunk(
+        tables, data, lengths, init_scan_state(B, tables.opt.shape[0]), 0)
+    return extract_slots(tables, state, lengths)

@@ -66,19 +66,17 @@ def table_shardings(mesh: Mesh, tables: Mapping[str, Any]) -> dict:
 
     def shard_nfa(t: NfaTables) -> NfaTables:
         w = NamedSharding(mesh, P("tp"))
+        p = NamedSharding(mesh, P("tp"))
         return NfaTables(
             byte_table=NamedSharding(mesh, P(None, "tp")),
             init_anchored=w,
             init_unanchored=w,
             opt=w,
             rep=w,
-            last_float=w,
-            last_end=w,
-            slot_word=NamedSharding(mesh, P("tp")),
-            slot_mask=NamedSharding(mesh, P("tp")),
-            slot_end=NamedSharding(mesh, P("tp")),
-            slot_always=NamedSharding(mesh, P("tp")),
-            slot_empty_ok=NamedSharding(mesh, P("tp")),
+            slot_word=p,
+            slot_mask=p,
+            slot_always=p,
+            slot_empty_ok=p,
         )
 
     out: dict = {}
@@ -140,11 +138,8 @@ def pad_tables_for_tp(np_tables: dict, tp: int) -> dict:
                 init_unanchored=pad_axis(np.asarray(val.init_unanchored), 0, tp),
                 opt=pad_axis(np.asarray(val.opt), 0, tp),
                 rep=pad_axis(np.asarray(val.rep), 0, tp),
-                last_float=pad_axis(np.asarray(val.last_float), 0, tp),
-                last_end=pad_axis(np.asarray(val.last_end), 0, tp),
                 slot_word=pad_axis(np.asarray(val.slot_word), 0, tp),
                 slot_mask=pad_axis(np.asarray(val.slot_mask), 0, tp),
-                slot_end=pad_axis(np.asarray(val.slot_end), 0, tp),
                 slot_always=pad_axis(np.asarray(val.slot_always), 0, tp),
                 slot_empty_ok=pad_axis(np.asarray(val.slot_empty_ok), 0, tp),
             )

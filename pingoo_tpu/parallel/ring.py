@@ -7,17 +7,14 @@ state travels around the ring via `ppermute` — the ring-attention-style
 accumulation of scan state across chunk boundaries, applied to the
 bit-parallel NFA instead of attention blocks.
 
-Stage s: the device holding chunk s advances the state it just received
-over its local bytes; every device then rotates its state register one
-step around the ring, delivering the true state to the device holding
-chunk s+1. Float accepts accumulate on whichever device finds them and
-are OR-combined at the end (psum over the one-hot contributions);
-$-anchored accepts are evaluated by the device that ran the final stage.
+With sticky-accept compilation (compiler/nfa.py) the carried state IS
+the accept state, so the ring rotates exactly one [B, W] uint32 tensor;
+extraction happens once, on the device that ran the final stage, and the
+verdict broadcast rides a psum.
 
-This distributes both the byte tensors and the NFA state over sp, so a
-field's device footprint shrinks 1/sp while verdict semantics stay
-bit-identical to ops/nfa_scan.nfa_scan (differentially tested on the
-8-device CPU mesh).
+This distributes the byte tensors and NFA state 1/sp per device while
+verdict semantics stay bit-identical to ops/nfa_scan.nfa_scan
+(differentially tested on the 8-device CPU mesh).
 """
 
 from __future__ import annotations
@@ -28,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.nfa_scan import NfaTables, extract_slots, scan_chunk
+from ..ops.nfa_scan import NfaTables, extract_slots, init_scan_state, scan_chunk
 
 
 def ring_nfa_scan(
@@ -55,46 +52,27 @@ def ring_nfa_scan(
         sp_idx = jax.lax.axis_index("sp")
         Bl = chunk.shape[0]
         W = tables_local.opt.shape[0]
-        state = jnp.zeros((Bl, W), dtype=jnp.uint32)
-        float_acc = jnp.zeros_like(state)
-        end_acc = jnp.zeros_like(state)
-
-        # Trailing-newline flag needs the *global* last byte; each device
-        # checks whether it owns position len-1 and the flag is OR-shared.
-        lengths_i = lengths_local.astype(jnp.int32)
-        local_pos = jnp.clip(lengths_i - 1 - sp_idx * Lc, 0, Lc - 1)
-        owns_last = (lengths_i > 0) & (
-            (lengths_i - 1) // Lc == sp_idx)
-        my_last = chunk[jnp.arange(Bl), local_pos]
-        nl_local = owns_last & (my_last == 0x0A)
-        ends_nl = jax.lax.psum(nl_local.astype(jnp.int32), "sp") > 0
+        state = init_scan_state(Bl, W)
 
         perm = [(i, (i + 1) % sp) for i in range(sp)]
-        final_end_bits = jnp.zeros_like(state)
+        hits = jnp.zeros(
+            (Bl, tables_local.slot_word.shape[0]), dtype=jnp.int32)
         for stage in range(sp):
             my_turn = sp_idx == stage
-            s2, f2, e2 = scan_chunk(
-                tables_local, chunk, lengths_local, state, float_acc,
-                end_acc, ends_nl, stage * Lc)
-            # Only the stage owner's results are real this round. Note
-            # the owner of stage `stage` is the device whose chunk is at
-            # byte offset stage*Lc — device index == stage.
-            take = my_turn
-            state = jnp.where(take, s2, state)
-            float_acc = jnp.where(take, f2, float_acc)
-            end_acc = jnp.where(take, e2, end_acc)
+            s2 = scan_chunk(tables_local, chunk, lengths_local, state,
+                            stage * Lc)
+            # Only the stage owner's result is real this round (the owner
+            # of stage s is the device holding byte offset s*Lc).
+            state = jnp.where(my_turn, s2, state)
             if stage == sp - 1:
-                final_end_bits = jnp.where(
-                    take, state & tables_local.last_end, final_end_bits)
-            # Rotate the state register one step; accs stay local.
-            state = jax.lax.ppermute(state, "sp", perm)
+                final_hits = extract_slots(
+                    tables_local, state, lengths_local)
+                hits = jnp.where(my_turn, final_hits.astype(jnp.int32), hits)
+            else:
+                state = jax.lax.ppermute(state, "sp", perm)
 
-        end_acc = end_acc | final_end_bits
-        hits = extract_slots(
-            tables_local, float_acc, end_acc, lengths_local, ends_nl)
-        # OR the per-device partial verdicts (disjoint discovery times,
-        # possibly overlapping patterns).
-        return jax.lax.psum(hits.astype(jnp.int32), "sp") > 0
+        # Broadcast the final-stage device's verdicts to the ring.
+        return jax.lax.psum(hits, "sp") > 0
 
     return kernel(tables, data, lengths)
 

@@ -21,11 +21,12 @@ from ..engine.batch import RequestTuple
 from ..expr import Ip, compile_expression
 
 SQLI_CORES = [
-    r"(?i)union\s+select", r"(?i)select\s+.{0,10}from", r"(?i)insert\s+into",
-    r"(?i)delete\s+from", r"(?i)drop\s+table", r"(?i)or\s+1=1",
-    r"(?i)and\s+1=1", r"(?i)sleep\(\d+\)", r"(?i)benchmark\(",
+    r"(?i)\bunion\s+select\b", r"(?i)select\s+.{0,10}from", r"(?i)insert\s+into",
+    r"(?i)delete\s+from", r"(?i)drop\s+table", r"(?i)\bor\b\s+1=1",
+    r"(?i)\band\b\s+1=1", r"(?i)sleep\(\d+\)", r"(?i)benchmark\(",
     r"(?i)waitfor\s+delay", r"(?i)group\s+by.{0,8}having", r"(?i)into\s+outfile",
     r"(?i)load_file\(", r"(?i)information_schema", r"'\s*--", r"(?i)xp_cmdshell",
+    r"(?i)\bexec\b", r"(?i)\bcast\(", r"(?i)\bconcat\(",
 ]
 XSS_CORES = [
     r"(?i)<script", r"(?i)javascript:", r"(?i)onerror\s*=", r"(?i)onload\s*=",
@@ -156,10 +157,11 @@ def _escape(pattern: str) -> str:
 
 def _in_device_subset(pattern: str) -> bool:
     from ..compiler import repat
+    from ..compiler.nfa import WORD_BITS, scan_bits_needed
 
     try:
-        repat.compile_regex(pattern)
-        return True
+        return all(scan_bits_needed(lp) <= WORD_BITS
+                   for lp in repat.compile_regex(pattern))
     except repat.Unsupported:
         return False
 

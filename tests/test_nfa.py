@@ -70,6 +70,20 @@ SUPPORTED_PATTERNS = [
     r"x$|^y",
     r"(a|b|c|d|e|f|g){3}",  # single-char alts merge into a class
     r"(ab|cd){2}",  # repetition rewrite composes with cross product
+    # word boundaries (leading/trailing)
+    r"\babc",
+    r"abc\b",
+    r"\babc\b",
+    r"\bor\b",
+    r"(?i)\bunion\b",
+    r"\b\.x",  # non-word first class: requires word char before '.'
+    r"x\.\b",  # non-word last class: requires word char after '.'
+    r"\bab+\b",
+    r"^\babc",
+    r"abc\b$",
+    r"(?i)\bor\b\s+1=1",  # mid-\b folds away (word before, \s after)
+    r"a\bb",  # mid-\b same wordness: statically never matches
+    r"x\b\.y",
     r"abc$",  # trailing-newline $ semantics
     r"^abc$",
     r"ab\nc",
@@ -80,7 +94,8 @@ UNSUPPORTED_PATTERNS = [
     r"a(?=b)",  # lookahead
     r"(a)\1",  # backreference
     r"a{1,50}" * 2,  # expansion too large
-    r"\bword\b",  # boundary
+    r"\b(a|\s)x",  # boundary before mixed word/non-word class
+    r"\ba?bc",  # boundary before optional position
     r"a*?",  # lazy
     r"(?s)a.c",  # dotall
     r"(?P<x>ab)",  # named group
@@ -97,6 +112,9 @@ def gen_inputs(rng: random.Random, n: int = 60) -> list[bytes]:
         b"10.0.0.1", b"999.999", b"word boundary", b"a|b", b"x", b"y",
         b"xyz", b"def", b"defgx", b"abcd", b"\x00\x01", b"aa", b"aaaa",
         b"abc\n", b"abc\n\n", b"\n", b"a\n", b"ab\ncd", b"xabc\n",
+        b"abc", b" abc ", b"xabc", b"abcx", b" abc", b"abc ", b"or",
+        b"for", b"orb", b" or 1=1", b"union select", b"UNION ALL",
+        b".x", b"a.x", b" .x", b"x.", b"x.a", b"x. ", b"ab", b"abb ",
     ]
     alphabet = b"abcdefgxyz0123456789 ./<>%|$^\\()[]{}\x00\nABC"
     for _ in range(n):

@@ -63,6 +63,9 @@ RULE_SOURCES = [
     '!(http_request.method == "GET" || http_request.method == "HEAD")',
     'lists["missing"].contains(client.ip)',  # runtime error -> never matches
     'http_request.path.matches("^/(admin|wp-admin|phpmyadmin)")',
+    'http_request.url.matches("(?i)\\bor\\b 1=1")',
+    'http_request.url.matches("\\bselect\\b")',
+    'http_request.path.matches("x\\.\\b$")',  # \b$ non-word-last: never matches
     'true',
     'false || http_request.path.contains("..")',
     '1 / 0 == 1 || http_request.path == "/x"',  # left error -> no-match
