@@ -185,9 +185,12 @@ class HttpListener:
         self._server: Optional[asyncio.AbstractServer] = None
 
     async def bind(self) -> None:
+        # reuse_port: N processes can share the port for zero-downtime
+        # upgrades (reference listeners/mod.rs:57-61 SO_REUSEPORT).
         self._server = await asyncio.start_server(
             self._serve_connection, self.host, self.port,
-            ssl=self.tls_context, reuse_address=True, backlog=2048)
+            ssl=self.tls_context, reuse_address=True, reuse_port=True,
+            backlog=2048)
 
     @property
     def bound_port(self) -> int:

@@ -167,4 +167,8 @@ async def run(config: Config, **kwargs) -> None:
     serve_task = asyncio.create_task(server.serve_forever())
     await stop_event.wait()
     serve_task.cancel()
-    await server.stop()
+    # Graceful-shutdown cap (reference listeners/mod.rs:28: 20 s).
+    try:
+        await asyncio.wait_for(server.stop(), timeout=20)
+    except asyncio.TimeoutError:
+        pass

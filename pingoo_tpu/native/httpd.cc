@@ -1,0 +1,520 @@
+// Native HTTP data plane: epoll listener -> verdict ring -> 403/proxy.
+//
+// The C++ half of the architecture split (SURVEY.md §7 item 1: "Host
+// data plane (C++): listeners ... proxying"): a non-blocking epoll event
+// loop accepts connections, parses HTTP/1.1 request heads, enqueues the
+// request tuple into the shared-memory verdict ring (pingoo_ring.h), and
+// on the TPU sidecar's verdict either serves 403 / a captcha redirect or
+// proxies the buffered request to the upstream and relays bytes both
+// ways. SO_REUSEPORT allows N listener processes on one port (the
+// reference's zero-downtime upgrade mechanism, listeners/mod.rs:57-61).
+//
+// Event-loop invariants:
+//   * epoll data carries Conn* (nullptr = the listening socket); closes
+//     are deferred to the end of the batch so stale events for a reused
+//     fd can never touch a fresh connection.
+//   * SIGPIPE is ignored; every short/EAGAIN write buffers the
+//     remainder and arms EPOLLOUT, so relayed bytes are never dropped.
+//   * A sidecar stall (verdict ring full) fails OPEN: the request is
+//     proxied without a verdict, mirroring the reference's rule-error
+//     fail-open (pingoo/rules.rs:41-44).
+//   * Idle connections (no complete head, half-open peers) are swept
+//     after kIdleTimeoutS.
+//
+// Scope: HTTP/1.1, Connection: close semantics downstream+upstream.
+// TLS and h2 stay in the Python plane for now.
+//
+// Usage: httpd <listen-port> <ring-file> <upstream-host> <upstream-port>
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/epoll.h>
+#include <sys/mman.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+#include "pingoo_ring.h"
+
+namespace {
+
+constexpr size_t kMaxHead = 32 * 1024;
+constexpr size_t kMaxBuffered = 1 << 20;  // per-direction relay backlog cap
+constexpr time_t kIdleTimeoutS = 30;
+
+enum class ConnState { kReadingHead, kAwaitingVerdict, kProxying, kClosing };
+
+struct Conn;
+
+struct SockRef {
+  Conn* conn = nullptr;  // nullptr = the listening socket
+  bool is_upstream = false;
+};
+
+struct Conn {
+  int fd = -1;
+  int upstream_fd = -1;
+  ConnState state = ConnState::kReadingHead;
+  std::string inbuf;    // buffered request bytes (head + any body read)
+  std::string outbuf;   // bytes pending to client
+  std::string upbuf;    // bytes pending to upstream
+  uint64_t ticket = UINT64_MAX;
+  char peer_ip[INET6_ADDRSTRLEN] = {0};
+  uint16_t peer_port = 0;
+  bool dead = false;           // queued for deferred deletion
+  bool upstream_connected = false;
+  bool client_eof = false;
+  bool upstream_eof = false;
+  time_t last_active = 0;
+  SockRef client_ref;
+  SockRef upstream_ref;
+};
+
+const char k403[] =
+    "HTTP/1.1 403 Forbidden\r\nserver: pingoo\r\n"
+    "content-type: text/plain\r\ncontent-length: 9\r\n"
+    "connection: close\r\n\r\nForbidden";
+const char kCaptcha[] =
+    "HTTP/1.1 302 Found\r\nserver: pingoo\r\n"
+    "location: /__pingoo/captcha\r\ncontent-length: 0\r\n"
+    "connection: close\r\n\r\n";
+const char k502[] =
+    "HTTP/1.1 502 Bad Gateway\r\nserver: pingoo\r\n"
+    "content-type: text/plain\r\ncontent-length: 11\r\n"
+    "connection: close\r\n\r\nBad Gateway";
+const char k400[] =
+    "HTTP/1.1 400 Bad Request\r\nserver: pingoo\r\n"
+    "content-length: 0\r\nconnection: close\r\n\r\n";
+
+struct Parsed {
+  std::string method, target, path, host, user_agent;
+  bool ok = false;
+};
+
+// Minimal HTTP/1.1 head parser: request line + the headers the verdict
+// tuple needs (reference hot path extracts the same fields,
+// http_listener.rs:140-165).
+Parsed parse_head(const std::string& head) {
+  Parsed p;
+  size_t line_end = head.find("\r\n");
+  if (line_end == std::string::npos) return p;
+  const std::string line = head.substr(0, line_end);
+  size_t sp1 = line.find(' ');
+  size_t sp2 = line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 == sp1) return p;
+  p.method = line.substr(0, sp1);
+  p.target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  if (p.method.empty() || p.target.empty() ||
+      line.compare(sp2 + 1, 8, "HTTP/1.1") != 0)
+    return p;
+  size_t q = p.target.find('?');
+  p.path = q == std::string::npos ? p.target : p.target.substr(0, q);
+
+  size_t pos = line_end + 2;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos || eol == pos) break;
+    size_t colon = head.find(':', pos);
+    if (colon != std::string::npos && colon < eol) {
+      std::string name = head.substr(pos, colon - pos);
+      for (auto& ch : name) ch = static_cast<char>(tolower(ch));
+      size_t vstart = colon + 1;
+      while (vstart < eol && head[vstart] == ' ') ++vstart;
+      std::string value = head.substr(vstart, eol - vstart);
+      if (name == "host") {
+        size_t port_colon = value.rfind(':');
+        p.host = port_colon == std::string::npos ? value
+                                                 : value.substr(0, port_colon);
+      } else if (name == "user-agent") {
+        p.user_agent = value;
+      }
+    }
+    pos = eol + 2;
+  }
+  p.ok = true;
+  return p;
+}
+
+class Server {
+ public:
+  Server(int ep, void* ring, const sockaddr_in& upstream)
+      : ep_(ep), ring_(ring), upstream_(upstream) {}
+
+  void add_client(int cfd, const sockaddr_in& peer) {
+    Conn* c = new Conn();
+    c->fd = cfd;
+    c->last_active = now_;
+    c->client_ref.conn = c;
+    c->upstream_ref.conn = c;
+    c->upstream_ref.is_upstream = true;
+    inet_ntop(AF_INET, &peer.sin_addr, c->peer_ip, sizeof(c->peer_ip));
+    c->peer_port = ntohs(peer.sin_port);
+    conns_.insert(c);
+    epoll_event ce{};
+    ce.events = EPOLLIN;
+    ce.data.ptr = &c->client_ref;
+    epoll_ctl(ep_, EPOLL_CTL_ADD, cfd, &ce);
+  }
+
+  void mark_close(Conn* c) {
+    if (c->dead) return;
+    c->dead = true;
+    doomed_.push_back(c);
+  }
+
+  void flush_doomed() {
+    for (Conn* c : doomed_) {
+      if (c->fd >= 0) { epoll_ctl(ep_, EPOLL_CTL_DEL, c->fd, nullptr);
+                        close(c->fd); }
+      if (c->upstream_fd >= 0) { epoll_ctl(ep_, EPOLL_CTL_DEL,
+                                           c->upstream_fd, nullptr);
+                                 close(c->upstream_fd); }
+      if (c->ticket != UINT64_MAX) awaiting_.erase(c->ticket);
+      conns_.erase(c);
+      delete c;
+    }
+    doomed_.clear();
+  }
+
+  void set_now(time_t t) { now_ = t; }
+
+  void sweep_idle() {
+    for (Conn* c : conns_) {
+      if (!c->dead && c->state == ConnState::kReadingHead &&
+          now_ - c->last_active > kIdleTimeoutS) {
+        mark_close(c);
+      }
+    }
+  }
+
+  void arm(Conn* c, int fd, uint32_t events) {
+    epoll_event e{};
+    e.events = events;
+    e.data.ptr = fd == c->upstream_fd ? &c->upstream_ref : &c->client_ref;
+    epoll_ctl(ep_, EPOLL_CTL_MOD, fd, &e);
+  }
+
+  // Queue a canned response and switch to drain-then-close.
+  void respond_close(Conn* c, const char* response) {
+    c->outbuf.append(response);
+    c->state = ConnState::kClosing;
+    arm(c, c->fd, EPOLLOUT);
+  }
+
+  void start_proxy(Conn* c) {
+    int ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (ufd < 0 ||
+        (connect(ufd, reinterpret_cast<const sockaddr*>(&upstream_),
+                 sizeof(upstream_)) != 0 &&
+         errno != EINPROGRESS)) {
+      if (ufd >= 0) close(ufd);
+      respond_close(c, k502);
+      return;
+    }
+    c->upstream_fd = ufd;
+    c->upbuf = c->inbuf;
+    c->state = ConnState::kProxying;
+    upstream_conn_[ufd] = c;
+    epoll_event ue{};
+    ue.events = EPOLLOUT | EPOLLIN;
+    ue.data.ptr = &c->upstream_ref;
+    epoll_ctl(ep_, EPOLL_CTL_ADD, ufd, &ue);
+    arm(c, c->fd, EPOLLIN);
+  }
+
+  void drain_verdicts() {
+    uint64_t ticket;
+    uint8_t action;
+    float score;
+    while (pingoo_ring_poll_verdict(ring_, &ticket, &action, &score) == 0) {
+      auto it = awaiting_.find(ticket);
+      if (it == awaiting_.end()) continue;  // connection died meanwhile
+      Conn* c = it->second;
+      awaiting_.erase(it);
+      c->ticket = UINT64_MAX;
+      if (c->dead) continue;
+      if (action == 1) respond_close(c, k403);
+      else if (action == 2) respond_close(c, kCaptcha);
+      else start_proxy(c);
+    }
+  }
+
+  void on_client_readable(Conn* c) {
+    c->last_active = now_;
+    char buf[16384];
+    ssize_t r;
+    while ((r = read(c->fd, buf, sizeof(buf))) > 0) {
+      c->inbuf.append(buf, static_cast<size_t>(r));
+      if (c->inbuf.size() > kMaxHead) { mark_close(c); return; }
+    }
+    bool eof = (r == 0);
+    size_t head_end = c->inbuf.find("\r\n\r\n");
+    if (head_end == std::string::npos) {
+      // EOF before a complete head: nothing more will arrive.
+      if (eof) mark_close(c);
+      return;
+    }
+    Parsed p = parse_head(c->inbuf.substr(0, head_end + 4));
+    if (!p.ok) { respond_close(c, k400); return; }
+    // Empty UA -> 403 before the ring, like the Python listener
+    // (reference http_listener.rs:196-198).
+    if (p.user_agent.empty()) { respond_close(c, k403); return; }
+    uint8_t ip[16] = {0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0xff, 0xff, 0, 0, 0, 0};
+    in_addr v4{};
+    inet_pton(AF_INET, c->peer_ip, &v4);
+    std::memcpy(ip + 12, &v4, 4);
+    char country[2] = {'X', 'X'};
+    uint64_t ticket = pingoo_ring_enqueue_request(
+        ring_, p.method.data(), p.method.size(), p.host.data(), p.host.size(),
+        p.path.data(), p.path.size(), p.target.data(), p.target.size(),
+        p.user_agent.data(), p.user_agent.size(), ip, c->peer_port, 0,
+        country);
+    if (ticket == UINT64_MAX) {
+      // Verdict ring full (sidecar stalled): FAIL OPEN — proxy without a
+      // verdict, like rule-execution errors in the reference
+      // (pingoo/rules.rs:41-44).
+      start_proxy(c);
+      return;
+    }
+    c->ticket = ticket;
+    c->state = ConnState::kAwaitingVerdict;
+    awaiting_[ticket] = c;
+    arm(c, c->fd, 0);  // quiesce until the verdict arrives
+  }
+
+  // Relay src -> pending-buffer/dst without ever dropping bytes.
+  // Returns false if the connection should close.
+  bool relay(int src, int dst, std::string* pending, bool* src_eof) {
+    // Flush pending first.
+    while (!pending->empty()) {
+      ssize_t w = send(dst, pending->data(), pending->size(), MSG_NOSIGNAL);
+      if (w > 0) {
+        pending->erase(0, static_cast<size_t>(w));
+      } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        break;
+      } else {
+        return false;
+      }
+    }
+    if (!*src_eof && pending->size() < kMaxBuffered) {
+      char buf[16384];
+      ssize_t r;
+      while ((r = read(src, buf, sizeof(buf))) > 0) {
+        size_t off = 0;
+        while (off < static_cast<size_t>(r)) {
+          ssize_t w = send(dst, buf + off, static_cast<size_t>(r) - off,
+                           MSG_NOSIGNAL);
+          if (w > 0) {
+            off += static_cast<size_t>(w);
+          } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            pending->append(buf + off, static_cast<size_t>(r) - off);
+            break;
+          } else {
+            return false;
+          }
+        }
+        if (!pending->empty()) break;  // backpressure: stop reading
+      }
+      if (r == 0) *src_eof = true;
+    }
+    if (*src_eof && pending->empty()) return false;  // finished this way
+    return true;
+  }
+
+  void on_proxy_event(Conn* c, int fd, uint32_t events) {
+    c->last_active = now_;
+    if (fd == c->upstream_fd && !c->upstream_connected &&
+        (events & (EPOLLOUT | EPOLLERR))) {
+      int err = 0;
+      socklen_t len = sizeof(err);
+      getsockopt(c->upstream_fd, SOL_SOCKET, SO_ERROR, &err, &len);
+      if (err != 0) {  // async connect failed -> 502, not an empty reset
+        epoll_ctl(ep_, EPOLL_CTL_DEL, c->upstream_fd, nullptr);
+        close(c->upstream_fd);
+        upstream_conn_.erase(c->upstream_fd);
+        c->upstream_fd = -1;
+        respond_close(c, k502);
+        return;
+      }
+      c->upstream_connected = true;
+    }
+    if (events & (EPOLLHUP | EPOLLERR)) { mark_close(c); return; }
+    // Request direction: client -> upstream (upbuf holds the head).
+    if (!relay(c->fd, c->upstream_fd, &c->upbuf, &c->client_eof)) {
+      if (!c->client_eof) { mark_close(c); return; }
+      // client done sending; keep response direction alive
+    }
+    // Response direction: upstream -> client.
+    if (!relay(c->upstream_fd, c->fd, &c->outbuf, &c->upstream_eof)) {
+      mark_close(c);
+      return;
+    }
+    uint32_t cl_ev = EPOLLIN;
+    if (!c->outbuf.empty()) cl_ev |= EPOLLOUT;
+    arm(c, c->fd, cl_ev);
+    uint32_t up_ev = EPOLLIN;
+    if (!c->upbuf.empty()) up_ev |= EPOLLOUT;
+    arm(c, c->upstream_fd, up_ev);
+  }
+
+  void on_closing_writable(Conn* c) {
+    while (!c->outbuf.empty()) {
+      ssize_t w = send(c->fd, c->outbuf.data(), c->outbuf.size(),
+                       MSG_NOSIGNAL);
+      if (w > 0) {
+        c->outbuf.erase(0, static_cast<size_t>(w));
+      } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        return;
+      } else {
+        break;
+      }
+    }
+    mark_close(c);
+  }
+
+  void handle(Conn* c, int fd, uint32_t events) {
+    if (c->dead) return;  // stale event within this batch
+    switch (c->state) {
+      case ConnState::kReadingHead:
+        if (fd == c->fd && (events & (EPOLLIN | EPOLLHUP)))
+          on_client_readable(c);
+        break;
+      case ConnState::kAwaitingVerdict:
+        if (events & (EPOLLHUP | EPOLLERR)) mark_close(c);
+        break;
+      case ConnState::kProxying:
+        on_proxy_event(c, fd, events);
+        break;
+      case ConnState::kClosing:
+        if (events & (EPOLLHUP | EPOLLERR)) mark_close(c);
+        else if (fd == c->fd && (events & EPOLLOUT)) on_closing_writable(c);
+        break;
+    }
+  }
+
+ private:
+  int ep_;
+  void* ring_;
+  sockaddr_in upstream_;
+  std::unordered_set<Conn*> conns_;
+  std::unordered_map<uint64_t, Conn*> awaiting_;
+  std::unordered_map<int, Conn*> upstream_conn_;
+  std::vector<Conn*> doomed_;
+  time_t now_ = 0;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  if (argc < 5) {
+    std::fprintf(stderr,
+                 "usage: %s <listen-port> <ring-file> <upstream-host> "
+                 "<upstream-port>\n",
+                 argv[0]);
+    return 2;
+  }
+  signal(SIGPIPE, SIG_IGN);  // peer resets must not kill the data plane
+  int listen_port = std::atoi(argv[1]);
+  const char* ring_path = argv[2];
+  const char* up_host = argv[3];
+  const char* up_port = argv[4];
+
+  // Resolve the upstream (numeric or hostname) up front; fail fast.
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(up_host, up_port, &hints, &res) != 0 || res == nullptr) {
+    std::fprintf(stderr, "cannot resolve upstream %s:%s\n", up_host, up_port);
+    return 1;
+  }
+  sockaddr_in upstream{};
+  std::memcpy(&upstream, res->ai_addr, sizeof(upstream));
+  freeaddrinfo(res);
+
+  int rfd = open(ring_path, O_RDWR);
+  if (rfd < 0) { std::perror("open ring"); return 1; }
+  struct stat st;
+  fstat(rfd, &st);
+  void* ring = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                    rfd, 0);
+  if (ring == MAP_FAILED || pingoo_ring_attach(ring, nullptr) != 0) {
+    std::fprintf(stderr, "ring attach failed\n");
+    return 1;
+  }
+
+  int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(listen_port));
+  if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(lfd, 2048) != 0) {
+    std::perror("bind/listen");
+    return 1;
+  }
+
+  int ep = epoll_create1(0);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.ptr = nullptr;  // nullptr marks the listening socket
+  epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev);
+
+  Server server(ep, ring, upstream);
+  std::printf("{\"listening\": %d}\n", listen_port);
+  std::fflush(stdout);
+
+  time_t last_sweep = time(nullptr);
+  while (true) {
+    epoll_event events[256];
+    // Short timeout so verdicts are polled even while sockets are idle.
+    int n = epoll_wait(ep, events, 256, 1);
+    time_t now = time(nullptr);
+    server.set_now(now);
+    server.drain_verdicts();
+
+    for (int i = 0; i < n; ++i) {
+      if (events[i].data.ptr == nullptr) {
+        while (true) {
+          sockaddr_in peer{};
+          socklen_t plen = sizeof(peer);
+          int cfd = accept4(lfd, reinterpret_cast<sockaddr*>(&peer), &plen,
+                            SOCK_NONBLOCK);
+          if (cfd < 0) break;
+          int nd = 1;
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
+          server.add_client(cfd, peer);
+        }
+        continue;
+      }
+      SockRef* ref = static_cast<SockRef*>(events[i].data.ptr);
+      Conn* c = ref->conn;
+      int fd = ref->is_upstream ? c->upstream_fd : c->fd;
+      server.handle(c, fd, events[i].events);
+    }
+    server.flush_doomed();
+    if (now != last_sweep) {
+      server.sweep_idle();
+      server.flush_doomed();
+      last_sweep = now;
+    }
+  }
+  return 0;
+}
