@@ -28,6 +28,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--no-docker", action="store_true")
     parser.add_argument("--cache-dir", default=None,
                         help="compiled-ruleset artifact cache directory")
+    parser.add_argument("--bot-score-params", default=None,
+                        help="npz of trained bot-score head weights "
+                             "(models/botscore.save_params)")
     args = parser.parse_args(argv)
 
     init_logging()
@@ -56,7 +59,8 @@ def main(argv: list[str] | None = None) -> int:
     try:
         asyncio.run(run(config, use_device=not args.no_device,
                         enable_docker=not args.no_docker,
-                        cache_dir=args.cache_dir))
+                        cache_dir=args.cache_dir,
+                        bot_score_params_path=args.bot_score_params))
     except KeyboardInterrupt:
         pass
     finally:

@@ -81,6 +81,7 @@ class ServiceStats:
     batches: int = 0
     requests: int = 0
     device_errors: int = 0
+    score_errors: int = 0
     host_fallback_batches: int = 0
     batch_occupancy_sum: int = 0
     verdict_ms: list = field(default_factory=list)
@@ -91,6 +92,7 @@ class ServiceStats:
             "batches": self.batches,
             "requests": self.requests,
             "device_errors": self.device_errors,
+            "score_errors": self.score_errors,
             "host_fallback_batches": self.host_fallback_batches,
             "mean_occupancy": (self.batch_occupancy_sum / self.batches
                                if self.batches else 0.0),
@@ -110,11 +112,14 @@ class VerdictService:
         max_wait_us: int = 300,
         device: Optional[object] = None,
         use_device: bool = True,
+        bot_score_params: Optional[object] = None,
     ):
         self.plan = plan
         self.lists = lists
         self.max_batch = max_batch
         self.max_wait_s = max_wait_us / 1e6
+        self.bot_score_params = bot_score_params
+        self._score_fn = None
         self.stats = ServiceStats()
         self.use_device = use_device
         self._queue: asyncio.Queue = asyncio.Queue()
@@ -198,7 +203,8 @@ class VerdictService:
         reqs = [r for r, _ in pending]
         t0 = time.monotonic()
         loop = asyncio.get_running_loop()
-        matched = await loop.run_in_executor(None, self._evaluate_sync, reqs)
+        matched, scores = await loop.run_in_executor(
+            None, self._evaluate_with_scores, reqs)
         dt_ms = (time.monotonic() - t0) * 1000
         actions = first_action(self.plan, matched)
         self.stats.batches += 1
@@ -210,24 +216,57 @@ class VerdictService:
         for i, (_, fut) in enumerate(pending):
             if not fut.done():
                 fut.set_result(
-                    Verdict(action=int(actions[i]), matched=matched[i]))
+                    Verdict(action=int(actions[i]), matched=matched[i],
+                            bot_score=float(scores[i])))
 
-    def _evaluate_sync(self, reqs: list[RequestTuple]) -> np.ndarray:
-        n = len(reqs)
+    def _evaluate_with_scores(self, reqs: list[RequestTuple]):
+        """-> (matched [B, R], bot scores [B]). Scores ride the same
+        encoded batch (BASELINE config 5: the vectorized bot head)."""
         batch = encode_requests(reqs, self.plan.field_specs)
+        matched = self._evaluate_sync(reqs, batch)
+        n = len(reqs)
+        scores = np.zeros(n, dtype=np.float32)
+        if self.bot_score_params is not None:
+            try:
+                if self._score_fn is None:
+                    import jax
+
+                    from ..models import botscore
+
+                    self._score_fn = jax.jit(botscore.score)
+                # Pad to the same pow2 shape the verdict used so the
+                # jitted scorer compiles once per bucket, not per
+                # occupancy.
+                padded = pad_batch(batch, self._pow2_size(n))
+                scores = np.asarray(
+                    self._score_fn(self.bot_score_params, padded.arrays),
+                    dtype=np.float32)[:n]
+            except Exception:
+                # Scoring is advisory and never blocks verdicts, but a
+                # broken scorer must show up on the metrics surface.
+                self.stats.score_errors += 1
+        return matched, scores
+
+    def _pow2_size(self, n: int) -> int:
+        target = 1
+        while target < n:
+            target *= 2
+        return max(min(max(target, 8), self.max_batch), n)
+
+    def _evaluate_sync(self, reqs: list[RequestTuple],
+                       batch: Optional[RequestBatch] = None) -> np.ndarray:
+        n = len(reqs)
+        if batch is None:
+            batch = encode_requests(reqs, self.plan.field_specs)
         if self.use_device:
             try:
                 # Stabilize BOTH shape axes: bucket field lengths, and pad
                 # the batch axis to a power of two so arbitrary collector
                 # occupancies don't each compile a fresh XLA program.
                 arrays = bucket_arrays(batch.arrays)
-                target = 1
-                while target < n:
-                    target *= 2
-                target = min(max(target, 8), self.max_batch)
                 fast = pad_batch(
                     RequestBatch(size=batch.size, arrays=arrays),
-                    max(target, n))
+                    self._pow2_size(n))
                 return evaluate_batch(
                     self.plan, self._verdict_fn, self._tables, fast,
                     self.lists)[:n]

@@ -39,6 +39,7 @@ class Server:
         tls_dir: str = "/etc/pingoo/tls",
         enable_docker: bool = True,
         cache_dir: Optional[str] = None,
+        bot_score_params_path: Optional[str] = None,
     ):
         self.config = config
         self.use_device = use_device
@@ -47,6 +48,7 @@ class Server:
         self.tls_dir = tls_dir
         self.enable_docker = enable_docker
         self.cache_dir = cache_dir
+        self.bot_score_params_path = bot_score_params_path
         self.registry: Optional[ServiceRegistry] = None
         self.verdict: Optional[VerdictService] = None
         self.http_listeners: list[HttpListener] = []
@@ -75,7 +77,13 @@ class Server:
 
         plan = compile_ruleset_cached(
             list(config.rules), lists, cache_dir=self.cache_dir)
-        self.verdict = VerdictService(plan, lists, use_device=use_device)
+        bot_params = None
+        if self.bot_score_params_path:
+            from ..models.botscore import load_params
+
+            bot_params = load_params(self.bot_score_params_path)
+        self.verdict = VerdictService(plan, lists, use_device=use_device,
+                                      bot_score_params=bot_params)
         await self.verdict.start()
 
         tls_manager: Optional[TlsManager] = None

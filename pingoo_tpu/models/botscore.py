@@ -62,7 +62,9 @@ def extract_features(arrays: dict) -> jax.Array:
     country_hash = (
         country[:, 0].astype(jnp.int32) * 31 + country[:, 1].astype(jnp.int32)
     ) % 16
-    asn_hash = (arrays["asn"].astype(jnp.int32) * 2654435761 >> 24) % 8
+    asn_hash = (
+        (arrays["asn"].astype(jnp.uint32) * jnp.uint32(2654435761)) >> 24
+    ).astype(jnp.int32) % 8
     port = arrays["remote_port"].astype(f32) / 65535.0
 
     feats = jnp.concatenate(
@@ -125,6 +127,18 @@ def bce_loss(params: Params, feats: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean(
         jnp.maximum(lg, 0) - lg * labels + jnp.log1p(jnp.exp(-jnp.abs(lg)))
     )
+
+
+def save_params(params: Params, path: str) -> None:
+    """Persist trained weights (npz) for the server's --bot-score-params."""
+    np.savez(path, w1=np.asarray(params.w1), b1=np.asarray(params.b1),
+             w2=np.asarray(params.w2), b2=np.asarray(params.b2))
+
+
+def load_params(path: str) -> Params:
+    with np.load(path) as data:
+        return Params(w1=jnp.asarray(data["w1"]), b1=jnp.asarray(data["b1"]),
+                      w2=jnp.asarray(data["w2"]), b2=jnp.asarray(data["b2"]))
 
 
 def make_train_step(learning_rate: float = 1e-3):
