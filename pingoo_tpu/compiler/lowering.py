@@ -41,13 +41,20 @@ from ..expr.values import I64_MAX, I64_MIN, Ip
 from . import repat
 
 # Request byte fields and their device capacities (bytes). The reference
-# caps UA/host at 256 on the hot path (http_listener.rs:159,
-# http_utils.rs:20-21); parity is defined over these truncated views —
-# the host oracle sees the same truncation (engine/batch.py).
+# caps UA at 256 (empty + 403 on overflow, http_listener.rs:159,196-198)
+# and host at 256 (EMPTY on overflow, get_host http_listener.rs:284-296,
+# http_utils.rs:20-21) but matches the FULL path/url. The listener
+# reproduces the UA/host caps before encoding, so those fields never
+# overflow; path/url/method get generous device capacities and any
+# request whose field still exceeds its capacity is re-evaluated on the
+# host interpreter over the UNTRUNCATED strings (engine/service.py), so
+# on the Python plane padding a URL can never bypass a content rule.
+# (The native ring plane carries the same 2048-byte caps in its slots
+# and counts the >2048 residue via PINGOO_SLOT_FLAG_TRUNCATED.)
 DEFAULT_FIELD_SPECS = {
-    "host": 128,
-    "url": 512,
-    "path": 256,
+    "host": 256,
+    "url": 2048,
+    "path": 2048,
     "method": 16,
     "user_agent": 256,
     "country": 2,

@@ -2,11 +2,12 @@
 
 from .. import ops as _ops  # noqa: F401  (enables x64 before tracing)
 from .batch import RequestBatch, RequestTuple, batch_to_contexts, encode_requests, pad_batch
-from .verdict import evaluate_batch, first_action, make_verdict_fn
+from .verdict import action_lanes, evaluate_batch, first_action, make_verdict_fn
 
 __all__ = [
     "RequestBatch",
     "RequestTuple",
+    "action_lanes",
     "batch_to_contexts",
     "encode_requests",
     "evaluate_batch",

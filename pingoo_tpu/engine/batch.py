@@ -6,11 +6,15 @@ tuple shape the reference builds for its bel context (pingoo/rules.rs:
 — and batches them into zero-padded byte tensors + numeric columns.
 
 Truncation policy: every string field is capped at its plan capacity
-(compiler/lowering.DEFAULT_FIELD_SPECS; the reference similarly caps UA
-at 256 bytes on the hot path, http_listener.rs:159). FP/FN parity is
-defined over this truncated view: `batch_to_contexts` rebuilds exactly
-the strings the device saw (latin-1 view of the bytes), and the host
-interpreter oracle evaluates those.
+(compiler/lowering.DEFAULT_FIELD_SPECS; the reference caps UA/host at
+256 on the hot path, http_listener.rs:159,284-296 — the listener applies
+those caps before encoding). A request whose field still exceeds its
+device capacity gets its row flagged in the batch's `overflow` lane and
+is re-evaluated on the host interpreter over the untruncated strings
+(engine/service.py), because the reference matches full path/url and
+truncated matching would let padded URLs slip past content rules.
+`batch_to_contexts` rebuilds the strings the device saw for the
+non-overflowing rows (the parity oracle view).
 """
 
 from __future__ import annotations
@@ -45,10 +49,14 @@ class RequestTuple:
 @dataclass
 class RequestBatch:
     """Fixed-shape encoded batch (numpy; device transfer happens in the
-    engine). A pytree-compatible dict lives in `.arrays`."""
+    engine). A pytree-compatible dict lives in `.arrays`; `overflow` is
+    host-side metadata (rows whose fields exceeded device capacity) and
+    deliberately NOT part of the arrays pytree — it would otherwise ride
+    every device transfer and change jit signatures for nothing."""
 
     size: int
     arrays: dict  # field -> np/jnp arrays
+    overflow: Optional[np.ndarray] = None  # [size] bool or None
 
     def __getitem__(self, key: str):
         return self.arrays[key]
@@ -70,12 +78,16 @@ def encode_requests(
     specs = dict(field_specs or DEFAULT_FIELD_SPECS)
     B = len(requests)
     arrays: dict = {}
+    overflow = np.zeros(B, dtype=bool)
     for field in STRING_FIELDS:
         L = specs.get(field, 256)
         data = np.zeros((B, L), dtype=np.uint8)
         lens = np.zeros(B, dtype=np.int32)
         for i, req in enumerate(requests):
-            raw = _to_bytes(getattr(req, field))[:L]
+            full = _to_bytes(getattr(req, field))
+            if len(full) > L:
+                overflow[i] = True
+            raw = full[:L]
             data[i, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
             lens[i] = len(raw)
         arrays[f"{field}_bytes"] = data
@@ -92,7 +104,7 @@ def encode_requests(
         [_clamp_i64(r.asn) for r in requests], dtype=np.int64)
     arrays["remote_port"] = np.array(
         [_clamp_i64(r.remote_port) for r in requests], dtype=np.int64)
-    return RequestBatch(size=B, arrays=arrays)
+    return RequestBatch(size=B, arrays=arrays, overflow=overflow)
 
 
 def _clamp_i64(v: int) -> int:
@@ -122,7 +134,7 @@ def bucket_arrays(arrays: dict, min_len: int = 16) -> dict:
 
 def pad_batch(batch: RequestBatch, to_size: int) -> RequestBatch:
     """Pad a batch to a fixed size (jit shape stability); padded rows are
-    inert (zero-length fields, ip 0)."""
+    inert (zero-length fields, ip 0, no overflow)."""
     B = batch.size
     if B == to_size:
         return batch
@@ -131,7 +143,11 @@ def pad_batch(batch: RequestBatch, to_size: int) -> RequestBatch:
     for key, arr in batch.arrays.items():
         pad_shape = (to_size - B,) + arr.shape[1:]
         arrays[key] = np.concatenate([arr, np.zeros(pad_shape, dtype=arr.dtype)])
-    return RequestBatch(size=to_size, arrays=arrays)
+    overflow = batch.overflow
+    if overflow is not None:
+        overflow = np.concatenate(
+            [overflow, np.zeros(to_size - B, dtype=bool)])
+    return RequestBatch(size=to_size, arrays=arrays, overflow=overflow)
 
 
 def batch_to_contexts(
@@ -168,6 +184,27 @@ def batch_to_contexts(
         )
         out.append(ctx)
     return out
+
+
+def tuple_to_context(tup: RequestTuple, lists: Mapping[str, list]) -> Context:
+    """Interpreter context straight from the UNTRUNCATED request tuple —
+    used for overflow-row re-evaluation and route matching. The reference
+    builds the same variable shape at http_listener.rs:238-249."""
+    try:
+        ip = Ip(tup.ip)
+    except Exception:
+        ip = Ip("0.0.0.0")
+    return Context({
+        "http_request": {
+            "host": tup.host, "url": tup.url, "path": tup.path,
+            "method": tup.method, "user_agent": tup.user_agent,
+        },
+        "client": {
+            "ip": ip, "remote_port": tup.remote_port,
+            "asn": tup.asn, "country": tup.country,
+        },
+        "lists": dict(lists),
+    })
 
 
 def _words_to_ip(words: np.ndarray) -> Ip:

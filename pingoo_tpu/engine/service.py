@@ -34,8 +34,9 @@ from .batch import (
     bucket_arrays,
     encode_requests,
     pad_batch,
+    tuple_to_context,
 )
-from .verdict import evaluate_batch, first_action, make_verdict_fn
+from .verdict import action_lanes, evaluate_batch, make_verdict_fn
 
 
 def ensure_jax_backend() -> bool:
@@ -63,9 +64,13 @@ def ensure_jax_backend() -> bool:
 
 @dataclass
 class Verdict:
-    action: int  # 0 none, 1 block, 2 captcha
+    action: int  # unverified-client lane: 0 none, 1 block, 2 captcha
     matched: np.ndarray  # [R] bool, original rule order
     bot_score: float = 0.0
+    # Verified-client lane: the reference's action loop skips Captcha
+    # actions for captcha-verified clients but still blocks on any
+    # matched rule carrying Block (http_listener.rs:251-264).
+    verified_block: bool = False
 
     @property
     def block(self) -> bool:
@@ -74,6 +79,13 @@ class Verdict:
     @property
     def captcha(self) -> bool:
         return self.action == 2
+
+    def action_for(self, captcha_verified: bool) -> int:
+        """0 none / 1 block / 2 captcha for this client's verification
+        state — the decision the reference loop would reach."""
+        if captcha_verified:
+            return 1 if self.verified_block else 0
+        return self.action
 
 
 @dataclass
@@ -206,7 +218,7 @@ class VerdictService:
         matched, scores = await loop.run_in_executor(
             None, self._evaluate_with_scores, reqs)
         dt_ms = (time.monotonic() - t0) * 1000
-        actions = first_action(self.plan, matched)
+        actions, verified_block = action_lanes(self.plan, matched)
         self.stats.batches += 1
         self.stats.requests += len(reqs)
         self.stats.batch_occupancy_sum += len(reqs)
@@ -217,7 +229,8 @@ class VerdictService:
             if not fut.done():
                 fut.set_result(
                     Verdict(action=int(actions[i]), matched=matched[i],
-                            bot_score=float(scores[i])))
+                            bot_score=float(scores[i]),
+                            verified_block=bool(verified_block[i])))
 
     def _evaluate_with_scores(self, reqs: list[RequestTuple]):
         """-> (matched [B, R], bot scores [B]). Scores ride the same
@@ -258,6 +271,7 @@ class VerdictService:
         n = len(reqs)
         if batch is None:
             batch = encode_requests(reqs, self.plan.field_specs)
+        matched = None
         if self.use_device:
             try:
                 # Stabilize BOTH shape axes: bucket field lengths, and pad
@@ -267,13 +281,30 @@ class VerdictService:
                 fast = pad_batch(
                     RequestBatch(size=batch.size, arrays=arrays),
                     self._pow2_size(n))
-                return evaluate_batch(
+                matched = evaluate_batch(
                     self.plan, self._verdict_fn, self._tables, fast,
                     self.lists)[:n]
             except Exception:
                 self.stats.device_errors += 1
-        self.stats.host_fallback_batches += 1
-        return self._evaluate_host(batch)
+        if matched is None:
+            self.stats.host_fallback_batches += 1
+            matched = self._evaluate_host(batch)
+        return self._rewrite_overflow_rows(reqs, batch, matched)
+
+    def _rewrite_overflow_rows(self, reqs, batch, matched: np.ndarray):
+        """Rows whose fields exceeded device capacity are re-evaluated on
+        the host interpreter over the UNTRUNCATED strings — the reference
+        matches full path/url (pingoo/rules.rs:37-51), so parity for
+        over-long requests cannot be defined over the truncated view."""
+        overflow = batch.overflow
+        if overflow is None or not overflow[: len(reqs)].any():
+            return matched
+        from .verdict import interpret_rules_row
+
+        for i in np.nonzero(overflow[: len(reqs)])[0]:
+            ctx = tuple_to_context(reqs[i], self.lists)
+            matched[i, :] = interpret_rules_row(self.plan, ctx)
+        return matched
 
     def _evaluate_host(self, batch: RequestBatch) -> np.ndarray:
         """Interpreter path: the CPU engine (also the watchdog fallback)."""

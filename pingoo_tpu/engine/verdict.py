@@ -338,15 +338,54 @@ def evaluate_batch(plan, verdict_fn, tables, batch, lists) -> np.ndarray:
     return out
 
 
-def first_action(plan: RulesetPlan, matched: np.ndarray) -> np.ndarray:
-    """First-match action per request (reference http_listener.rs:251-264):
-    0 = none, 1 = block, 2 = captcha. Vectorized — runs on the per-batch
-    decision path."""
-    rule_actions = np.zeros(len(plan.rules), dtype=np.int32)
+def interpret_rules_row(plan: RulesetPlan, ctx) -> np.ndarray:
+    """One request's full match row via the host interpreter (the parity
+    oracle): always-rules match, errors fail open (pingoo/rules.rs:41-44).
+    Used for overflow rows whose fields exceeded device capacity."""
+    row = np.zeros(len(plan.rules), dtype=bool)
+    for rule in plan.rules:
+        if rule.always:
+            row[rule.index] = True
+            continue
+        try:
+            row[rule.index] = execute_as_bool(rule.program, ctx)
+        except Exception:
+            row[rule.index] = False
+    return row
+
+
+def action_lanes(plan: RulesetPlan,
+                 matched: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Per-request action decision as TWO lanes, reproducing the
+    reference's rules/actions loop (http_listener.rs:251-264) for both
+    captcha-verification states — a single collapsed action cannot,
+    because the loop *continues past* Captcha actions for verified
+    clients (a matched [Captcha, Block] rule or a later Block rule must
+    still block them).
+
+      unverified [B] int32: 0 none / 1 block / 2 captcha — the first
+        matched rule with actions decides via its first action (for an
+        unverified client both Block and Captcha terminate the loop).
+      verified_block [B] bool: whether a VERIFIED client is blocked —
+        true iff any matched rule carries a Block action anywhere in its
+        action list (Captcha actions are skipped for verified clients).
+    """
+    rule_first = np.zeros(len(plan.rules), dtype=np.int32)
+    rule_has_block = np.zeros(len(plan.rules), dtype=bool)
     for r in plan.rules:
         if r.actions:
-            rule_actions[r.index] = 1 if r.actions[0] == Action.BLOCK else 2
-    acting = matched & (rule_actions != 0)[None, :]  # [B, R]
+            rule_first[r.index] = 1 if r.actions[0] == Action.BLOCK else 2
+            rule_has_block[r.index] = Action.BLOCK in r.actions
+    acting = matched & (rule_first != 0)[None, :]  # [B, R]
     any_hit = acting.any(axis=1)
     first = np.argmax(acting, axis=1)  # first True column (0 if none)
-    return np.where(any_hit, rule_actions[first], 0).astype(np.int32)
+    unverified = np.where(any_hit, rule_first[first], 0).astype(np.int32)
+    verified_block = (matched & rule_has_block[None, :]).any(axis=1)
+    return unverified, verified_block
+
+
+def first_action(plan: RulesetPlan, matched: np.ndarray) -> np.ndarray:
+    """The unverified-client lane of `action_lanes` (0 none / 1 block /
+    2 captcha). Consumers that can see captcha-verified clients must use
+    both lanes."""
+    return action_lanes(plan, matched)[0]

@@ -37,7 +37,6 @@ from typing import Optional
 
 import h11
 
-from ..config.schema import Action
 from ..engine.batch import RequestTuple
 from ..engine.service import VerdictService
 from ..expr import Context
@@ -118,39 +117,27 @@ def _strip_port(authority: str) -> str:
 
 
 def get_host(req: Request) -> str:
-    """Host from the request target or Host header (:284-296)."""
+    """Host from the request target or Host header (:284-296). Over-long
+    hosts become EMPTY, not truncated (heapless from_str overflow ->
+    unwrap_or_default, http_listener.rs:287,292)."""
     if req.target.startswith("http://") or req.target.startswith("https://"):
         rest = req.target.split("://", 1)[1]
-        return _strip_port(rest.split("/", 1)[0])[:HOSTNAME_MAX_LENGTH]
-    for name, value in req.headers:
-        if name.lower() == "host":
-            return _strip_port(value)[:HOSTNAME_MAX_LENGTH]
-    return ""
+        host = _strip_port(rest.split("/", 1)[0])
+    else:
+        host = ""
+        for name, value in req.headers:
+            if name.lower() == "host":
+                host = _strip_port(value)
+                break
+    return host if len(host) <= HOSTNAME_MAX_LENGTH else ""
 
 
 def request_tuple_to_context(tup: RequestTuple, lists: dict) -> Context:
-    """Interpreter context for route matching — same variable shape as
-    the verdict engine's truncated view (engine/batch.py)."""
-    return Context({
-        "http_request": {
-            "host": tup.host, "url": tup.url, "path": tup.path,
-            "method": tup.method, "user_agent": tup.user_agent,
-        },
-        "client": {
-            "ip": _ip_value(tup.ip), "remote_port": tup.remote_port,
-            "asn": tup.asn, "country": tup.country,
-        },
-        "lists": lists,
-    })
+    """Interpreter context for route matching (engine/batch.py owns the
+    shared construction)."""
+    from ..engine.batch import tuple_to_context
 
-
-def _ip_value(text: str):
-    from ..expr import Ip
-
-    try:
-        return Ip(text)
-    except Exception:
-        return Ip("0.0.0.0")
+    return tuple_to_context(tup, lists)
 
 
 class HttpListener:
@@ -164,7 +151,7 @@ class HttpListener:
         services: list,  # (service, is proxy/static objects with .route)
         verdict: VerdictService,
         lists: dict,
-        rules_meta: list,  # plan.rules (names/actions/order)
+        rules_meta: list,  # plan.rules (kept for metrics/introspection)
         captcha: CaptchaManager,
         geoip: Optional[GeoipDB] = None,
         tls_context=None,
@@ -367,17 +354,17 @@ class HttpListener:
             user_agent=user_agent, ip=client_ip, remote_port=client_port,
             asn=geoip_record.asn, country=geoip_record.country)
 
-        # RULES LOOP (:251-264) over the batched verdict row.
+        # RULES LOOP (:251-264): the engine's action lanes reproduce the
+        # reference loop for both captcha states (engine/verdict.py
+        # action_lanes — verified clients skip Captcha actions but still
+        # block on any matched Block).
         verdict = await self.verdict.evaluate(tup)
-        for rule in self.rules_meta:
-            if not verdict.matched[rule.index]:
-                continue
-            for action in rule.actions:
-                if action == Action.BLOCK:
-                    self.stats.blocked += 1
-                    return blocked_response()
-                if action == Action.CAPTCHA and not captcha_verified:
-                    return self._serve_captcha()
+        action = verdict.action_for(captcha_verified)
+        if action == 1:
+            self.stats.blocked += 1
+            return blocked_response()
+        if action == 2:
+            return self._serve_captcha()
 
         # ROUTING LOOP (:266-270).
         route_ctx = request_tuple_to_context(tup, self.lists)

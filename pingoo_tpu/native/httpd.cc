@@ -247,8 +247,12 @@ class Server {
       awaiting_.erase(it);
       c->ticket = UINT64_MAX;
       if (c->dead) continue;
-      if (action == 1) respond_close(c, k403);
-      else if (action == 2) respond_close(c, kCaptcha);
+      // Verdict byte: bits 0-1 = unverified-client action, bit 2 =
+      // verified-client block (native_ring.py RingSidecar). Clients are
+      // treated as unverified until the cookie gate lands here.
+      uint8_t unverified = action & 3;
+      if (unverified == 1) respond_close(c, k403);
+      else if (unverified == 2) respond_close(c, kCaptcha);
       else start_proxy(c);
     }
   }
@@ -270,9 +274,17 @@ class Server {
     }
     Parsed p = parse_head(c->inbuf.substr(0, head_end + 4));
     if (!p.ok) { respond_close(c, k400); return; }
-    // Empty UA -> 403 before the ring, like the Python listener
-    // (reference http_listener.rs:196-198).
-    if (p.user_agent.empty()) { respond_close(c, k403); return; }
+    // Empty or oversized UA -> 403 before the ring. The >= is the
+    // reference's own explicit check (http_listener.rs:196: len >=
+    // USER_AGENT_MAX_LENGTH blocks an exactly-256-byte UA); the host
+    // cap below is the different, implicit heapless-overflow rule.
+    if (p.user_agent.empty() || p.user_agent.size() >= 256) {
+      respond_close(c, k403);
+      return;
+    }
+    // Over-long host becomes EMPTY, not truncated (reference get_host,
+    // http_listener.rs:284-296).
+    if (p.host.size() > 256) p.host.clear();
     uint8_t ip[16] = {0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0xff, 0xff, 0, 0, 0, 0};
     in_addr v4{};
     inet_pton(AF_INET, c->peer_ip, &v4);

@@ -119,8 +119,10 @@ int main(int argc, char** argv) {
     float score;
     while (pingoo_ring_poll_verdict(mem, &ticket, &action, &score) == 0) {
       ++received;
-      if (action == 1) ++blocked;
-      if (action == 2) ++captcha;
+      // Bits 0-1 = unverified-client action; bit 2 = verified-block
+      // lane (native_ring.py RingSidecar).
+      if ((action & 3) == 1) ++blocked;
+      if ((action & 3) == 2) ++captcha;
     }
   }
   auto t1 = std::chrono::steady_clock::now();

@@ -27,12 +27,15 @@ Layout layout(void* mem, uint32_t capacity) {
   return l;
 }
 
-inline void copy_capped(char* dst, uint32_t cap, const char* src, uint32_t len,
+// Returns true if the source exceeded the cap (the slot then carries a
+// truncated view and must be flagged for off-device re-evaluation).
+inline bool copy_capped(char* dst, uint32_t cap, const char* src, uint32_t len,
                         uint16_t* len_out) {
   uint32_t n = len < cap ? len : cap;
   std::memcpy(dst, src, n);
   if (n < cap) std::memset(dst + n, 0, cap - n);
   *len_out = static_cast<uint16_t>(n);
+  return len > cap;
 }
 
 }  // namespace
@@ -90,20 +93,23 @@ uint64_t pingoo_ring_enqueue_request(
       if (head->compare_exchange_weak(pos, pos + 1,
                                       std::memory_order_relaxed)) {
         slot->ticket = pos;
-        copy_capped(slot->method, PINGOO_METHOD_CAP, method, method_len,
-                    &slot->method_len);
-        copy_capped(slot->host, PINGOO_HOST_CAP, host, host_len,
-                    &slot->host_len);
-        copy_capped(slot->path, PINGOO_PATH_CAP, path, path_len,
-                    &slot->path_len);
-        copy_capped(slot->url, PINGOO_URL_CAP, url, url_len, &slot->url_len);
-        copy_capped(slot->user_agent, PINGOO_UA_CAP, ua, ua_len,
-                    &slot->ua_len);
+        bool truncated = false;
+        truncated |= copy_capped(slot->method, PINGOO_METHOD_CAP, method,
+                                 method_len, &slot->method_len);
+        truncated |= copy_capped(slot->host, PINGOO_HOST_CAP, host, host_len,
+                                 &slot->host_len);
+        truncated |= copy_capped(slot->path, PINGOO_PATH_CAP, path, path_len,
+                                 &slot->path_len);
+        truncated |= copy_capped(slot->url, PINGOO_URL_CAP, url, url_len,
+                                 &slot->url_len);
+        truncated |= copy_capped(slot->user_agent, PINGOO_UA_CAP, ua, ua_len,
+                                 &slot->ua_len);
         std::memcpy(slot->ip, ip, 16);
         slot->remote_port = remote_port;
         slot->asn = asn;
         slot->country[0] = country[0];
         slot->country[1] = country[1];
+        slot->flags = truncated ? PINGOO_SLOT_FLAG_TRUNCATED : 0;
         as_atomic(&slot->seq)->store(pos + 1, std::memory_order_release);
         return pos;
       }

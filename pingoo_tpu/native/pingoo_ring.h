@@ -10,9 +10,14 @@
 // sidecar drains batches, and verdicts flow back keyed by ticket id.
 //
 // The slot field layout mirrors pingoo_tpu/engine/batch.py field specs
-// (method 16 / host 128 / path 256 / url 512 / user_agent 256 bytes,
+// (method 16 / host 256 / path 2048 / url 2048 / user_agent 256 bytes,
 // v6-mapped ip words, asn/port columns) so the Python side can decode a
 // whole batch with one numpy structured view, no per-field parsing.
+// A request whose field exceeded its cap at enqueue time carries
+// PINGOO_SLOT_FLAG_TRUNCATED. The sidecar counts flagged rows
+// (RingSidecar.truncated_rows): on this plane they are matched on the
+// slot view (first 2048 bytes) — the Python listener re-evaluates such
+// requests over fully untruncated strings (engine/service.py).
 
 #ifndef PINGOO_RING_H_
 #define PINGOO_RING_H_
@@ -33,13 +38,15 @@ extern "C" {
 #endif
 
 #define PINGOO_RING_MAGIC 0x50474f52u  // "PGOR"
-#define PINGOO_RING_VERSION 1u
+#define PINGOO_RING_VERSION 2u
 
 #define PINGOO_METHOD_CAP 16
-#define PINGOO_HOST_CAP 128
-#define PINGOO_PATH_CAP 256
-#define PINGOO_URL_CAP 512
+#define PINGOO_HOST_CAP 256
+#define PINGOO_PATH_CAP 2048
+#define PINGOO_URL_CAP 2048
 #define PINGOO_UA_CAP 256
+
+#define PINGOO_SLOT_FLAG_TRUNCATED 0x1u
 
 typedef struct {
   // Vyukov slot sequence: slot is writable when seq == pos, readable
@@ -51,7 +58,8 @@ typedef struct {
   uint8_t ip[16];  // big-endian, v4 addresses v6-mapped (::ffff:a.b.c.d)
   uint32_t asn;
   char country[2];
-  char _pad[2];
+  uint8_t flags;  // PINGOO_SLOT_FLAG_* (set by enqueue)
+  char _pad;
   char method[PINGOO_METHOD_CAP];
   char host[PINGOO_HOST_CAP];
   char path[PINGOO_PATH_CAP];
@@ -62,7 +70,11 @@ typedef struct {
 typedef struct {
   PINGOO_ALIGN8 uint64_t seq;
   uint64_t ticket;
-  uint8_t action;  // 0 none, 1 block, 2 captcha
+  // Two-lane encoding (the reference action loop diverges per client
+  // captcha state, http_listener.rs:251-264): bits 0-1 = action for an
+  // UNVERIFIED client (0 none, 1 block, 2 captcha); bit 2 = a VERIFIED
+  // client must be blocked. Consumers mask: (action & 3) / (action & 4).
+  uint8_t action;
   uint8_t _pad[3];
   float bot_score;
 } PingooVerdictSlot;

@@ -26,8 +26,10 @@ import numpy as np
 NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 LIB_PATH = os.path.join(NATIVE_DIR, "libpingoo_ring.so")
 
-FIELD_CAPS = {"method": 16, "host": 128, "path": 256, "url": 512,
+FIELD_CAPS = {"method": 16, "host": 256, "path": 2048, "url": 2048,
               "user_agent": 256}
+
+SLOT_FLAG_TRUNCATED = 0x1  # PINGOO_SLOT_FLAG_TRUNCATED
 
 # numpy mirror of PingooRequestSlot (natural alignment, no padding holes
 # beyond the explicit _pad).
@@ -40,15 +42,16 @@ REQUEST_SLOT_DTYPE = np.dtype([
     ("ip", "u1", 16),
     ("asn", "<u4"),
     ("country", "S2"),
-    ("_pad", "S2"),
+    ("flags", "u1"),
+    ("_pad", "S1"),
     ("method", "u1", 16),
-    ("host", "u1", 128),
-    ("path", "u1", 256),
-    ("url", "u1", 512),
+    ("host", "u1", 256),
+    ("path", "u1", 2048),
+    ("url", "u1", 2048),
     ("user_agent", "u1", 256),
-    ("_tail_pad", "S4"),  # C struct pads to 8-byte alignment (1224 bytes)
+    ("_tail_pad", "S4"),  # C struct pads to 8-byte alignment (4680 bytes)
 ])
-assert REQUEST_SLOT_DTYPE.itemsize == 1224, REQUEST_SLOT_DTYPE.itemsize
+assert REQUEST_SLOT_DTYPE.itemsize == 4680, REQUEST_SLOT_DTYPE.itemsize
 
 
 def ensure_built() -> bool:
@@ -187,7 +190,7 @@ class RingSidecar:
 
     def __init__(self, ring: Ring, plan, lists, max_batch: int = 1024,
                  idle_sleep_s: float = 0.0002):
-        from .engine.verdict import first_action, make_verdict_fn
+        from .engine.verdict import action_lanes, make_verdict_fn
 
         self.ring = ring
         self.plan = plan
@@ -195,14 +198,15 @@ class RingSidecar:
         self.max_batch = max_batch
         self.idle_sleep_s = idle_sleep_s
         self._verdict_fn = make_verdict_fn(plan)
-        self._first_action = first_action
+        self._action_lanes = action_lanes
         self._tables = plan.device_tables()
         self.processed = 0
+        self.truncated_rows = 0
         self._stop = False
 
     def run(self, max_requests: Optional[int] = None) -> int:
         """Blocking drain loop; returns requests processed."""
-        from .engine.batch import RequestBatch, pad_batch
+        from .engine.batch import RequestBatch, bucket_arrays, pad_batch
         from .engine.verdict import evaluate_batch
 
         while not self._stop:
@@ -213,17 +217,32 @@ class RingSidecar:
                 time.sleep(self.idle_sleep_s)
                 continue
             n = len(slots)
-            # Fixed batch shape: a partial batch would otherwise be a new
-            # XLA program (compile stall on the serving path). Length
-            # bucketing is skipped here for the same reason — the ring
-            # path prefers one stable shape over minimal scan length.
+            # Pad the batch axis to one fixed shape (a partial batch would
+            # otherwise be a new XLA program — compile stall on the
+            # serving path) and bucket field lengths to powers of two so
+            # the NFA scan walks the batch's longest value, not the
+            # 2048-byte slot capacity (engine/batch.bucket_arrays; at most
+            # log2(cap) shapes per field).
             batch = pad_batch(
-                RequestBatch(size=n, arrays=slots_to_arrays(slots)),
+                RequestBatch(size=n, arrays=bucket_arrays(slots_to_arrays(slots))),
                 self.max_batch)
             matched = evaluate_batch(
                 self.plan, self._verdict_fn, self._tables, batch,
                 self.lists)[:n]
-            actions = self._first_action(self.plan, matched)
+            # Rows the producer flagged as truncated (a field exceeded
+            # its 2048-byte slot cap) were matched on the slot view —
+            # the widest bytes this plane carries. Count them so the
+            # residual truncation window (>2048B fields) is observable;
+            # the Python plane re-evaluates such rows on fully
+            # untruncated strings (engine/service.py).
+            self.truncated_rows += int(
+                ((slots["flags"] & SLOT_FLAG_TRUNCATED) != 0).sum())
+            # Verdict byte carries BOTH client-state lanes (the reference
+            # action loop diverges for captcha-verified clients,
+            # http_listener.rs:251-264): bits 0-1 = unverified action
+            # (0 none / 1 block / 2 captcha), bit 2 = verified-block.
+            unverified, verified_block = self._action_lanes(self.plan, matched)
+            actions = unverified | (verified_block.astype(np.int32) << 2)
             tickets = slots["ticket"]
             for i in range(n):
                 while not self.ring.post_verdict(

@@ -194,6 +194,20 @@ class TestHostParsing:
                       path="/x", headers=[])
         assert get_host(req) == "[2001:db8::1]"
 
+    def test_overlong_host_becomes_empty(self):
+        """Reference get_host: heapless from_str overflow -> EMPTY, not
+        truncated (http_listener.rs:287,292)."""
+        from pingoo_tpu.host.httpd import Request, get_host
+
+        long_host = "a" * 300 + ".example.com"
+        req = Request(method="GET", target="/", path="/",
+                      headers=[("host", long_host)])
+        assert get_host(req) == ""
+        ok = "b" * 256  # exactly at the cap still fits
+        req = Request(method="GET", target="/", path="/",
+                      headers=[("host", ok)])
+        assert get_host(req) == ok
+
 
 class TestRingCapacityValidation:
     def test_non_pow2_rejected(self, tmp_path):
@@ -265,3 +279,59 @@ class TestVerdictServiceFallback:
 
         v1, v2 = loop_runner.run(flow())
         assert v1.action == 0 and v2.action == 0  # fail-open, not hung
+
+
+class TestOverflowRouting:
+    """Fields past device capacity -> host interpreter over the FULL
+    strings (reference matches full path/url; padding must not bypass)."""
+
+    def _service(self, expr, use_device):
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.engine.service import VerdictService
+        from pingoo_tpu.expr import compile_expression
+
+        rules = [RuleConfig(name="r", actions=(Action.BLOCK,),
+                            expression=compile_expression(expr))]
+        plan = compile_ruleset(rules, {})
+        return plan, VerdictService(plan, {}, use_device=use_device,
+                                    max_wait_us=100)
+
+    @pytest.mark.parametrize("use_device", [True, False])
+    def test_padded_url_cannot_bypass_contains(self, use_device):
+        from pingoo_tpu.engine.batch import RequestTuple
+
+        plan, svc = self._service(
+            'http_request.url.contains("attackmarker")', use_device)
+        cap = plan.field_specs["url"]
+        long_url = "/" + "A" * (cap + 100) + "attackmarker"
+        matched = svc._evaluate_sync([
+            RequestTuple(url=long_url, path="/x"),
+            RequestTuple(url="/clean", path="/x"),
+            RequestTuple(url="/attackmarker", path="/x"),
+        ])
+        assert matched[0, 0], "marker past device cap must still match"
+        assert not matched[1, 0]
+        assert matched[2, 0]
+
+    def test_overflow_length_uses_full_string(self):
+        from pingoo_tpu.engine.batch import RequestTuple
+
+        plan, svc = self._service("length(http_request.path) > 3000", False)
+        cap = plan.field_specs["path"]
+        matched = svc._evaluate_sync([
+            RequestTuple(path="/" + "p" * 3200),
+            RequestTuple(path="/" + "p" * (cap - 10)),
+        ])
+        assert matched[0, 0]
+        assert not matched[1, 0]
+
+    def test_encode_marks_overflow_rows(self):
+        from pingoo_tpu.engine.batch import RequestTuple, encode_requests
+
+        batch = encode_requests([
+            RequestTuple(url="/" + "x" * 5000),
+            RequestTuple(url="/short"),
+        ])
+        assert batch.overflow.tolist() == [True, False]
+        assert "overflow" not in batch.arrays  # never rides the pytree

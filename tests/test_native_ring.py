@@ -50,6 +50,46 @@ class TestRingBasics:
         finally:
             ring.close()
 
+    def test_padded_url_matches_on_ring_plane(self, tmp_path):
+        """A marker past the OLD 512-byte cap must still match through
+        the ring (slot caps now equal the 2048-byte device caps), and
+        >2048-byte fields set the truncation flag."""
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.engine.verdict import evaluate_batch, first_action
+        from pingoo_tpu.expr import compile_expression
+
+        rules = [RuleConfig(
+            name="r", actions=(Action.BLOCK,),
+            expression=compile_expression(
+                'http_request.url.contains("evilmarker")'))]
+        plan = compile_ruleset(rules, {})
+
+        ring = Ring(str(tmp_path / "ring"), capacity=64, create=True)
+        try:
+            padded = b"/" + b"A" * 900 + b"evilmarker"  # past old 512 cap
+            huge = b"/" + b"B" * 3000  # past the 2048 slot cap
+            ring.enqueue(url=padded, path=b"/x", user_agent=b"ua")
+            ring.enqueue(url=b"/clean", path=b"/x", user_agent=b"ua")
+            ring.enqueue(url=huge, path=b"/x", user_agent=b"ua")
+            slots = ring.dequeue_batch()
+            assert len(slots) == 3
+            flags = slots["flags"] & native_ring.SLOT_FLAG_TRUNCATED
+            assert flags.tolist() == [0, 0, 1]
+            assert slots["url_len"].tolist() == [911, 6, 2048]
+
+            sidecar = RingSidecar(ring, plan, {}, max_batch=8)
+            from pingoo_tpu.engine.batch import RequestBatch, bucket_arrays
+
+            batch = RequestBatch(size=3,
+                                 arrays=bucket_arrays(slots_to_arrays(slots)))
+            matched = evaluate_batch(plan, sidecar._verdict_fn,
+                                     sidecar._tables, batch, {})
+            acts = first_action(plan, matched)
+            assert acts.tolist() == [1, 0, 0]
+        finally:
+            ring.close()
+
     def test_ring_full_and_wraparound(self, tmp_path):
         ring = Ring(str(tmp_path / "ring"), capacity=8, create=True)
         try:

@@ -174,6 +174,41 @@ class TestDeviceParity:
         acts = first_action(plan, matched)
         assert acts.tolist() == [2, 1]  # captcha first for /a, block for /b
 
+    def test_action_lanes_verified_fallthrough(self):
+        """Reference action loop (http_listener.rs:251-264): a verified
+        client skips Captcha actions but must still hit Block actions —
+        in the SAME rule ([Captcha, Block]) or in a LATER matched rule."""
+        from pingoo_tpu.engine.verdict import action_lanes
+
+        rules = [
+            # /a: captcha-then-block rule — unverified gets captcha,
+            # verified must be BLOCKED by the second action.
+            RuleConfig(name="cb",
+                       expression=compile_expression('http_request.path == "/a"'),
+                       actions=(Action.CAPTCHA, Action.BLOCK)),
+            # /b: captcha-only rule followed by a block rule — verified
+            # clients fall through the first and hit the second.
+            RuleConfig(name="c",
+                       expression=compile_expression('http_request.path == "/b"'),
+                       actions=(Action.CAPTCHA,)),
+            RuleConfig(name="b",
+                       expression=compile_expression('http_request.path == "/b"'),
+                       actions=(Action.BLOCK,)),
+            # /c: captcha-only — verified clients pass entirely.
+            RuleConfig(name="conly",
+                       expression=compile_expression('http_request.path == "/c"'),
+                       actions=(Action.CAPTCHA,)),
+        ]
+        plan = compile_ruleset(rules, {})
+        verdict_fn = make_verdict_fn(plan)
+        batch = encode_requests([RequestTuple(path=p)
+                                 for p in ("/a", "/b", "/c", "/d")])
+        matched = evaluate_batch(plan, verdict_fn, plan.device_tables(),
+                                 batch, {})
+        unverified, verified_block = action_lanes(plan, matched)
+        assert unverified.tolist() == [2, 2, 2, 0]
+        assert verified_block.tolist() == [True, True, False, False]
+
     def test_fuzzed_numeric_rules(self):
         rng = random.Random(45)
         sources = []
