@@ -444,12 +444,12 @@ class Lowerer:
             if isinstance(recv, LStrField) and isinstance(arg, LStrLit):
                 try:
                     alts = repat.compile_regex(arg.value)
-                    from .nfa import WORD_BITS, scan_bits_needed
+                    from .nfa import MAX_SCAN_BITS, scan_bits_needed
 
                     for lp in alts:
-                        if scan_bits_needed(lp) > WORD_BITS:
+                        if scan_bits_needed(lp) > MAX_SCAN_BITS:
                             raise repat.Unsupported(
-                                "expanded pattern exceeds one state word")
+                                "expanded pattern exceeds the multi-word cap")
                 except repat.Unsupported as exc:
                     raise LowerError(f"regex outside device subset: {exc}")
                 except Exception:
@@ -486,10 +486,10 @@ class Lowerer:
                 lit = _lit_bytes(arg.value)
                 if lit is None:
                     return LBool(BConst(False))  # >0xFF chars never match
-                from .nfa import WORD_BITS
+                from .nfa import MAX_SCAN_BITS
 
-                if len(lit) + 2 > WORD_BITS:  # guard + positions + sticky
-                    raise LowerError("contains literal too long for NFA word")
+                if len(lit) + 2 > MAX_SCAN_BITS:  # guard + positions + sticky
+                    raise LowerError("contains literal too long for NFA span")
                 leaf = self.reg.add(
                     NfaPred(field=recv.field, kind="contains", pattern=arg.value))
                 return LBool(BLeaf(leaf))

@@ -2,22 +2,29 @@
 
 An `NfaBank` holds every contains/regex predicate that scans one request
 field (path, url, host, user_agent, ...). Patterns are packed into uint32
-words — one guard bit + one bit per position, each pattern confined to a
-single word — and executed as extended Shift-And (Glushkov over linear
-patterns) with pure bitwise ops:
+words — one guard bit + one bit per position — and executed as extended
+Shift-And (Glushkov over linear patterns) with pure bitwise ops:
 
     inj  = INIT_unanchored | (t == 0 ? INIT_anchored : 0)
-    adv  = (S << 1) | inj
+    adv  = (S << 1) | inj | word_carry(S)   # bit31 -> bit0 of next word
     adv |= ((adv & OPT) + OPT) ^ OPT        # skip optional runs (carry trick)
     pre  = adv | (S & REP)                  # self-loops for x* / x+
     S'   = pre & B[c]                       # byte-class transition
-    float_matches |= S' & LAST_FLOAT        # accept for non-$ patterns
-    ...after the scan: end_matches = S_final & LAST_END   # $ patterns
 
 The optional-skip identity: within a run of consecutive OPT bits, adding
 (adv & OPT) to OPT carries through the run; XOR with OPT recovers every
 position from the first active bit through one past the run's end —
 exactly the Glushkov epsilon-skip closure for linear patterns.
+
+Multi-word patterns (> ~31 positions after expansion — the OWASP-CRS
+long literals and bounded-repeat classes): a pattern spanning k uint32
+words gets a DEDICATED run of consecutive words. Advancement crosses
+word boundaries through `carry_mask` (bit31 of word w feeds bit0 of
+word w+1 where enabled), and the optional-skip closure crosses through
+its add-carry: a run reaching bit31 overflows the uint32 add, detected
+as `sum < OPT`, and re-injected at bit0 of the next word before another
+propagation pass. The number of passes is static per bank
+(1 + max word boundaries any optional run crosses).
 
 This module builds the (numpy) tables; ops/nfa_scan.py executes them in
 JAX; `simulate` is the pure-Python oracle used by differential tests
@@ -34,6 +41,10 @@ import numpy as np
 from .repat import LinearPattern, Pos, Quant, Unsupported
 
 WORD_BITS = 32
+# Device-residency cap for one pattern's expanded footprint (guards +
+# positions + sticky bits across all alternatives). 128 bits = a 4-word
+# span; anything larger is Unsupported -> host-interpreted rule.
+MAX_SCAN_BITS = 128
 
 
 def _skippable(p: Pos) -> bool:
@@ -149,14 +160,16 @@ class PatternSlot:
     """Where one input pattern lives in the bank + accept metadata.
 
     With sticky-accept compilation every accept is read from the FINAL
-    scan state: `hit = (S_final[word] & accept_mask) != 0`, plus the
-    always/empty flags. There is no float/end distinction at scan time —
-    `$`, trailing newlines, and \\b variants were compiled into extra
-    positions/alternatives (see _expand_scan_patterns).
+    scan state: `hit = any((S_final[word] & mask) != 0 for word, mask in
+    accepts)`, plus the always/empty flags. There is no float/end
+    distinction at scan time — `$`, trailing newlines, and \\b variants
+    were compiled into extra positions/alternatives (see
+    _expand_scan_patterns). Single-word patterns have exactly one
+    (word, mask) pair; multi-word patterns may accept in several words
+    (one pair per word their accept positions touch).
     """
 
-    word: int
-    accept_mask: int
+    accepts: tuple[tuple[int, int], ...]  # (word, accept_mask) pairs
     always_match: bool
     empty_ok: bool  # additionally accept empty input (lengths == 0)
 
@@ -194,11 +207,22 @@ class NfaBank:
     )  # [W] injected every step
     opt: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.uint32))
     rep: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.uint32))
+    # carry_mask[w] == 1 -> word w continues word w-1's pattern: bit31 of
+    # w-1 advances into bit0 of w, and opt-closure escapes re-inject there.
+    carry_mask: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.uint32))
+    # Static number of opt-propagation passes the scan needs
+    # (1 + max word boundaries any optional run crosses).
+    prop_passes: int = 1
     slots: list[PatternSlot] = field(default_factory=list)
 
     @property
     def num_patterns(self) -> int:
         return len(self.slots)
+
+    @property
+    def has_carry(self) -> bool:
+        return bool(self.carry_mask.any())
 
 
 @dataclass(frozen=True)
@@ -279,7 +303,7 @@ def _expand_scan_patterns(lp: LinearPattern) -> list[_ScanPattern]:
 
 def scan_bits_needed(lp: LinearPattern) -> int:
     """Bits one input pattern occupies after expansion (guards + sticky
-    included). Must be <= WORD_BITS for device residency."""
+    included). Must be <= MAX_SCAN_BITS for device residency."""
     if lp.never_match:
         return 0
     if lp.min_len == 0 and not (lp.anchor_start and lp.anchor_end):
@@ -290,79 +314,56 @@ def scan_bits_needed(lp: LinearPattern) -> int:
     return total
 
 
-def build_bank(patterns: list[LinearPattern]) -> NfaBank:
-    """Pack linear patterns into an NfaBank (first-fit into uint32 words).
+class _BankBuilder:
+    """Mutable word-table state shared by both packing paths."""
 
-    All expanded alternatives of one input pattern are packed contiguously
-    in a single word so each pattern keeps one (word, accept_mask) slot.
-    """
-    from .repat import Unsupported
+    def __init__(self) -> None:
+        self.used: list[int] = []
+        self.byte_rows: list[dict[int, int]] = []
+        self.init_a: list[int] = []
+        self.init_u: list[int] = []
+        self.opt: list[int] = []
+        self.rep: list[int] = []
+        self.carry: list[bool] = []
+        self.dedicated: list[bool] = []
+        self.max_passes = 1
 
-    bank = NfaBank()
-    word_used: list[int] = []
-    byte_rows: list[dict[int, int]] = []
-    init_a: list[int] = []
-    init_u: list[int] = []
-    opt: list[int] = []
-    rep: list[int] = []
+    def add_word(self, carry: bool, dedicated: bool) -> int:
+        self.used.append(0)
+        self.byte_rows.append({})
+        self.init_a.append(0)
+        self.init_u.append(0)
+        self.opt.append(0)
+        self.rep.append(0)
+        self.carry.append(carry)
+        self.dedicated.append(dedicated)
+        return len(self.used) - 1
 
-    for lp in patterns:
-        m = len(lp.positions)
-        always = lp.min_len == 0 and not (lp.anchor_start and lp.anchor_end)
-        empty_ok = lp.min_len == 0 and lp.anchor_start and lp.anchor_end
-        if lp.never_match:
-            bank.slots.append(PatternSlot(word=0, accept_mask=0,
-                                          always_match=False, empty_ok=False))
-            continue
-        if m == 0 and not (lp.anchor_start and lp.anchor_end):
-            bank.slots.append(PatternSlot(word=0, accept_mask=0,
-                                          always_match=True, empty_ok=False))
-            continue
-        if always:
-            bank.slots.append(PatternSlot(word=0, accept_mask=0,
-                                          always_match=True, empty_ok=False))
-            continue
+    # -- single-word path (first-fit sharing, the common case) ---------------
 
-        subs = _expand_scan_patterns(lp)
-        need = sum(1 + len(s.positions) + (1 if s.sticky else 0)
-                   for s in subs)
-        if not subs or need == 0:
-            # e.g. ^\b with non-word first class only: unsatisfiable.
-            bank.slots.append(PatternSlot(word=0, accept_mask=0,
-                                          always_match=False,
-                                          empty_ok=empty_ok))
-            continue
-        if need > WORD_BITS:
-            raise Unsupported(f"pattern needs {need} bits > {WORD_BITS}")
+    def pack_shared(self, subs: list[_ScanPattern], need: int) -> PatternSlot:
         w = -1
-        for idx, used in enumerate(word_used):
-            if used + need <= WORD_BITS:
+        for idx, used in enumerate(self.used):
+            if not self.dedicated[idx] and used + need <= WORD_BITS:
                 w = idx
                 break
         if w == -1:
-            word_used.append(0)
-            byte_rows.append({})
-            init_a.append(0)
-            init_u.append(0)
-            opt.append(0)
-            rep.append(0)
-            w = len(word_used) - 1
-
+            w = self.add_word(carry=False, dedicated=False)
         accept_mask = 0
         for sub in subs:
-            base = word_used[w] + 1  # skip the guard bit
+            base = self.used[w] + 1  # skip the guard bit
             bit = lambda i: 1 << (base + i)  # noqa: E731
             for i, pos in enumerate(sub.positions):
                 for b in pos.bytes:
-                    byte_rows[w][b] = byte_rows[w].get(b, 0) | bit(i)
+                    self.byte_rows[w][b] = self.byte_rows[w].get(b, 0) | bit(i)
                 if _skippable(pos):
-                    opt[w] |= bit(i)
+                    self.opt[w] |= bit(i)
                 if _repeatable(pos):
-                    rep[w] |= bit(i)
+                    self.rep[w] |= bit(i)
             if sub.anchored:
-                init_a[w] |= bit(0)
+                self.init_a[w] |= bit(0)
             else:
-                init_u[w] |= bit(0)
+                self.init_u[w] |= bit(0)
             for i in sub.accept:
                 accept_mask |= bit(i)
             n = len(sub.positions)
@@ -370,26 +371,140 @@ def build_bank(patterns: list[LinearPattern]) -> NfaBank:
                 # Sticky accept bit: matches any byte, self-loops, fed by
                 # the last position's shift/opt-propagation.
                 for b in range(256):
-                    byte_rows[w][b] = byte_rows[w].get(b, 0) | bit(n)
-                rep[w] |= bit(n)
+                    self.byte_rows[w][b] = self.byte_rows[w].get(b, 0) | bit(n)
+                self.rep[w] |= bit(n)
                 accept_mask |= bit(n)
                 n += 1
-            word_used[w] += 1 + n
+            self.used[w] += 1 + n
+        return PatternSlot(accepts=((w, accept_mask),),
+                           always_match=False, empty_ok=False)
 
-        bank.slots.append(PatternSlot(word=w, accept_mask=accept_mask,
-                                      always_match=False, empty_ok=empty_ok))
+    # -- multi-word path (dedicated span, cross-word carry) ------------------
 
-    W = len(word_used)
+    def pack_span(self, subs: list[_ScanPattern]) -> PatternSlot:
+        first_w = self.add_word(carry=False, dedicated=True)
+        cur = [first_w]  # boxed current word
+
+        def gbit(w: int, b: int) -> int:
+            return (w - first_w) * WORD_BITS + b
+
+        def place() -> tuple[int, int]:
+            if self.used[cur[0]] == WORD_BITS:
+                cur[0] = self.add_word(carry=True, dedicated=True)
+            b = self.used[cur[0]]
+            self.used[cur[0]] += 1
+            return cur[0], b
+
+        accepts: dict[int, int] = {}
+        for sub in subs:
+            place()  # guard bit: absorbs shift-in from the previous region
+            run_start: int | None = None  # global bit of current opt run
+
+            def close_run(end_g: int) -> None:
+                nonlocal run_start
+                if run_start is not None:
+                    # The epsilon closure from an active bit at run_start
+                    # reaches end_g (one past the run); each word boundary
+                    # in between needs one extra propagation pass.
+                    crossings = end_g // WORD_BITS - run_start // WORD_BITS
+                    self.max_passes = max(self.max_passes, 1 + crossings)
+                    run_start = None
+
+            placed: list[tuple[int, int]] = []
+            first = True
+            for pos in sub.positions:
+                w, b = place()
+                for byte in pos.bytes:
+                    self.byte_rows[w][byte] = (
+                        self.byte_rows[w].get(byte, 0) | (1 << b))
+                if _skippable(pos):
+                    self.opt[w] |= 1 << b
+                    if run_start is None:
+                        run_start = gbit(w, b)
+                else:
+                    close_run(gbit(w, b))
+                if _repeatable(pos):
+                    self.rep[w] |= 1 << b
+                if first:
+                    if sub.anchored:
+                        self.init_a[w] |= 1 << b
+                    else:
+                        self.init_u[w] |= 1 << b
+                    first = False
+                placed.append((w, b))
+            # A trailing optional run's closure must still reach one past
+            # the last position (the sticky bit, when present).
+            close_run(gbit(*placed[-1]) + 1)
+            if sub.sticky:
+                w, b = place()
+                for byte in range(256):
+                    self.byte_rows[w][byte] = (
+                        self.byte_rows[w].get(byte, 0) | (1 << b))
+                self.rep[w] |= 1 << b
+                accepts[w] = accepts.get(w, 0) | (1 << b)
+            for i in sub.accept:
+                w, b = placed[i]
+                accepts[w] = accepts.get(w, 0) | (1 << b)
+        return PatternSlot(
+            accepts=tuple(sorted(accepts.items())),
+            always_match=False, empty_ok=False)
+
+
+def build_bank(patterns: list[LinearPattern]) -> NfaBank:
+    """Pack linear patterns into an NfaBank.
+
+    Patterns fitting one uint32 word (<= 32 bits after expansion) share
+    words first-fit, all alternatives contiguous in the same word.
+    Larger patterns (up to MAX_SCAN_BITS) get a dedicated span of
+    consecutive words with cross-word carry (see module docstring).
+    """
+    from dataclasses import replace
+
+    from .repat import Unsupported
+
+    bank = NfaBank()
+    builder = _BankBuilder()
+
+    for lp in patterns:
+        m = len(lp.positions)
+        always = lp.min_len == 0 and not (lp.anchor_start and lp.anchor_end)
+        empty_ok = lp.min_len == 0 and lp.anchor_start and lp.anchor_end
+        no_match = PatternSlot(accepts=(), always_match=False, empty_ok=False)
+        if lp.never_match:
+            bank.slots.append(no_match)
+            continue
+        if always or (m == 0 and not (lp.anchor_start and lp.anchor_end)):
+            bank.slots.append(replace(no_match, always_match=True))
+            continue
+
+        subs = _expand_scan_patterns(lp)
+        need = sum(1 + len(s.positions) + (1 if s.sticky else 0)
+                   for s in subs)
+        if not subs or need == 0:
+            # e.g. ^\b with non-word first class only: unsatisfiable.
+            bank.slots.append(replace(no_match, empty_ok=empty_ok))
+            continue
+        if need > MAX_SCAN_BITS:
+            raise Unsupported(f"pattern needs {need} bits > {MAX_SCAN_BITS}")
+        if need <= WORD_BITS:
+            slot = builder.pack_shared(subs, need)
+        else:
+            slot = builder.pack_span(subs)
+        bank.slots.append(replace(slot, empty_ok=empty_ok))
+
+    W = len(builder.used)
     bank.num_words = W
     table = np.zeros((256, W), dtype=np.uint32)
     for w in range(W):
-        for b, mask in byte_rows[w].items():
+        for b, mask in builder.byte_rows[w].items():
             table[b, w] = mask
     bank.byte_table = table
-    bank.init_anchored = np.array(init_a, dtype=np.uint32)
-    bank.init_unanchored = np.array(init_u, dtype=np.uint32)
-    bank.opt = np.array(opt, dtype=np.uint32)
-    bank.rep = np.array(rep, dtype=np.uint32)
+    bank.init_anchored = np.array(builder.init_a, dtype=np.uint32)
+    bank.init_unanchored = np.array(builder.init_u, dtype=np.uint32)
+    bank.opt = np.array(builder.opt, dtype=np.uint32)
+    bank.rep = np.array(builder.rep, dtype=np.uint32)
+    bank.carry_mask = np.array(builder.carry, dtype=np.uint32)
+    bank.prop_passes = builder.max_passes
     return bank
 
 
@@ -400,6 +515,9 @@ def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarr
     """
     B, L = data.shape
     W = bank.num_words
+    has_carry = bank.has_carry
+    carry_mask = bank.carry_mask
+    opt = bank.opt
     S = np.zeros((B, W), dtype=np.uint32)
     for t in range(L):
         c = data[:, t].astype(np.int64)
@@ -408,7 +526,21 @@ def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarr
         if t == 0:
             inj = inj | bank.init_anchored[None, :]
         adv = ((S << np.uint32(1)) | inj).astype(np.uint32)
-        adv |= ((adv & bank.opt) + bank.opt) ^ bank.opt
+        if has_carry:
+            # bit31 of word w-1 advances into bit0 of word w.
+            carry = np.zeros_like(S)
+            carry[:, 1:] = (S[:, :-1] >> np.uint32(31)) & np.uint32(1)
+            adv |= carry & carry_mask
+        for p in range(bank.prop_passes):
+            x = ((adv & opt) + opt).astype(np.uint32)  # wraps on escape
+            adv |= x ^ opt
+            if has_carry and p + 1 < bank.prop_passes:
+                # Closure escaped past bit31 (add overflow) -> re-inject
+                # at bit0 of the next span word and propagate again.
+                esc = (x < opt).astype(np.uint32)
+                esc_in = np.zeros_like(S)
+                esc_in[:, 1:] = esc[:, :-1]
+                adv |= esc_in & carry_mask
         S_new = ((adv | (S & bank.rep)) & bc).astype(np.uint32)
         S = np.where((t < lengths)[:, None], S_new, S)
     out = np.zeros((B, bank.num_patterns), dtype=bool)
@@ -418,8 +550,9 @@ def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarr
             out[:, p] = True
             continue
         hit = np.zeros(B, dtype=bool)
-        if W and slot.accept_mask:
-            hit = (S[:, slot.word] & np.uint32(slot.accept_mask)) != 0
+        for w, mask in slot.accepts:
+            if W and mask:
+                hit |= (S[:, w] & np.uint32(mask)) != 0
         if slot.empty_ok:
             hit |= empty
         out[:, p] = hit

@@ -28,9 +28,14 @@ import enum
 from dataclasses import dataclass, field
 from typing import Iterable
 
-MAX_POSITIONS = 31  # bits per uint32 word, minus one guard bit
+# Positions per linear pattern. Multi-word packing (compiler/nfa.py
+# pack_span) spreads one pattern over up to MAX_SCAN_BITS/32 uint32
+# words with cross-word carry, so patterns are no longer capped at one
+# word; the binding limit is nfa.MAX_SCAN_BITS on the EXPANDED footprint
+# (checked at lowering), this is just a sanity bound before expansion.
+MAX_POSITIONS = 126  # 1 guard + 126 positions + 1 sticky = 128 bits
 MAX_CROSS_PRODUCT = 16  # cap on alternation expansion
-MAX_REPEAT_EXPANSION = 31
+MAX_REPEAT_EXPANSION = 96
 
 
 class Unsupported(Exception):

@@ -11,6 +11,13 @@ table plus ~7 elementwise uint32 ops, and only S round-trips HBM
 between scan iterations (four carried accumulator lanes in an earlier
 design tripled the scan's HBM traffic).
 
+Multi-word patterns (compiler/nfa.py pack_span) add a cross-word carry:
+bit31 of a span word advances into bit0 of the next (`carry_mask`), and
+optional-run closures that overflow a word re-inject there before an
+extra propagation pass. Both the carry and the pass count are STATIC
+bank properties (`has_carry`, `extra_passes`), so single-word banks —
+the common case — trace to exactly the old 7-op step.
+
 The reference behavior this replaces: per-request sequential regex
 execution inside the rules loop (reference pingoo/listeners/
 http_listener.rs:251-264 -> bel tree-walk with Rust regex).
@@ -18,7 +25,7 @@ http_listener.rs:251-264 -> bel tree-walk with Rust regex).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
@@ -27,19 +34,42 @@ import numpy as np
 from ..compiler.nfa import NfaBank
 
 
-class NfaTables(NamedTuple):
-    """Device-resident tables for one field's NFA bank (a pytree)."""
+@dataclass(frozen=True)
+class NfaTables:
+    """Device-resident tables for one field's NFA bank.
+
+    Registered as a pytree whose array fields are leaves and whose
+    `has_carry` / `extra_passes` / `identity_accept` fields are STATIC
+    metadata — they steer trace-time control flow (python ifs/loops in
+    scan_chunk / extract_slots), never device data.
+    """
 
     byte_table: jax.Array  # [256, W] uint32
     init_anchored: jax.Array  # [W] injected at t == 0 only
     init_unanchored: jax.Array  # [W] injected every step
     opt: jax.Array  # [W]
     rep: jax.Array  # [W]
-    # Per-pattern slot extraction data:
-    slot_word: jax.Array  # [P] int32
-    slot_mask: jax.Array  # [P] uint32
+    carry_mask: jax.Array  # [W] uint32: 1 where word w continues word w-1
+    # Accept extraction: J (word, mask) pairs; pattern p owns the pairs
+    # member[:, p] selects (pairs are contiguous per pattern).
+    accept_word: jax.Array  # [J] int32
+    accept_mask: jax.Array  # [J] uint32
+    accept_member: jax.Array  # [J, P] float32 OR-membership matrix
     slot_always: jax.Array  # [P] bool
     slot_empty_ok: jax.Array  # [P] bool
+    # -- static metadata (not pytree leaves) --
+    has_carry: bool = False
+    extra_passes: int = 0  # opt-propagation passes beyond the first
+    identity_accept: bool = True  # J == P with pair j belonging to slot j
+
+
+jax.tree_util.register_dataclass(
+    NfaTables,
+    data_fields=["byte_table", "init_anchored", "init_unanchored", "opt",
+                 "rep", "carry_mask", "accept_word", "accept_mask",
+                 "accept_member", "slot_always", "slot_empty_ok"],
+    meta_fields=["has_carry", "extra_passes", "identity_accept"],
+)
 
 
 def bank_to_tables(bank: NfaBank) -> NfaTables:
@@ -58,19 +88,44 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
         bt = np.zeros((256, W), dtype=np.uint32)
         bt[:, : byte_table.shape[1]] = byte_table
         byte_table = bt
+
+    # Flatten accept pairs in slot order; never-match slots contribute a
+    # dead pair (word 0, mask 0) so the identity fast path (J == P, pair
+    # j <-> slot j) survives banks that mix in always/never patterns.
+    acc_word: list[int] = []
+    acc_mask: list[int] = []
+    pair_slot: list[int] = []
+    for p, slot in enumerate(slots):
+        pairs = slot.accepts or ((0, 0),)
+        for w, mask in pairs:
+            acc_word.append(w)
+            acc_mask.append(mask)
+            pair_slot.append(p)
+    J, P = len(acc_word), len(slots)
+    identity = J == P and all(pair_slot[j] == j for j in range(J))
+    # Rows follow the (possibly padded-to-1) accept arrays; columns are
+    # exactly P so the matmul output shape is [B, P] even when P == 0.
+    member = np.zeros((max(J, 1), P), dtype=np.float32)
+    for j, p in enumerate(pair_slot):
+        member[j, p] = 1.0
+
     return NfaTables(
         byte_table=jnp.asarray(byte_table),
         init_anchored=jnp.asarray(pad(bank.init_anchored)),
         init_unanchored=jnp.asarray(pad(bank.init_unanchored)),
         opt=jnp.asarray(pad(bank.opt)),
         rep=jnp.asarray(pad(bank.rep)),
-        slot_word=jnp.asarray(np.array([s.word for s in slots], dtype=np.int32)),
-        slot_mask=jnp.asarray(
-            np.array([s.accept_mask for s in slots], dtype=np.uint32)),
+        carry_mask=jnp.asarray(pad(bank.carry_mask)),
+        accept_word=jnp.asarray(np.array(acc_word or [0], dtype=np.int32)),
+        accept_mask=jnp.asarray(np.array(acc_mask or [0], dtype=np.uint32)),
+        accept_member=jnp.asarray(member),
         slot_always=jnp.asarray(
             np.array([s.always_match for s in slots], dtype=bool)),
         slot_empty_ok=jnp.asarray(
             np.array([s.empty_ok for s in slots], dtype=bool)),
+        has_carry=bank.has_carry,
+        extra_passes=max(bank.prop_passes - 1, 0),
+        identity_accept=identity,
     )
 
 
@@ -90,7 +145,14 @@ def scan_chunk(
     one = jnp.uint32(1)
     opt = tables.opt
     rep = tables.rep
+    carry_mask = tables.carry_mask
     lengths = lengths.astype(jnp.int32)
+    has_carry = tables.has_carry
+    passes = 1 + tables.extra_passes
+
+    def shift_words(x):
+        """[B, W] -> value of word w-1 moved into word w (word 0 gets 0)."""
+        return jnp.pad(x[:, :-1], ((0, 0), (1, 0)))
 
     def step(S, xs):
         c, t_local = xs  # c: [B] uint8
@@ -99,7 +161,15 @@ def scan_chunk(
         inj = jnp.where(t == 0, tables.init_unanchored | tables.init_anchored,
                         tables.init_unanchored)
         adv = (S << one) | inj[None, :]
-        adv = adv | (((adv & opt) + opt) ^ opt)
+        if has_carry:
+            # bit31 of span word w-1 advances into bit0 of word w.
+            adv = adv | (shift_words((S >> jnp.uint32(31)) & one) & carry_mask)
+        for p in range(passes):
+            x = (adv & opt) + opt  # wraps (mod 2^32) when closure escapes
+            adv = adv | (x ^ opt)
+            if has_carry and p + 1 < passes:
+                esc = (x < opt).astype(jnp.uint32)
+                adv = adv | (shift_words(esc) & carry_mask)
         S_new = (adv | (S & rep)) & bc
         S = jnp.where((t < lengths)[:, None], S_new, S)
         return S, None
@@ -121,8 +191,17 @@ def extract_slots(tables: NfaTables, state: jax.Array,
                   lengths: jax.Array) -> jax.Array:
     """Per-pattern verdicts [B, P] from the final state."""
     lengths = lengths.astype(jnp.int32)
-    lanes = jnp.take(state, tables.slot_word, axis=1)  # [B, P]
-    hit = (lanes & tables.slot_mask[None, :]) != 0
+    lanes = jnp.take(state, tables.accept_word, axis=1)  # [B, J]
+    pair_hit = (lanes & tables.accept_mask[None, :]) != 0
+    if tables.identity_accept:
+        hit = pair_hit  # J == P, pair j IS slot j
+    else:
+        # OR pairs into slots with one [B, J] x [J, P] matmul (MXU does
+        # the reduction; same trick as the leaf-span extraction in
+        # engine/verdict.py).
+        counts = jnp.dot(pair_hit.astype(jnp.float32), tables.accept_member,
+                         preferred_element_type=jnp.float32)
+        hit = counts > 0.0
     hit = hit | (tables.slot_empty_ok[None, :] & (lengths == 0)[:, None])
     return hit | tables.slot_always[None, :]
 

@@ -6,9 +6,10 @@ mapping of the classic axes onto a WAF verdict engine is
   dp — request-batch sharding (the throughput lever; every batch row is
        independent, so dp scales embarrassingly),
   tp — rule/pattern sharding: pattern tables shard on their pattern axis
-       and NFA banks on their word axis (patterns are confined to single
-       uint32 words by construction, compiler/nfa.py, so word sharding IS
-       rule sharding),
+       and NFA banks on their word axis (most patterns occupy one uint32
+       word, so word sharding is mostly rule sharding; a multi-word span
+       straddling a shard boundary keeps its cross-word carry via GSPMD
+       halo exchange, compiler/nfa.py pack_span),
   sp — sequence (byte-dimension) sharding for long fields via the ring
        scan in parallel/ring.py.
 
@@ -65,18 +66,27 @@ def table_shardings(mesh: Mesh, tables: Mapping[str, Any]) -> dict:
         )
 
     def shard_nfa(t: NfaTables) -> NfaTables:
+        from dataclasses import replace
+
         w = NamedSharding(mesh, P("tp"))
-        p = NamedSharding(mesh, P("tp"))
-        return NfaTables(
+        # Word-axis arrays shard on tp (word sharding IS rule sharding);
+        # the per-pattern accept/slot arrays are tiny and replicate —
+        # extraction is one gather + matmul, not worth a halo. Cross-word
+        # carries of multi-word spans that straddle a tp shard boundary
+        # become GSPMD halo exchanges (correct, slightly slower).
+        return replace(
+            t,
             byte_table=NamedSharding(mesh, P(None, "tp")),
             init_anchored=w,
             init_unanchored=w,
             opt=w,
             rep=w,
-            slot_word=p,
-            slot_mask=p,
-            slot_always=p,
-            slot_empty_ok=p,
+            carry_mask=w,
+            accept_word=repl,
+            accept_mask=repl,
+            accept_member=repl,
+            slot_always=repl,
+            slot_empty_ok=repl,
         )
 
     out: dict = {}
@@ -84,8 +94,7 @@ def table_shardings(mesh: Mesh, tables: Mapping[str, Any]) -> dict:
         if isinstance(val, PatternTable) and _divisible(val.bytes.shape[0], mesh, "tp"):
             out[key] = shard_pattern_table(val)
         elif isinstance(val, NfaTables) and _divisible(
-                val.opt.shape[0], mesh, "tp") and _divisible(
-                val.slot_word.shape[0], mesh, "tp"):
+                val.opt.shape[0], mesh, "tp"):
             out[key] = shard_nfa(val)
         else:
             out[key] = jax.tree_util.tree_map(lambda _: repl, val)
@@ -102,8 +111,9 @@ def pad_tables_for_tp(np_tables: dict, tp: int) -> dict:
 
     Padding rows are inert: zero-length patterns in a PatternTable can
     only produce spurious columns that no leaf binding reads; NFA padding
-    words carry no init bits so their lanes stay dead. Slot arrays pad
-    with always-false slots (mask 0, word 0).
+    words carry no init bits and no carry flag so their lanes stay dead
+    (accept/slot arrays index words by value and are replicated, so they
+    need no padding).
     """
     import numpy as np  # local: keep module import-light
 
@@ -132,16 +142,19 @@ def pad_tables_for_tp(np_tables: dict, tp: int) -> dict:
                 ci=pad_axis(np.asarray(val.ci), 0, tp),
             )
         elif isinstance(val, NfaTables):
-            out[key] = NfaTables(
+            from dataclasses import replace
+
+            # Pad only the word axis; padded words carry no init bits and
+            # no carry flag, so their lanes stay dead. Accept/slot arrays
+            # index words by value and are replicated, so they need no pad.
+            out[key] = replace(
+                val,
                 byte_table=pad_axis(np.asarray(val.byte_table), 1, tp),
                 init_anchored=pad_axis(np.asarray(val.init_anchored), 0, tp),
                 init_unanchored=pad_axis(np.asarray(val.init_unanchored), 0, tp),
                 opt=pad_axis(np.asarray(val.opt), 0, tp),
                 rep=pad_axis(np.asarray(val.rep), 0, tp),
-                slot_word=pad_axis(np.asarray(val.slot_word), 0, tp),
-                slot_mask=pad_axis(np.asarray(val.slot_mask), 0, tp),
-                slot_always=pad_axis(np.asarray(val.slot_always), 0, tp),
-                slot_empty_ok=pad_axis(np.asarray(val.slot_empty_ok), 0, tp),
+                carry_mask=pad_axis(np.asarray(val.carry_mask), 0, tp),
             )
         else:
             out[key] = val

@@ -56,7 +56,7 @@ def ring_nfa_scan(
 
         perm = [(i, (i + 1) % sp) for i in range(sp)]
         hits = jnp.zeros(
-            (Bl, tables_local.slot_word.shape[0]), dtype=jnp.int32)
+            (Bl, tables_local.slot_always.shape[0]), dtype=jnp.int32)
         for stage in range(sp):
             my_turn = sp_idx == stage
             s2 = scan_chunk(tables_local, chunk, lengths_local, state,

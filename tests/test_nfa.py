@@ -93,7 +93,7 @@ UNSUPPORTED_PATTERNS = [
     r"(abc)+",  # unbounded multi-char group repeat
     r"a(?=b)",  # lookahead
     r"(a)\1",  # backreference
-    r"a{1,50}" * 2,  # expansion too large
+    r"a{1,90}" * 2,  # expansion too large even for the multi-word cap
     r"\b(a|\s)x",  # boundary before mixed word/non-word class
     r"\ba?bc",  # boundary before optional position
     r"a*?",  # lazy
@@ -236,3 +236,146 @@ def test_multi_pattern_bank_packing():
             assert got[i] == (gold.search(d) is not None), (
                 f"bank {src!r} on {d!r}")
         col += n_alts
+
+
+# -- multi-word patterns (>31 positions, cross-word carry) -------------------
+
+MULTIWORD_CASES = [
+    # (pattern, targeted inputs) — truth always comes from `re`.
+    ("x" * 40,
+     [b"x" * 40, b"x" * 39, b"pad" + b"x" * 40 + b"tail", b"x" * 80,
+      b"x" * 20 + b"y" + b"x" * 19]),
+    ("k" * 80,
+     [b"k" * 80, b"k" * 79, b"z" * 30 + b"k" * 80]),
+    ("z" * 126,  # at the MAX_SCAN_BITS cap
+     [b"z" * 126, b"z" * 125, b"q" + b"z" * 126]),
+    (r"<svg[^>]{0,40}onload",  # CRS-style opt run crossing a word boundary
+     [b"<svg onload", b"<svg " + b"a" * 40 + b"onload",
+      b"<svg " + b"a" * 41 + b"onload", b"<svg>onload",
+      b"<svg" + b"b" * 36 + b"onload", b"onload<svg"]),
+    ("(?i)" + "union" * 8,  # case-insensitive 40-position literal
+     [b"union" * 8, b"UNION" * 8, b"UnIoN" * 8, b"union" * 7,
+      b"x" + b"uNion" * 8 + b"y"]),
+    ("^" + "a" * 50,  # anchored: injection only at t == 0
+     [b"a" * 50, b"a" * 49, b"b" + b"a" * 50, b"a" * 60]),
+    ("b" * 45 + "$",  # $: accept positions near the span end
+     [b"b" * 45, b"b" * 45 + b"\n", b"b" * 45 + b"x", b"x" + b"b" * 45,
+      b"b" * 44]),
+    (r"\b" + "w" * 40 + r"\b",  # boundary alternatives in a span
+     [b"w" * 40, b" " + b"w" * 40 + b" ", b"3" + b"w" * 40,
+      b"w" * 41, b"-" + b"w" * 40 + b"."]),
+    ("a" * 30 + "[0-9]{0,30}" + "b" * 30,  # opt run mid-span
+     [b"a" * 30 + b"b" * 30, b"a" * 30 + b"123" + b"b" * 30,
+      b"a" * 30 + b"1" * 30 + b"b" * 30, b"a" * 30 + b"1" * 31 + b"b" * 30,
+      b"a" * 29 + b"b" * 30]),
+    ("p" * 31 + "q?" * 10 + "r",  # opt run straddling the 32-bit boundary
+     [b"p" * 31 + b"r", b"p" * 31 + b"q" * 10 + b"r",
+      b"p" * 31 + b"q" * 4 + b"r", b"p" * 31 + b"q" * 11 + b"r",
+      b"p" * 30 + b"r"]),
+    ("m" * 20 + "n+" + "o" * 20,  # self-loop feeding a cross-word advance
+     [b"m" * 20 + b"n" + b"o" * 20, b"m" * 20 + b"n" * 40 + b"o" * 20,
+      b"m" * 20 + b"o" * 20]),
+    ("e{0,60}f",  # 60-bit pure-optional run: crosses two boundaries
+     [b"f", b"e" * 60 + b"f", b"ef", b"e" * 61 + b"f", b"g" * 5 + b"f",
+      b"e" * 59]),
+    ("(longfirstalternative[0-9]{5,10}|second[a-z]{20,30}tail)",
+     [b"longfirstalternative12345", b"longfirstalternative1234",
+      b"second" + b"q" * 20 + b"tail", b"second" + b"q" * 31 + b"tail",
+      b"secondtail", b"x longfirstalternative1234567890 y"]),
+]
+
+
+def _scan_bank(patterns, inputs):
+    bank = build_bank(patterns)
+    L = max(1, max(len(d) for d in inputs))
+    mat = np.zeros((len(inputs), L), dtype=np.uint8)
+    lengths = np.array([len(d) for d in inputs], dtype=np.int32)
+    for i, d in enumerate(inputs):
+        mat[i, : len(d)] = np.frombuffer(d, dtype=np.uint8)
+    return bank, scan_numpy(bank, mat, lengths)
+
+
+@pytest.mark.parametrize("pattern,targeted", MULTIWORD_CASES,
+                         ids=[p[:34] for p, _ in MULTIWORD_CASES])
+def test_multiword_differential(pattern, targeted):
+    """re == simulate == scan_numpy on >1-word patterns, each packed
+    alone (dedicated span; bank must report carry)."""
+    alts = compile_regex(pattern)
+    rng = random.Random(hash(pattern) & 0xFFFF)
+    inputs = list(targeted) + gen_inputs(rng, n=30)
+    bank, out = _scan_bank(alts, inputs)
+    assert bank.has_carry, "multi-word pattern must produce a carry span"
+    gold = re.compile(pattern.encode())
+    for i, data in enumerate(inputs):
+        want = gold.search(data) is not None
+        got_sim = any(simulate(lp, data) for lp in alts)
+        got_scan = bool(out[i].any())
+        assert got_sim == want, (
+            f"simulate {pattern!r} on {data!r}: {got_sim} != {want}")
+        assert got_scan == want, (
+            f"scan {pattern!r} on {data!r}: {got_scan} != {want}")
+
+
+def test_multiword_mixed_bank():
+    """Single-word and multi-word patterns coexist in one bank without
+    cross-talk; single-word words keep carry disabled."""
+    sources = [r"abc", "x" * 40, r"^/api/", r"<svg[^>]{0,40}onload",
+               r"\.php$", "k" * 80, r"(?i)select"]
+    patterns, spans = [], []
+    for src in sources:
+        alts = compile_regex(src)
+        spans.append((len(patterns), len(patterns) + len(alts)))
+        patterns.extend(alts)
+    rng = random.Random(5)
+    inputs = (gen_inputs(rng, n=40) +
+              [b"x" * 40, b"k" * 80, b"<svg " + b"a" * 30 + b"onload",
+               b"/api/abc.php", b"x" * 39 + b"SELECT"])
+    bank, out = _scan_bank(patterns, inputs)
+    assert bank.has_carry
+    for (lo, hi), src in zip(spans, sources):
+        gold = re.compile(src.encode())
+        got = out[:, lo:hi].any(axis=1)
+        for i, d in enumerate(inputs):
+            assert got[i] == (gold.search(d) is not None), (src, d)
+
+
+def test_multiword_fuzz():
+    """Randomized long-pattern generator: differential vs re across the
+    one/two/three/four-word footprint range."""
+    rng = random.Random(20260729)
+    atoms = ["a", "b", "x", r"\d", r"[a-c]", r"[^ab]", "."]
+    quants = ["", "", "", "?", "*", "+", "{2}", "{1,3}", "{0,9}"]
+    tested = 0
+    for trial in range(200):
+        n = rng.randint(10, 40)
+        parts = []
+        for _ in range(n):
+            parts.append(rng.choice(atoms) + rng.choice(quants))
+        pattern = "".join(parts)
+        if rng.random() < 0.2:
+            pattern = "^" + pattern
+        if rng.random() < 0.2:
+            pattern = pattern + "$"
+        try:
+            alts = compile_regex(pattern)
+        except Unsupported:
+            continue
+        from pingoo_tpu.compiler.nfa import WORD_BITS, scan_bits_needed
+        if max(scan_bits_needed(lp) for lp in alts) <= WORD_BITS:
+            continue  # only exercise the multi-word path here
+        tested += 1
+        gold = re.compile(pattern.encode())
+        inputs = gen_inputs(rng, n=15)
+        # Bias toward near-matches: mutate a sampled matching prefix.
+        alphabet = b"abx0123456789c "
+        for _ in range(10):
+            k = rng.randint(20, 70)
+            inputs.append(bytes(rng.choice(alphabet) for _ in range(k)))
+        bank, out = _scan_bank(alts, inputs)
+        for i, data in enumerate(inputs):
+            want = gold.search(data) is not None
+            got_sim = any(simulate(lp, data) for lp in alts)
+            got_scan = bool(out[i].any())
+            assert got_sim == want, (pattern, data, "simulate")
+            assert got_scan == want, (pattern, data, "scan")
+    assert tested >= 30, f"only {tested} multi-word patterns exercised"

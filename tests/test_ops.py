@@ -185,3 +185,42 @@ class TestCidrOps:
         assert not np.asarray(
             int_set_contains(iset, jnp.asarray(np.array([0], dtype=np.int64)))
         )[0]
+
+
+class TestMultiWordJax:
+    def test_multiword_matches_numpy_and_re(self):
+        """The jitted scan agrees with scan_numpy and re on multi-word
+        banks (cross-word carry + escape passes + pair extraction)."""
+        sources = [r"abc", "x" * 40, r"<svg[^>]{0,40}onload",
+                   "(?i)" + "union" * 8, "b" * 45 + "$",
+                   r"\b" + "w" * 40 + r"\b", "e{0,60}f", r"\.php$"]
+        patterns, spans = [], []
+        for src in sources:
+            alts = compile_regex(src)
+            spans.append((len(patterns), len(patterns) + len(alts)))
+            patterns.extend(alts)
+        bank = build_bank(patterns)
+        assert bank.has_carry and bank.prop_passes >= 2
+        tables = bank_to_tables(bank)
+        # \b patterns emit multiple accept pairs -> the matmul (non-
+        # identity) extraction path must be the one under test here.
+        assert not tables.identity_accept
+
+        rng = random.Random(424242)
+        inputs = [b"x" * 40, b"<svg " + b"a" * 39 + b"onload",
+                  b"UNION" * 8, b"b" * 45, b"b" * 45 + b"\n",
+                  b" " + b"w" * 40 + b".", b"e" * 30 + b"f", b"x.php",
+                  b"x" * 39, b"w" * 41, b""]
+        alphabet = b"xwabeunion<svg>.php$ 0123456789"
+        for _ in range(60):
+            k = rng.randint(0, 90)
+            inputs.append(bytes(rng.choice(alphabet) for _ in range(k)))
+        mat, lens = to_matrix(inputs)
+        want = scan_numpy(bank, mat, lens)
+        got = np.asarray(nfa_scan(tables, mat, lens))
+        np.testing.assert_array_equal(got, want)
+        for (lo, hi), src in zip(spans, sources):
+            gold = re.compile(src.encode())
+            for i, d in enumerate(inputs):
+                assert got[i, lo:hi].any() == (gold.search(d) is not None), (
+                    src, d)
