@@ -9,12 +9,15 @@ persisted to `<tls_dir>/acme.json` as a versioned document
 hot-inserted into the TlsManager and written next to the other certs
 with retries (acme.rs:124-169).
 
-One deliberate deviation: the reference validates via tls-alpn-01
-(answered at TLS-accept time, listeners/mod.rs:130-141); Python's ssl
-layer cannot select a certificate by client ALPN, so this client uses
-http-01 — the HTTP listener serves
+Challenge types: tls-alpn-01 (the reference's only type, acme.rs:180-242)
+when an `alpn_dir` is configured — the ephemeral challenge certificate
+(RFC 8737: SAN = domain, critical acmeIdentifier extension carrying
+SHA256(key authorization)) is written as `<domain>.pem/.key` into the
+dir the native TLS transport answers `acme-tls/1` handshakes from
+(native/httpd.cc client_hello_cb; Python's ssl layer cannot select a
+certificate by client ALPN, which is why this rides the C++ plane).
+Fallback: http-01 — the HTTP listener serves
 /.well-known/acme-challenge/<token> from `AcmeManager.challenges`.
-tls-alpn-01 belongs to the native (C++) transport.
 """
 
 from __future__ import annotations
@@ -45,8 +48,45 @@ PERSIST_RETRY_DELAY_S = 5.0
 HTTP01_PATH_PREFIX = "/.well-known/acme-challenge/"
 
 
+ACME_IDENTIFIER_OID = x509.ObjectIdentifier("1.3.6.1.5.5.7.1.31")
+
+
 class AcmeError(Exception):
     pass
+
+
+def make_tls_alpn_challenge_cert(domain: str,
+                                 keyauth: str) -> tuple[bytes, bytes]:
+    """RFC 8737 §3 challenge certificate: self-signed, SAN = [domain],
+    critical id-pe-acmeIdentifier extension = DER OCTET STRING of
+    SHA256(key authorization) (reference acme.rs:208-242)."""
+    import hashlib
+
+    digest = hashlib.sha256(keyauth.encode("ascii")).digest()
+    acme_ext = x509.UnrecognizedExtension(
+        ACME_IDENTIFIER_OID, b"\x04\x20" + digest)
+    key = ec.generate_private_key(ec.SECP256R1())
+    now = datetime.datetime.now(datetime.timezone.utc)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, domain)])
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(hours=1))
+        .public_key(key.public_key())
+        .add_extension(x509.SubjectAlternativeName(
+            [x509.DNSName(domain)]), critical=False)
+        .add_extension(acme_ext, critical=True)
+        .sign(key, hashes.SHA256())
+    )
+    cert_pem = cert.public_bytes(serialization.Encoding.PEM)
+    key_pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    return cert_pem, key_pem
 
 
 class AcmeClient:
@@ -143,10 +183,17 @@ class AcmeClient:
     async def order_certificate(self, domains: list[str],
                                 challenges: dict[str, str],
                                 poll_interval_s: float = 1.0,
-                                poll_tries: int = 30) -> tuple[bytes, bytes]:
-        """-> (cert_pem_chain, key_pem). Publishes http-01 key
-        authorizations into `challenges` (token -> keyauth) while the
-        order validates (reference order_certificate, acme.rs:245-306).
+                                poll_tries: int = 30,
+                                alpn_dir: Optional[str] = None
+                                ) -> tuple[bytes, bytes]:
+        """-> (cert_pem_chain, key_pem).
+
+        With `alpn_dir` set, validates via tls-alpn-01 (the reference's
+        only type, acme.rs:180-242): the RFC 8737 challenge certificate
+        is written as <domain>.pem/.key for the native TLS transport to
+        answer at accept time. Otherwise http-01: key authorizations are
+        published into `challenges` (token -> keyauth) for the HTTP
+        listener. (reference order_certificate, acme.rs:245-306.)
         """
         directory = await self.directory()
         status, headers, order = await self._post(
@@ -156,8 +203,10 @@ class AcmeClient:
             raise AcmeError(f"newOrder: {status} {order}")
         order_url = headers.get("Location", "")
 
+        want_type = "tls-alpn-01" if alpn_dir else "http-01"
         thumbprint = jose.jwk_thumbprint(self.key)
         published: list[str] = []
+        staged_files: list[str] = []
         try:
             for authz_url in order.get("authorizations", []):
                 status, _, authz = await self._post(authz_url, None)
@@ -167,12 +216,27 @@ class AcmeClient:
                     continue
                 challenge = next(
                     (c for c in authz.get("challenges", [])
-                     if c.get("type") == "http-01"), None)
+                     if c.get("type") == want_type), None)
                 if challenge is None:
-                    raise AcmeError("no http-01 challenge offered")
+                    raise AcmeError(f"no {want_type} challenge offered")
                 token = challenge["token"]
-                challenges[token] = f"{token}.{thumbprint}"
-                published.append(token)
+                keyauth = f"{token}.{thumbprint}"
+                if alpn_dir:
+                    domain = authz.get("identifier", {}).get(
+                        "value", domains[0])
+                    cert_pem, key_pem = make_tls_alpn_challenge_cert(
+                        domain, keyauth)
+                    os.makedirs(alpn_dir, exist_ok=True)
+                    cert_path = os.path.join(alpn_dir, domain + ".pem")
+                    key_path = os.path.join(alpn_dir, domain + ".key")
+                    with open(key_path, "wb") as f:
+                        f.write(key_pem)
+                    with open(cert_path, "wb") as f:
+                        f.write(cert_pem)
+                    staged_files += [cert_path, key_path]
+                else:
+                    challenges[token] = keyauth
+                    published.append(token)
                 status, _, _ = await self._post(challenge["url"], {})
                 if status not in (200, 202):
                     raise AcmeError(f"challenge ready: {status}")
@@ -223,6 +287,11 @@ class AcmeClient:
         finally:
             for token in published:
                 challenges.pop(token, None)
+            for path in staged_files:  # challenge certs are ephemeral
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
 
 
 class AcmeManager:
@@ -230,11 +299,14 @@ class AcmeManager:
 
     def __init__(self, tls_dir: str, domains: list[str],
                  directory_url: str = LETSENCRYPT_PRODUCTION_URL,
-                 tls_manager=None):
+                 tls_manager=None, alpn_dir: Optional[str] = None):
         self.tls_dir = tls_dir
         self.domains = list(domains)
         self.directory_url = directory_url
         self.tls_manager = tls_manager
+        # tls-alpn-01 challenge-cert dir (native TLS transport answers
+        # from it); None -> http-01 via `challenges`.
+        self.alpn_dir = alpn_dir
         self.challenges: dict[str, str] = {}  # token -> key authorization
         self._task: Optional[asyncio.Task] = None
         self.client = AcmeClient(directory_url, *self._load_account())
@@ -326,7 +398,7 @@ class AcmeManager:
         for domain in needed:
             try:
                 cert_pem, key_pem = await self.client.order_certificate(
-                    [domain], self.challenges)
+                    [domain], self.challenges, alpn_dir=self.alpn_dir)
                 await self._install(domain, cert_pem, key_pem)
                 log.info("acme: certificate issued",
                          extra={"fields": {"domain": domain}})

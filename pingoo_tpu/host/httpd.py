@@ -156,6 +156,7 @@ class HttpListener:
         geoip: Optional[GeoipDB] = None,
         tls_context=None,
         acme_challenges: Optional[dict] = None,
+        trust_xff: bool = False,
     ):
         self.name = name
         self.host = host
@@ -168,6 +169,11 @@ class HttpListener:
         self.geoip = geoip
         self.tls_context = tls_context
         self.acme_challenges = acme_challenges
+        # When this listener runs as the control plane BEHIND the native
+        # data plane (which injects x-forwarded-for), the captcha client
+        # id must bind to the REAL client address, not the proxy's.
+        # Only enable behind a trusted front — XFF is client-forgeable.
+        self.trust_xff = trust_xff
         self.stats = ListenerStats()
         self._server: Optional[asyncio.AbstractServer] = None
 
@@ -290,6 +296,13 @@ class HttpListener:
     async def handle_request(self, req: Request, peer) -> Response:
         self.stats.requests += 1
         client_ip, client_port = str(peer[0]), int(peer[1])
+        if self.trust_xff:
+            for name, value in req.headers:
+                if name.lower() == "x-forwarded-for":
+                    first = value.split(",")[0].strip()
+                    if first:
+                        client_ip = first
+                    break
         host = get_host(req)
 
         geoip_record = GeoipRecord()

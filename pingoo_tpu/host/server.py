@@ -14,6 +14,7 @@ compile during config load) into the TPU plan + device tables.
 from __future__ import annotations
 
 import asyncio
+import os
 import signal
 from typing import Optional
 
@@ -95,10 +96,21 @@ class Server:
         if config.tls.acme is not None and config.tls.acme.domains:
             from .acme import AcmeManager
 
+            # Challenge type is an EXPLICIT deployment choice:
+            # PINGOO_TLS_ALPN=1 means the native TLS transport fronts
+            # port 443 and answers acme-tls/1 from <tls_dir>/alpn
+            # (tls-alpn-01, the reference's only challenge type,
+            # acme.rs:180-242). Without it, the Python-only deployment
+            # uses http-01 — inferring the mode from directory existence
+            # would silently break issuance either way.
+            alpn_dir = None
+            if os.environ.get("PINGOO_TLS_ALPN") == "1":
+                alpn_dir = os.path.join(self.tls_dir, "alpn")
+                os.makedirs(alpn_dir, exist_ok=True)
             self.acme = AcmeManager(
                 self.tls_dir, list(config.tls.acme.domains),
                 directory_url=config.tls.acme.directory_url,
-                tls_manager=tls_manager)
+                tls_manager=tls_manager, alpn_dir=alpn_dir)
             acme_challenges = self.acme.challenges
             await self.acme.start_in_background()
 

@@ -1,32 +1,60 @@
-// Native HTTP data plane: epoll listener -> verdict ring -> 403/proxy.
+// Native HTTP(S) data plane: epoll listener -> verdict ring -> action.
 //
 // The C++ half of the architecture split (SURVEY.md §7 item 1: "Host
 // data plane (C++): listeners ... proxying"): a non-blocking epoll event
-// loop accepts connections, parses HTTP/1.1 request heads, enqueues the
-// request tuple into the shared-memory verdict ring (pingoo_ring.h), and
-// on the TPU sidecar's verdict either serves 403 / a captcha redirect or
-// proxies the buffered request to the upstream and relays bytes both
-// ways. SO_REUSEPORT allows N listener processes on one port (the
-// reference's zero-downtime upgrade mechanism, listeners/mod.rs:57-61).
+// loop accepts plain-TCP or TLS connections, parses HTTP/1.1 requests,
+// enqueues each request's tuple into the shared-memory verdict ring
+// (pingoo_ring.h), and on the TPU sidecar's verdict either serves
+// 403 / a captcha redirect or proxies the request upstream.
+//
+// Per-REQUEST policy (reference hyper serves each request through the
+// rules loop, http_listener.rs:133-274): connections are keep-alive and
+// every request on them is framed (Content-Length / chunked), verdicted
+// through the ring, and proxied on its own upstream connection with
+// `connection: close` injected — bytes beyond the current request's
+// body are never forwarded, so pipelining cannot bypass the WAF.
+//
+// Captcha gate (reference http_listener.rs:200-236): requests under
+// /__pingoo/captcha are proxied to the control-plane upstream (the
+// Python listener serving the PoW API); the __pingoo_captcha_verified
+// cookie is verified HERE (Ed25519 JWT against the shared JWKS file,
+// claims exp/iss/challenge_passed/client_id — client_id =
+// b64url(SHA256(ip||ua||host)), captcha.rs:409-421). The verdict byte's
+// two lanes (bits 0-1 unverified action, bit 2 verified-block,
+// native_ring.py) are applied according to the client's verified state —
+// a verified client skips Captcha actions but still blocks on Block.
+//
+// TLS (reference listeners/mod.rs:112-154 LazyConfigAcceptor): a
+// client-hello callback inspects SNI + ALPN before any config is
+// chosen; `acme-tls/1` handshakes get the ephemeral tls-alpn-01
+// challenge certificate for the requested domain (RFC 8737; reference
+// acme.rs:180-242) and close after the handshake; everything else gets
+// the SNI-matched certificate (exact, then wildcard, then default).
+// Certificates live as <name>.pem/<name>.key pairs in --tls-dir
+// ("default" = fallback; "_.example.com" = *.example.com); challenge
+// certs as <domain>.pem/.key in --alpn-dir, re-read per handshake
+// because they are ephemeral.
 //
 // Event-loop invariants:
-//   * epoll data carries Conn* (nullptr = the listening socket); closes
-//     are deferred to the end of the batch so stale events for a reused
-//     fd can never touch a fresh connection.
-//   * SIGPIPE is ignored; every short/EAGAIN write buffers the
-//     remainder and arms EPOLLOUT, so relayed bytes are never dropped.
-//   * A sidecar stall (verdict ring full) fails OPEN: the request is
-//     proxied without a verdict, mirroring the reference's rule-error
-//     fail-open (pingoo/rules.rs:41-44).
-//   * Idle connections (no complete head, half-open peers) are swept
-//     after kIdleTimeoutS.
-//
-// Scope: HTTP/1.1, Connection: close semantics downstream+upstream.
-// TLS and h2 stay in the Python plane for now.
+//   * epoll data carries SockRef (conn, side); closes are deferred to
+//     the end of the batch so stale events for a reused fd can never
+//     touch a fresh connection.
+//   * SIGPIPE is ignored; short writes buffer and arm EPOLLOUT.
+//   * A sidecar stall fails OPEN twice over: ring-full -> proxy without
+//     a verdict immediately; a verdict never arriving -> the idle sweep
+//     fails the request open after kVerdictTimeoutS (mirrors the
+//     reference's rule-error fail-open, pingoo/rules.rs:41-44).
+//   * Idle sweeps cover every state: head/handshake after
+//     kIdleTimeoutS, awaiting-verdict after kVerdictTimeoutS (fail
+//     open), proxying after kProxyIdleTimeoutS.
 //
 // Usage: httpd <listen-port> <ring-file> <upstream-host> <upstream-port>
+//          [--captcha-upstream host:port] [--jwks path]
+//          [--tls-dir dir] [--alpn-dir dir]
+// TLS is enabled iff --tls-dir is given.
 
 #include <arpa/inet.h>
+#include <dirent.h>
 #include <errno.h>
 #include <fcntl.h>
 #include <netdb.h>
@@ -48,15 +76,645 @@
 #include <unordered_set>
 #include <vector>
 
+#include "ossl_shim.h"
 #include "pingoo_ring.h"
 
 namespace {
 
 constexpr size_t kMaxHead = 32 * 1024;
-constexpr size_t kMaxBuffered = 1 << 20;  // per-direction relay backlog cap
+constexpr size_t kMaxBuffered = 1 << 20;  // per-direction backlog cap
 constexpr time_t kIdleTimeoutS = 30;
+constexpr time_t kVerdictTimeoutS = 3;   // then fail open
+constexpr time_t kProxyIdleTimeoutS = 60;
+constexpr int kMaxRequestsPerConn = 1000;
 
-enum class ConnState { kReadingHead, kAwaitingVerdict, kProxying, kClosing };
+// ---------------------------------------------------------------------------
+// small utils
+
+int b64url_val(char c) {
+  if (c >= 'A' && c <= 'Z') return c - 'A';
+  if (c >= 'a' && c <= 'z') return c - 'a' + 26;
+  if (c >= '0' && c <= '9') return c - '0' + 52;
+  if (c == '-') return 62;
+  if (c == '_') return 63;
+  return -1;
+}
+
+bool b64url_decode(const std::string& in, std::string* out) {
+  out->clear();
+  int bits = 0, acc = 0;
+  for (char c : in) {
+    if (c == '=') break;
+    int v = b64url_val(c);
+    if (v < 0) return false;
+    acc = (acc << 6) | v;
+    bits += 6;
+    if (bits >= 8) {
+      bits -= 8;
+      out->push_back(static_cast<char>((acc >> bits) & 0xFF));
+    }
+  }
+  return true;
+}
+
+std::string b64url_encode(const unsigned char* data, size_t len) {
+  static const char tab[] =
+      "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789-_";
+  std::string out;
+  size_t i = 0;
+  while (i + 3 <= len) {
+    unsigned v = (data[i] << 16) | (data[i + 1] << 8) | data[i + 2];
+    out += tab[(v >> 18) & 63];
+    out += tab[(v >> 12) & 63];
+    out += tab[(v >> 6) & 63];
+    out += tab[v & 63];
+    i += 3;
+  }
+  if (len - i == 1) {
+    unsigned v = data[i] << 16;
+    out += tab[(v >> 18) & 63];
+    out += tab[(v >> 12) & 63];
+  } else if (len - i == 2) {
+    unsigned v = (data[i] << 16) | (data[i + 1] << 8);
+    out += tab[(v >> 18) & 63];
+    out += tab[(v >> 12) & 63];
+    out += tab[(v >> 6) & 63];
+  }
+  return out;
+}
+
+std::string lower(std::string s) {
+  for (auto& ch : s) ch = static_cast<char>(tolower(ch));
+  return s;
+}
+
+std::string trim(const std::string& s) {
+  size_t a = 0, b = s.size();
+  while (a < b && (s[a] == ' ' || s[a] == '\t')) ++a;
+  while (b > a && (s[b - 1] == ' ' || s[b - 1] == '\t' || s[b - 1] == '\r'))
+    --b;
+  return s.substr(a, b - a);
+}
+
+// Flat-JSON string field extraction ("key":"value"). Sufficient for the
+// JWT payloads and JWKS files this framework itself writes (no escapes
+// in base64url/id values; a token with escapes simply fails the gate,
+// which fails SAFE — the client is treated as unverified).
+bool json_str(const std::string& j, const std::string& key, std::string* out) {
+  std::string pat = "\"" + key + "\"";
+  size_t p = j.find(pat);
+  if (p == std::string::npos) return false;
+  p = j.find(':', p + pat.size());
+  if (p == std::string::npos) return false;
+  ++p;
+  while (p < j.size() && (j[p] == ' ')) ++p;
+  if (p >= j.size() || j[p] != '"') return false;
+  size_t e = j.find('"', p + 1);
+  if (e == std::string::npos) return false;
+  *out = j.substr(p + 1, e - p - 1);
+  return out->find('\\') == std::string::npos;
+}
+
+bool json_num(const std::string& j, const std::string& key, long long* out) {
+  std::string pat = "\"" + key + "\"";
+  size_t p = j.find(pat);
+  if (p == std::string::npos) return false;
+  p = j.find(':', p + pat.size());
+  if (p == std::string::npos) return false;
+  ++p;
+  while (p < j.size() && j[p] == ' ') ++p;
+  char* end = nullptr;
+  long long v = strtoll(j.c_str() + p, &end, 10);
+  if (end == j.c_str() + p) return false;
+  *out = v;
+  return true;
+}
+
+bool json_true(const std::string& j, const std::string& key) {
+  std::string pat = "\"" + key + "\"";
+  size_t p = j.find(pat);
+  if (p == std::string::npos) return false;
+  p = j.find(':', p + pat.size());
+  if (p == std::string::npos) return false;
+  ++p;
+  while (p < j.size() && j[p] == ' ') ++p;
+  return j.compare(p, 4, "true") == 0;
+}
+
+// ---------------------------------------------------------------------------
+// captcha-verified gate: Ed25519 JWT against the shared JWKS file
+
+class CaptchaGate {
+ public:
+  // Loads the first EdDSA key from the JWKS file (written by the Python
+  // CaptchaManager, host/captcha.py). Returns false if unavailable —
+  // the gate then treats every client as unverified (fail safe).
+  bool load(const char* jwks_path) {
+    FILE* f = fopen(jwks_path, "r");
+    if (!f) return false;
+    std::string text;
+    char buf[4096];
+    size_t n;
+    while ((n = fread(buf, 1, sizeof(buf), f)) > 0) text.append(buf, n);
+    fclose(f);
+    std::string x;
+    if (!json_str(text, "x", &x)) return false;
+    std::string raw;
+    if (!b64url_decode(x, &raw) || raw.size() != 32) return false;
+    pkey_ = EVP_PKEY_new_raw_public_key(
+        EVP_PKEY_ED25519, nullptr,
+        reinterpret_cast<const unsigned char*>(raw.data()), raw.size());
+    return pkey_ != nullptr;
+  }
+
+  bool available() const { return pkey_ != nullptr; }
+
+  // Mirrors host/jwt.py parse_and_verify + captcha.py is_verified:
+  // EdDSA alg, valid signature, exp within 5s drift, iss == "pingoo",
+  // challenge_passed == true, client_id constant-time-equals ours.
+  bool verify(const std::string& token, const std::string& client_id,
+              time_t now) const {
+    if (!pkey_) return false;
+    size_t d1 = token.find('.');
+    if (d1 == std::string::npos) return false;
+    size_t d2 = token.find('.', d1 + 1);
+    if (d2 == std::string::npos || token.find('.', d2 + 1) != std::string::npos)
+      return false;
+    std::string header_json, payload_json, sig;
+    if (!b64url_decode(token.substr(0, d1), &header_json)) return false;
+    if (!b64url_decode(token.substr(d1 + 1, d2 - d1 - 1), &payload_json))
+      return false;
+    if (!b64url_decode(token.substr(d2 + 1), &sig) || sig.size() != 64)
+      return false;
+    std::string alg;
+    if (!json_str(header_json, "alg", &alg) || alg != "EdDSA") return false;
+
+    EVP_MD_CTX* ctx = EVP_MD_CTX_new();
+    if (!ctx) return false;
+    bool ok = false;
+    if (EVP_DigestVerifyInit(ctx, nullptr, nullptr, nullptr, pkey_) == 1) {
+      const std::string signed_part = token.substr(0, d2);
+      ok = EVP_DigestVerify(
+               ctx, reinterpret_cast<const unsigned char*>(sig.data()),
+               sig.size(),
+               reinterpret_cast<const unsigned char*>(signed_part.data()),
+               signed_part.size()) == 1;
+    }
+    EVP_MD_CTX_free(ctx);
+    if (!ok) return false;
+
+    // exp is REQUIRED here (the CaptchaManager always sets it; a signed
+    // token without one would otherwise never expire on this plane).
+    long long exp = 0;
+    if (!json_num(payload_json, "exp", &exp) || exp + 5 < now) return false;
+    long long nbf = 0;
+    if (json_num(payload_json, "nbf", &nbf) && nbf - 5 > now) return false;
+    std::string iss;
+    if (!json_str(payload_json, "iss", &iss) || iss != "pingoo") return false;
+    if (!json_true(payload_json, "challenge_passed")) return false;
+    std::string cid;
+    if (!json_str(payload_json, "client_id", &cid)) return false;
+    if (cid.size() != client_id.size()) return false;
+    return CRYPTO_memcmp(cid.data(), client_id.data(), cid.size()) == 0;
+  }
+
+ private:
+  EVP_PKEY* pkey_ = nullptr;
+};
+
+std::string captcha_client_id(const std::string& ip, const std::string& ua,
+                              const std::string& host) {
+  std::string input = ip + ua + host;
+  unsigned char md[32];
+  unsigned int mdlen = 0;
+  EVP_Digest(input.data(), input.size(), md, &mdlen, EVP_sha256(), nullptr);
+  return b64url_encode(md, mdlen);
+}
+
+// ---------------------------------------------------------------------------
+// TLS: cert store + client-hello SNI/ALPN inspection
+
+struct TlsStore {
+  SSL_CTX* fallback = nullptr;                       // "default" pair
+  std::unordered_map<std::string, SSL_CTX*> exact;   // domain -> ctx
+  std::unordered_map<std::string, SSL_CTX*> wildcard;  // parent -> ctx
+  std::string alpn_dir;  // tls-alpn-01 challenge certs, may be empty
+
+  SSL_CTX* match(const std::string& name) const {
+    auto it = exact.find(name);
+    if (it != exact.end()) return it->second;
+    size_t dot = name.find('.');
+    if (dot != std::string::npos) {
+      auto w = wildcard.find(name.substr(dot + 1));
+      if (w != wildcard.end()) return w->second;
+    }
+    return fallback;
+  }
+};
+
+SSL_CTX* make_server_ctx(const std::string& cert, const std::string& key) {
+  SSL_CTX* ctx = SSL_CTX_new(TLS_server_method());
+  if (!ctx) return nullptr;
+  // Partial-write + moving-buffer + auto-retry (SSL_CTRL_MODE): the
+  // event loop retries writes from a std::string that may reallocate.
+  SSL_CTX_ctrl(ctx, /*SSL_CTRL_MODE=*/33, 7, nullptr);
+  SSL_CTX_set_min_proto_version_shim(ctx, TLS1_2_VERSION);
+  if (SSL_CTX_use_certificate_chain_file(ctx, cert.c_str()) != 1 ||
+      SSL_CTX_use_PrivateKey_file(ctx, key.c_str(), SSL_FILETYPE_PEM) != 1 ||
+      SSL_CTX_check_private_key(ctx) != 1) {
+    SSL_CTX_free(ctx);
+    ERR_clear_error();
+    return nullptr;
+  }
+  return ctx;
+}
+
+bool load_tls_store(const char* dir, TlsStore* store) {
+  DIR* d = opendir(dir);
+  if (!d) return false;
+  dirent* ent;
+  while ((ent = readdir(d)) != nullptr) {
+    std::string fname = ent->d_name;
+    if (fname.size() < 5 || fname.compare(fname.size() - 4, 4, ".pem") != 0)
+      continue;
+    std::string base = fname.substr(0, fname.size() - 4);
+    std::string cert = std::string(dir) + "/" + fname;
+    std::string key = std::string(dir) + "/" + base + ".key";
+    SSL_CTX* ctx = make_server_ctx(cert, key);
+    if (!ctx) continue;
+    if (base == "default") {
+      store->fallback = ctx;
+    } else if (base.size() > 2 && base[0] == '_' && base[1] == '.') {
+      store->wildcard[base.substr(2)] = ctx;
+    } else {
+      store->exact[base] = ctx;
+    }
+  }
+  closedir(d);
+  return store->fallback != nullptr || !store->exact.empty() ||
+         !store->wildcard.empty();
+}
+
+// A hostname safe to use as a lookup key AND a file-name component
+// (the tls-alpn-01 challenge path is built from it): DNS charset only,
+// no dot-runs — rejects "../" traversal outright.
+bool valid_sni_name(const std::string& s) {
+  if (s.empty() || s.size() > 253 || s[0] == '.' || s[0] == '-') return false;
+  char prev = 0;
+  for (char ch : s) {
+    bool ok = (ch >= 'a' && ch <= 'z') || (ch >= 'A' && ch <= 'Z') ||
+              (ch >= '0' && ch <= '9') || ch == '.' || ch == '-';
+    if (!ok) return false;
+    if (ch == '.' && prev == '.') return false;
+    prev = ch;
+  }
+  return true;
+}
+
+// Parse SNI host out of the raw server_name ClientHello extension.
+std::string parse_sni_ext(const unsigned char* p, size_t len) {
+  if (len < 5) return "";
+  size_t list_len = (p[0] << 8) | p[1];
+  if (list_len + 2 > len || p[2] != 0) return "";  // type 0 = host_name
+  size_t name_len = (p[3] << 8) | p[4];
+  if (5 + name_len > len) return "";
+  std::string name(reinterpret_cast<const char*>(p + 5), name_len);
+  return valid_sni_name(name) ? name : "";
+}
+
+bool alpn_ext_offers(const unsigned char* p, size_t len, const char* proto) {
+  if (len < 2) return false;
+  size_t list_len = (p[0] << 8) | p[1];
+  size_t plen = strlen(proto);
+  size_t i = 2;
+  if (2 + list_len > len) return false;
+  while (i < 2 + list_len) {
+    size_t n = p[i];
+    if (i + 1 + n > len) return false;
+    if (n == plen && memcmp(p + i + 1, proto, n) == 0) return true;
+    i += 1 + n;
+  }
+  return false;
+}
+
+// ---------------------------------------------------------------------------
+// HTTP message framing
+
+struct BodyFramer {
+  enum Mode { kNone, kContentLength, kChunked, kUntilEof } mode = kNone;
+  long long remaining = 0;  // kContentLength
+  // chunked state
+  enum CState { kSize, kData, kDataCrlf, kTrailer } cstate = kSize;
+  std::string linebuf;
+  bool done = false;
+
+  void reset_none() { *this = BodyFramer(); done = true; }
+  void reset_cl(long long n) {
+    *this = BodyFramer();
+    mode = kContentLength;
+    remaining = n;
+    done = n == 0;
+  }
+  void reset_chunked() {
+    *this = BodyFramer();
+    mode = kChunked;
+  }
+  void reset_eof() {
+    *this = BodyFramer();
+    mode = kUntilEof;
+  }
+
+  // How many of data[0..len) belong to the current message. Sets done.
+  size_t consume(const char* data, size_t len) {
+    if (done) return 0;
+    switch (mode) {
+      case kNone:
+        done = true;
+        return 0;
+      case kUntilEof:
+        return len;  // done only at EOF (caller decides)
+      case kContentLength: {
+        size_t take = static_cast<size_t>(remaining) < len
+                          ? static_cast<size_t>(remaining)
+                          : len;
+        remaining -= static_cast<long long>(take);
+        if (remaining == 0) done = true;
+        return take;
+      }
+      case kChunked:
+        return consume_chunked(data, len);
+    }
+    return 0;
+  }
+
+  size_t consume_chunked(const char* data, size_t len) {
+    size_t used = 0;
+    while (used < len && !done) {
+      char c = data[used];
+      switch (cstate) {
+        case kSize:
+          linebuf.push_back(c);
+          ++used;
+          if (linebuf.size() > 1024) { done = true; return used; }  // junk
+          if (linebuf.size() >= 2 &&
+              linebuf.compare(linebuf.size() - 2, 2, "\r\n") == 0) {
+            long long sz = strtoll(linebuf.c_str(), nullptr, 16);
+            linebuf.clear();
+            if (sz == 0) {
+              cstate = kTrailer;
+            } else {
+              remaining = sz;
+              cstate = kData;
+            }
+          }
+          break;
+        case kData: {
+          size_t take = static_cast<size_t>(remaining) < (len - used)
+                            ? static_cast<size_t>(remaining)
+                            : (len - used);
+          remaining -= static_cast<long long>(take);
+          used += take;
+          if (remaining == 0) cstate = kDataCrlf;
+          break;
+        }
+        case kDataCrlf:
+          linebuf.push_back(c);
+          ++used;
+          if (linebuf.size() == 2) {
+            linebuf.clear();
+            cstate = kSize;
+          }
+          break;
+        case kTrailer:
+          linebuf.push_back(c);
+          ++used;
+          if (linebuf.size() >= 2 &&
+              linebuf.compare(linebuf.size() - 2, 2, "\r\n") == 0) {
+            if (linebuf == "\r\n") {
+              done = true;  // empty line ends trailers
+            }
+            linebuf.clear();
+          }
+          break;
+      }
+    }
+    return used;
+  }
+};
+
+struct Parsed {
+  std::string method, target, path, host, user_agent;
+  std::string verified_cookie;  // __pingoo_captcha_verified value
+  long long content_length = 0;
+  bool chunked = false;
+  bool has_transfer_encoding = false;
+  bool keep_alive = true;  // HTTP/1.1 default
+  bool ok = false;
+  std::string raw_head;  // original head (without final CRLF CRLF)
+};
+
+// Parse a request head (request line + headers).
+Parsed parse_head(const std::string& head) {
+  Parsed p;
+  size_t line_end = head.find("\r\n");
+  if (line_end == std::string::npos) return p;
+  const std::string line = head.substr(0, line_end);
+  size_t sp1 = line.find(' ');
+  size_t sp2 = line.rfind(' ');
+  if (sp1 == std::string::npos || sp2 == sp1) return p;
+  p.method = line.substr(0, sp1);
+  p.target = line.substr(sp1 + 1, sp2 - sp1 - 1);
+  if (p.method.empty() || p.target.empty()) return p;
+  if (line.compare(sp2 + 1, 8, "HTTP/1.1") == 0) {
+    p.keep_alive = true;
+  } else if (line.compare(sp2 + 1, 8, "HTTP/1.0") == 0) {
+    p.keep_alive = false;
+  } else {
+    return p;
+  }
+  size_t q = p.target.find('?');
+  p.path = q == std::string::npos ? p.target : p.target.substr(0, q);
+
+  size_t pos = line_end + 2;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos || eol == pos) break;
+    size_t colon = head.find(':', pos);
+    if (colon != std::string::npos && colon < eol) {
+      std::string name = lower(head.substr(pos, colon - pos));
+      std::string value = trim(head.substr(colon + 1, eol - colon - 1));
+      if (name == "host") {
+        size_t port_colon = value.rfind(':');
+        // bracketed IPv6 hosts keep their brackets, strip only a port
+        if (value.size() && value[0] == '[') {
+          size_t close = value.find(']');
+          p.host = close == std::string::npos ? value
+                                              : value.substr(0, close + 1);
+        } else {
+          p.host = port_colon == std::string::npos
+                       ? value
+                       : value.substr(0, port_colon);
+        }
+      } else if (name == "user-agent") {
+        p.user_agent = value;
+      } else if (name == "content-length") {
+        p.content_length = strtoll(value.c_str(), nullptr, 10);
+      } else if (name == "transfer-encoding") {
+        p.has_transfer_encoding = true;
+        if (lower(value).find("chunked") != std::string::npos)
+          p.chunked = true;
+      } else if (name == "connection") {
+        std::string v = lower(value);
+        if (v.find("close") != std::string::npos) p.keep_alive = false;
+        if (v.find("keep-alive") != std::string::npos) p.keep_alive = true;
+      } else if (name == "cookie" && p.verified_cookie.empty()) {
+        // find __pingoo_captcha_verified=...
+        size_t cp = 0;
+        while (cp < value.size()) {
+          size_t semi = value.find(';', cp);
+          std::string part = trim(value.substr(
+              cp, semi == std::string::npos ? std::string::npos : semi - cp));
+          size_t eq = part.find('=');
+          if (eq != std::string::npos &&
+              part.substr(0, eq) == "__pingoo_captcha_verified") {
+            p.verified_cookie = part.substr(eq + 1);
+            break;
+          }
+          if (semi == std::string::npos) break;
+          cp = semi + 1;
+        }
+      }
+    }
+    pos = eol + 2;
+  }
+  p.raw_head = head;
+  p.ok = true;
+  return p;
+}
+
+bool is_hop_header(const std::string& lname) {
+  return lname == "connection" || lname == "keep-alive" ||
+         lname == "proxy-connection" || lname == "upgrade" ||
+         lname == "te" || lname == "trailer" ||
+         lname == "proxy-authenticate" || lname == "proxy-authorization";
+}
+
+bool drop_request_header(const std::string& lname, bool chunked);
+
+// Rewrite the client's request head for the upstream: strip hop-by-hop
+// headers, inject connection: close (one upstream connection per
+// verdicted request — the enforced scope), add forwarding headers
+// (reference http_proxy_service.rs:114-190).
+std::string rewrite_request_head(const Parsed& p, const std::string& client_ip,
+                                 bool tls) {
+  const std::string& head = p.raw_head;
+  size_t line_end = head.find("\r\n");
+  std::string out = head.substr(0, line_end + 2);
+  size_t pos = line_end + 2;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos || eol == pos) break;
+    size_t colon = head.find(':', pos);
+    std::string lname = colon != std::string::npos && colon < eol
+                            ? lower(head.substr(pos, colon - pos))
+                            : "";
+    if (!drop_request_header(lname, p.chunked)) {
+      out.append(head, pos, eol + 2 - pos);
+    }
+    pos = eol + 2;
+  }
+  out += "connection: close\r\n";
+  out += "x-forwarded-for: " + client_ip + "\r\n";
+  out += std::string("x-forwarded-proto: ") + (tls ? "https" : "http") + "\r\n";
+  if (!p.host.empty()) out += "x-forwarded-host: " + p.host + "\r\n";
+  out += "pingoo-client-ip: " + client_ip + "\r\n";
+  out += "\r\n";
+  return out;
+}
+
+// is_hop_header, plus the request-smuggling hygiene rule (RFC 7230
+// §3.3.3): when Transfer-Encoding frames the body, any Content-Length
+// must NOT reach the upstream — the proxy framed by TE and a
+// CL-trusting upstream would see a different body boundary.
+bool drop_request_header(const std::string& lname, bool chunked) {
+  if (is_hop_header(lname)) return true;
+  if (chunked && lname == "content-length") return true;
+  return lname == "x-forwarded-for" || lname == "x-forwarded-proto" ||
+         lname == "x-forwarded-host";
+}
+
+// Parsed upstream response head.
+struct RespHead {
+  int status = 0;
+  bool chunked = false;
+  long long content_length = -1;  // -1 = absent
+  std::string rewritten;          // head to send downstream
+  bool ok = false;
+};
+
+// Rewrite the upstream response head for the client: strip hop-by-hop
+// headers and upstream server identity, set server: pingoo (reference
+// http_proxy_service.rs:37-43,197-201), and pin the connection header
+// to our keep-alive decision.
+RespHead rewrite_response_head(const std::string& head, bool client_keep) {
+  RespHead r;
+  size_t line_end = head.find("\r\n");
+  if (line_end == std::string::npos) return r;
+  const std::string line = head.substr(0, line_end);
+  // Shortest legal status line is "HTTP/1.x NNN" (12 chars); anything
+  // shorter would index out of bounds below.
+  if (line.size() < 12 || line.compare(0, 7, "HTTP/1.") != 0 ||
+      line[8] != ' ')
+    return r;
+  r.status = atoi(line.c_str() + 9);
+  if (r.status < 100 || r.status > 999) return r;
+  std::string out = "HTTP/1.1" + line.substr(8) + "\r\n";
+  size_t pos = line_end + 2;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos || eol == pos) break;
+    size_t colon = head.find(':', pos);
+    std::string lname = colon != std::string::npos && colon < eol
+                            ? lower(head.substr(pos, colon - pos))
+                            : "";
+    std::string value = colon != std::string::npos && colon < eol
+                            ? trim(head.substr(colon + 1, eol - colon - 1))
+                            : "";
+    if (lname == "transfer-encoding") {
+      if (lower(value).find("chunked") != std::string::npos) r.chunked = true;
+      out.append(head, pos, eol + 2 - pos);
+    } else if (lname == "content-length") {
+      r.content_length = strtoll(value.c_str(), nullptr, 10);
+      out.append(head, pos, eol + 2 - pos);
+    } else if (is_hop_header(lname) || lname == "server" ||
+               lname == "x-accel-buffering" || lname == "alt-svc") {
+      // dropped
+    } else {
+      out.append(head, pos, eol + 2 - pos);
+    }
+    pos = eol + 2;
+  }
+  out += "server: pingoo\r\n";
+  bool has_body_framing = r.chunked || r.content_length >= 0 ||
+                          r.status == 204 || r.status == 304;
+  bool keep = client_keep && has_body_framing;
+  out += keep ? "connection: keep-alive\r\n" : "connection: close\r\n";
+  out += "\r\n";
+  r.rewritten = out;
+  r.ok = true;
+  return r;
+}
+
+// ---------------------------------------------------------------------------
+// connections
+
+enum class ConnState {
+  kHandshake,
+  kReadingHead,
+  kAwaitingVerdict,
+  kProxying,
+  kClosing,  // drain outbuf, then close
+};
 
 struct Conn;
 
@@ -68,17 +726,36 @@ struct SockRef {
 struct Conn {
   int fd = -1;
   int upstream_fd = -1;
+  SSL* ssl = nullptr;           // non-null on TLS connections
+  SSL_CTX* owned_ctx = nullptr;  // per-conn challenge ctx (tls-alpn-01)
+  bool ssl_want_write = false;
+  bool acme_challenge = false;
   ConnState state = ConnState::kReadingHead;
-  std::string inbuf;    // buffered request bytes (head + any body read)
-  std::string outbuf;   // bytes pending to client
-  std::string upbuf;    // bytes pending to upstream
+
+  std::string inbuf;   // client bytes not yet consumed
+  std::string outbuf;  // bytes pending to client
+  std::string upbuf;   // bytes pending to upstream
+
+  // current request cycle
+  Parsed req;
+  BodyFramer req_body;
+  bool req_body_forwarded = false;  // all request bytes handed to upbuf
+  bool captcha_verified = false;
+  int requests_served = 0;
+
+  // upstream response
+  std::string resp_head_buf;
+  bool resp_head_done = false;
+  BodyFramer resp_body;
+  bool close_after_response = false;
+
   uint64_t ticket = UINT64_MAX;
   char peer_ip[INET6_ADDRSTRLEN] = {0};
   uint16_t peer_port = 0;
-  bool dead = false;           // queued for deferred deletion
+  bool dead = false;
   bool upstream_connected = false;
-  bool client_eof = false;
   bool upstream_eof = false;
+  bool client_eof = false;
   time_t last_active = 0;
   SockRef client_ref;
   SockRef upstream_ref;
@@ -100,61 +777,25 @@ const char k400[] =
     "HTTP/1.1 400 Bad Request\r\nserver: pingoo\r\n"
     "content-length: 0\r\nconnection: close\r\n\r\n";
 
-struct Parsed {
-  std::string method, target, path, host, user_agent;
-  bool ok = false;
-};
-
-// Minimal HTTP/1.1 head parser: request line + the headers the verdict
-// tuple needs (reference hot path extracts the same fields,
-// http_listener.rs:140-165).
-Parsed parse_head(const std::string& head) {
-  Parsed p;
-  size_t line_end = head.find("\r\n");
-  if (line_end == std::string::npos) return p;
-  const std::string line = head.substr(0, line_end);
-  size_t sp1 = line.find(' ');
-  size_t sp2 = line.rfind(' ');
-  if (sp1 == std::string::npos || sp2 == sp1) return p;
-  p.method = line.substr(0, sp1);
-  p.target = line.substr(sp1 + 1, sp2 - sp1 - 1);
-  if (p.method.empty() || p.target.empty() ||
-      line.compare(sp2 + 1, 8, "HTTP/1.1") != 0)
-    return p;
-  size_t q = p.target.find('?');
-  p.path = q == std::string::npos ? p.target : p.target.substr(0, q);
-
-  size_t pos = line_end + 2;
-  while (pos < head.size()) {
-    size_t eol = head.find("\r\n", pos);
-    if (eol == std::string::npos || eol == pos) break;
-    size_t colon = head.find(':', pos);
-    if (colon != std::string::npos && colon < eol) {
-      std::string name = head.substr(pos, colon - pos);
-      for (auto& ch : name) ch = static_cast<char>(tolower(ch));
-      size_t vstart = colon + 1;
-      while (vstart < eol && head[vstart] == ' ') ++vstart;
-      std::string value = head.substr(vstart, eol - vstart);
-      if (name == "host") {
-        size_t port_colon = value.rfind(':');
-        p.host = port_colon == std::string::npos ? value
-                                                 : value.substr(0, port_colon);
-      } else if (name == "user-agent") {
-        p.user_agent = value;
-      }
-    }
-    pos = eol + 2;
-  }
-  p.ok = true;
-  return p;
-}
-
 class Server {
  public:
-  Server(int ep, void* ring, const sockaddr_in& upstream)
-      : ep_(ep), ring_(ring), upstream_(upstream) {}
+  Server(int ep, void* ring, const sockaddr_in& upstream,
+         const sockaddr_in* captcha_upstream, CaptchaGate* gate,
+         TlsStore* tls)
+      : ep_(ep),
+        ring_(ring),
+        upstream_(upstream),
+        gate_(gate),
+        tls_(tls) {
+    if (captcha_upstream) {
+      captcha_upstream_ = *captcha_upstream;
+      has_captcha_upstream_ = true;
+    }
+  }
 
-  void add_client(int cfd, const sockaddr_in& peer) {
+  TlsStore* tls() { return tls_; }
+
+  void add_client(int cfd, const sockaddr_in& peer, SSL_CTX* base_ctx) {
     Conn* c = new Conn();
     c->fd = cfd;
     c->last_active = now_;
@@ -163,11 +804,26 @@ class Server {
     c->upstream_ref.is_upstream = true;
     inet_ntop(AF_INET, &peer.sin_addr, c->peer_ip, sizeof(c->peer_ip));
     c->peer_port = ntohs(peer.sin_port);
+    if (base_ctx != nullptr) {
+      c->ssl = SSL_new(base_ctx);
+      SSL_set_fd(c->ssl, cfd);
+      SSL_set_accept_state(c->ssl);
+      c->state = ConnState::kHandshake;
+      // The client-hello callback needs the Conn to stash challenge
+      // state; OpenSSL gives us per-SSL ex_data, but a side map is
+      // simpler with the shim surface we declare.
+      ssl_conn_[c->ssl] = c;
+    }
     conns_.insert(c);
     epoll_event ce{};
     ce.events = EPOLLIN;
     ce.data.ptr = &c->client_ref;
     epoll_ctl(ep_, EPOLL_CTL_ADD, cfd, &ce);
+  }
+
+  Conn* conn_for_ssl(SSL* ssl) {
+    auto it = ssl_conn_.find(ssl);
+    return it == ssl_conn_.end() ? nullptr : it->second;
   }
 
   void mark_close(Conn* c) {
@@ -178,11 +834,18 @@ class Server {
 
   void flush_doomed() {
     for (Conn* c : doomed_) {
-      if (c->fd >= 0) { epoll_ctl(ep_, EPOLL_CTL_DEL, c->fd, nullptr);
-                        close(c->fd); }
-      if (c->upstream_fd >= 0) { epoll_ctl(ep_, EPOLL_CTL_DEL,
-                                           c->upstream_fd, nullptr);
-                                 close(c->upstream_fd); }
+      if (c->ssl) {
+        SSL_shutdown(c->ssl);
+        ssl_conn_.erase(c->ssl);
+        SSL_free(c->ssl);
+        ERR_clear_error();
+      }
+      if (c->owned_ctx) SSL_CTX_free(c->owned_ctx);
+      if (c->fd >= 0) {
+        epoll_ctl(ep_, EPOLL_CTL_DEL, c->fd, nullptr);
+        close(c->fd);
+      }
+      close_upstream(c);
       if (c->ticket != UINT64_MAX) awaiting_.erase(c->ticket);
       conns_.erase(c);
       delete c;
@@ -194,47 +857,193 @@ class Server {
 
   void sweep_idle() {
     for (Conn* c : conns_) {
-      if (!c->dead && c->state == ConnState::kReadingHead &&
-          now_ - c->last_active > kIdleTimeoutS) {
-        mark_close(c);
+      if (c->dead) continue;
+      time_t idle = now_ - c->last_active;
+      switch (c->state) {
+        case ConnState::kHandshake:
+        case ConnState::kReadingHead:
+        case ConnState::kClosing:
+          if (idle > kIdleTimeoutS) mark_close(c);
+          break;
+        case ConnState::kAwaitingVerdict:
+          // A stalled/crashed sidecar must not leak connections: fail
+          // OPEN like the ring-full path (pingoo/rules.rs:41-44).
+          if (idle > kVerdictTimeoutS) {
+            if (c->ticket != UINT64_MAX) {
+              awaiting_.erase(c->ticket);
+              c->ticket = UINT64_MAX;
+            }
+            start_proxy(c, upstream_);
+          }
+          break;
+        case ConnState::kProxying:
+          if (idle > kProxyIdleTimeoutS) mark_close(c);
+          break;
       }
     }
   }
 
-  void arm(Conn* c, int fd, uint32_t events) {
+  // -- transport (plain / TLS) ----------------------------------------------
+
+  // >0 bytes, 0 clean EOF, -1 would-block, -2 error.
+  ssize_t t_read(Conn* c, char* buf, size_t n) {
+    if (c->ssl == nullptr) {
+      ssize_t r = read(c->fd, buf, n);
+      if (r > 0) return r;
+      if (r == 0) return 0;
+      return (errno == EAGAIN || errno == EWOULDBLOCK) ? -1 : -2;
+    }
+    int r = SSL_read(c->ssl, buf, static_cast<int>(n));
+    if (r > 0) return r;
+    int err = SSL_get_error(c->ssl, r);
+    ERR_clear_error();
+    if (err == SSL_ERROR_ZERO_RETURN) return 0;
+    if (err == SSL_ERROR_WANT_READ) return -1;
+    if (err == SSL_ERROR_WANT_WRITE) {
+      c->ssl_want_write = true;
+      return -1;
+    }
+    return -2;
+  }
+
+  ssize_t t_write(Conn* c, const char* buf, size_t n) {
+    if (c->ssl == nullptr) {
+      ssize_t w = send(c->fd, buf, n, MSG_NOSIGNAL);
+      if (w >= 0) return w;
+      return (errno == EAGAIN || errno == EWOULDBLOCK) ? -1 : -2;
+    }
+    int w = SSL_write(c->ssl, buf, static_cast<int>(n));
+    if (w > 0) return w;
+    int err = SSL_get_error(c->ssl, w);
+    ERR_clear_error();
+    if (err == SSL_ERROR_WANT_WRITE) {
+      c->ssl_want_write = true;
+      return -1;
+    }
+    if (err == SSL_ERROR_WANT_READ) return -1;
+    return -2;
+  }
+
+  // Flush c->outbuf to the client; false = connection error.
+  bool flush_out(Conn* c) {
+    while (!c->outbuf.empty()) {
+      ssize_t w = t_write(c, c->outbuf.data(), c->outbuf.size());
+      if (w > 0) {
+        c->outbuf.erase(0, static_cast<size_t>(w));
+      } else if (w == -1) {
+        break;
+      } else {
+        return false;
+      }
+    }
+    return true;
+  }
+
+  void update_client_events(Conn* c) {
+    uint32_t ev = 0;
+    switch (c->state) {
+      case ConnState::kHandshake:
+      case ConnState::kReadingHead:
+        ev = EPOLLIN;
+        break;
+      case ConnState::kAwaitingVerdict:
+        ev = 0;
+        break;
+      case ConnState::kProxying:
+        // Level-triggered epoll: a half-closed or backpressured client
+        // with EPOLLIN armed would wake the loop forever — disarm the
+        // read side at EOF / at the buffered cap.
+        if (!c->client_eof && c->inbuf.size() < kMaxBuffered) ev = EPOLLIN;
+        break;
+      case ConnState::kClosing:
+        ev = 0;
+        break;
+    }
+    if (!c->outbuf.empty() || c->ssl_want_write) ev |= EPOLLOUT;
     epoll_event e{};
-    e.events = events;
-    e.data.ptr = fd == c->upstream_fd ? &c->upstream_ref : &c->client_ref;
-    epoll_ctl(ep_, EPOLL_CTL_MOD, fd, &e);
+    e.events = ev;
+    e.data.ptr = &c->client_ref;
+    epoll_ctl(ep_, EPOLL_CTL_MOD, c->fd, &e);
+  }
+
+  void update_upstream_events(Conn* c) {
+    if (c->upstream_fd < 0) return;
+    uint32_t ev = 0;
+    // Same level-trigger discipline: stop reading an EOF'd upstream and
+    // pause reads while the client-side buffer is at its cap.
+    if (!c->upstream_eof && c->outbuf.size() < kMaxBuffered) ev = EPOLLIN;
+    if (!c->upbuf.empty() || !c->upstream_connected) ev |= EPOLLOUT;
+    epoll_event e{};
+    e.events = ev;
+    e.data.ptr = &c->upstream_ref;
+    epoll_ctl(ep_, EPOLL_CTL_MOD, c->upstream_fd, &e);
   }
 
   // Queue a canned response and switch to drain-then-close.
   void respond_close(Conn* c, const char* response) {
     c->outbuf.append(response);
     c->state = ConnState::kClosing;
-    arm(c, c->fd, EPOLLOUT);
+    if (!flush_out(c)) {
+      mark_close(c);
+      return;
+    }
+    if (c->outbuf.empty()) {
+      mark_close(c);
+      return;
+    }
+    update_client_events(c);
   }
 
-  void start_proxy(Conn* c) {
+  void close_upstream(Conn* c) {
+    if (c->upstream_fd >= 0) {
+      epoll_ctl(ep_, EPOLL_CTL_DEL, c->upstream_fd, nullptr);
+      close(c->upstream_fd);
+      c->upstream_fd = -1;
+    }
+    c->upstream_connected = false;
+    c->upstream_eof = false;
+  }
+
+  void start_proxy(Conn* c, const sockaddr_in& target) {
     int ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     if (ufd < 0 ||
-        (connect(ufd, reinterpret_cast<const sockaddr*>(&upstream_),
-                 sizeof(upstream_)) != 0 &&
+        (connect(ufd, reinterpret_cast<const sockaddr*>(&target),
+                 sizeof(target)) != 0 &&
          errno != EINPROGRESS)) {
       if (ufd >= 0) close(ufd);
       respond_close(c, k502);
       return;
     }
     c->upstream_fd = ufd;
-    c->upbuf = c->inbuf;
     c->state = ConnState::kProxying;
-    upstream_conn_[ufd] = c;
+    c->resp_head_buf.clear();
+    c->resp_head_done = false;
+    c->upstream_eof = false;
+    c->last_active = now_;
+
+    // Rewritten head + whatever request-body bytes are already buffered.
+    c->upbuf = rewrite_request_head(c->req, c->peer_ip, c->ssl != nullptr);
+    pump_request_body(c);
+
     epoll_event ue{};
     ue.events = EPOLLOUT | EPOLLIN;
     ue.data.ptr = &c->upstream_ref;
     epoll_ctl(ep_, EPOLL_CTL_ADD, ufd, &ue);
-    arm(c, c->fd, EPOLLIN);
+    update_client_events(c);
   }
+
+  // Move request-body bytes from inbuf into upbuf per the framer.
+  void pump_request_body(Conn* c) {
+    if (c->req_body_forwarded) return;
+    if (!c->inbuf.empty() && !c->req_body.done) {
+      size_t take = c->req_body.consume(c->inbuf.data(), c->inbuf.size());
+      c->upbuf.append(c->inbuf, 0, take);
+      c->inbuf.erase(0, take);
+    }
+    if (c->req_body.done) c->req_body_forwarded = true;
+  }
+
+  // -- verdict flow ---------------------------------------------------------
 
   void drain_verdicts() {
     uint64_t ticket;
@@ -247,173 +1056,432 @@ class Server {
       awaiting_.erase(it);
       c->ticket = UINT64_MAX;
       if (c->dead) continue;
-      // Verdict byte: bits 0-1 = unverified-client action, bit 2 =
-      // verified-client block (native_ring.py RingSidecar). Clients are
-      // treated as unverified until the cookie gate lands here.
-      uint8_t unverified = action & 3;
-      if (unverified == 1) respond_close(c, k403);
-      else if (unverified == 2) respond_close(c, kCaptcha);
-      else start_proxy(c);
+      apply_verdict(c, action);
     }
+  }
+
+  // Verdict byte: bits 0-1 unverified action, bit 2 verified-block
+  // (native_ring.py RingSidecar) — the reference loop skips Captcha
+  // actions for verified clients but still blocks on Block
+  // (http_listener.rs:251-264).
+  void apply_verdict(Conn* c, uint8_t action) {
+    if (c->captcha_verified) {
+      if (action & 4) {
+        respond_close(c, k403);
+      } else {
+        start_proxy(c, upstream_);
+      }
+      return;
+    }
+    uint8_t unverified = action & 3;
+    if (unverified == 1) {
+      respond_close(c, k403);
+    } else if (unverified == 2) {
+      respond_close(c, kCaptcha);
+    } else {
+      start_proxy(c, upstream_);
+    }
+  }
+
+  // -- request cycle --------------------------------------------------------
+
+  void begin_request_cycle(Conn* c) {
+    c->state = ConnState::kReadingHead;
+    c->req = Parsed();
+    c->req_body = BodyFramer();
+    c->req_body_forwarded = false;
+    c->captcha_verified = false;
+    c->resp_head_buf.clear();
+    c->resp_head_done = false;
+    c->resp_body = BodyFramer();
+    c->close_after_response = false;
+    // Pipelined bytes may already hold the next request.
+    if (!c->inbuf.empty() || c->client_eof) try_process_head(c, c->client_eof);
+    if (!c->dead && c->state == ConnState::kReadingHead)
+      update_client_events(c);
   }
 
   void on_client_readable(Conn* c) {
     c->last_active = now_;
     char buf[16384];
-    ssize_t r;
-    while ((r = read(c->fd, buf, sizeof(buf))) > 0) {
-      c->inbuf.append(buf, static_cast<size_t>(r));
-      if (c->inbuf.size() > kMaxHead) { mark_close(c); return; }
+    bool eof = false;
+    for (;;) {
+      ssize_t r = t_read(c, buf, sizeof(buf));
+      if (r > 0) {
+        c->inbuf.append(buf, static_cast<size_t>(r));
+        if (c->inbuf.size() > kMaxHead + kMaxBuffered) {
+          mark_close(c);
+          return;
+        }
+      } else if (r == 0) {
+        eof = true;
+        break;
+      } else if (r == -1) {
+        break;
+      } else {
+        mark_close(c);
+        return;
+      }
     }
-    bool eof = (r == 0);
+    try_process_head(c, eof);
+  }
+
+  void try_process_head(Conn* c, bool eof) {
+    if (c->state != ConnState::kReadingHead) {
+      if (eof && c->state != ConnState::kProxying) mark_close(c);
+      return;
+    }
     size_t head_end = c->inbuf.find("\r\n\r\n");
     if (head_end == std::string::npos) {
-      // EOF before a complete head: nothing more will arrive.
-      if (eof) mark_close(c);
+      if (c->inbuf.size() > kMaxHead) {
+        respond_close(c, k400);
+        return;
+      }
+      if (eof) mark_close(c);  // EOF before a complete head
       return;
     }
     Parsed p = parse_head(c->inbuf.substr(0, head_end + 4));
-    if (!p.ok) { respond_close(c, k400); return; }
+    if (!p.ok) {
+      respond_close(c, k400);
+      return;
+    }
+    c->inbuf.erase(0, head_end + 4);
+    c->req = p;
+    if (++c->requests_served > kMaxRequestsPerConn) c->req.keep_alive = false;
+
+    // A Transfer-Encoding we cannot frame (anything but chunked) would
+    // desync the proxy from the upstream: refuse it.
+    if (p.has_transfer_encoding && !p.chunked) {
+      respond_close(c, k400);
+      return;
+    }
+    // Request body framing (bytes beyond it are the NEXT request and
+    // are never forwarded with this one).
+    if (p.chunked) {
+      c->req_body.reset_chunked();
+    } else if (p.content_length > 0) {
+      c->req_body.reset_cl(p.content_length);
+    } else {
+      c->req_body.reset_none();
+    }
+    c->req_body_forwarded = c->req_body.done;
+
     // Empty or oversized UA -> 403 before the ring. The >= is the
-    // reference's own explicit check (http_listener.rs:196: len >=
-    // USER_AGENT_MAX_LENGTH blocks an exactly-256-byte UA); the host
-    // cap below is the different, implicit heapless-overflow rule.
+    // reference's own explicit check (http_listener.rs:196).
     if (p.user_agent.empty() || p.user_agent.size() >= 256) {
       respond_close(c, k403);
       return;
     }
-    // Over-long host becomes EMPTY, not truncated (reference get_host,
+    // Over-long host becomes EMPTY, not truncated (get_host,
     // http_listener.rs:284-296).
-    if (p.host.size() > 256) p.host.clear();
+    if (c->req.host.size() > 256) c->req.host.clear();
+
+    // Captcha endpoints bypass rules and go to the control plane — and
+    // they come BEFORE the cookie gate, exactly like the reference
+    // (http_listener.rs:200-204 precede :222-236), or a client with a
+    // stale cookie could never reach the challenge to clear it.
+    if (c->req.path.compare(0, 17, "/__pingoo/captcha") == 0) {
+      if (has_captcha_upstream_) {
+        start_proxy(c, captcha_upstream_);
+      } else {
+        respond_close(c, k403);
+      }
+      return;
+    }
+
+    // Captcha-verified cookie (Ed25519 JWT against the shared JWKS).
+    // An INVALID present cookie serves the challenge immediately
+    // (reference http_listener.rs:222-236) — here: redirect.
+    std::string client_id = captcha_client_id(
+        c->peer_ip, c->req.user_agent, c->req.host);
+    if (!c->req.verified_cookie.empty() && gate_ != nullptr &&
+        gate_->available()) {
+      if (gate_->verify(c->req.verified_cookie, client_id, now_)) {
+        c->captcha_verified = true;
+      } else {
+        respond_close(c, kCaptcha);
+        return;
+      }
+    }
+
     uint8_t ip[16] = {0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0xff, 0xff, 0, 0, 0, 0};
     in_addr v4{};
     inet_pton(AF_INET, c->peer_ip, &v4);
     std::memcpy(ip + 12, &v4, 4);
     char country[2] = {'X', 'X'};
     uint64_t ticket = pingoo_ring_enqueue_request(
-        ring_, p.method.data(), p.method.size(), p.host.data(), p.host.size(),
-        p.path.data(), p.path.size(), p.target.data(), p.target.size(),
-        p.user_agent.data(), p.user_agent.size(), ip, c->peer_port, 0,
-        country);
+        ring_, c->req.method.data(), c->req.method.size(), c->req.host.data(),
+        c->req.host.size(), c->req.path.data(), c->req.path.size(),
+        c->req.target.data(), c->req.target.size(), c->req.user_agent.data(),
+        c->req.user_agent.size(), ip, c->peer_port, 0, country);
     if (ticket == UINT64_MAX) {
-      // Verdict ring full (sidecar stalled): FAIL OPEN — proxy without a
-      // verdict, like rule-execution errors in the reference
-      // (pingoo/rules.rs:41-44).
-      start_proxy(c);
+      // Verdict ring full (sidecar stalled): FAIL OPEN — proxy without
+      // a verdict (pingoo/rules.rs:41-44).
+      start_proxy(c, upstream_);
       return;
     }
     c->ticket = ticket;
     c->state = ConnState::kAwaitingVerdict;
     awaiting_[ticket] = c;
-    arm(c, c->fd, 0);  // quiesce until the verdict arrives
+    update_client_events(c);  // quiesce until the verdict arrives
   }
 
-  // Relay src -> pending-buffer/dst without ever dropping bytes.
-  // Returns false if the connection should close.
-  bool relay(int src, int dst, std::string* pending, bool* src_eof) {
-    // Flush pending first.
-    while (!pending->empty()) {
-      ssize_t w = send(dst, pending->data(), pending->size(), MSG_NOSIGNAL);
+  // -- proxy phase ----------------------------------------------------------
+
+  void on_proxy_client_event(Conn* c, uint32_t events) {
+    c->last_active = now_;
+    if (events & EPOLLIN) {
+      char buf[16384];
+      for (;;) {
+        ssize_t r = t_read(c, buf, sizeof(buf));
+        if (r > 0) {
+          c->inbuf.append(buf, static_cast<size_t>(r));
+          if (c->inbuf.size() > kMaxBuffered) break;  // backpressure
+        } else if (r == 0) {
+          // Half-close: remember it (update_client_events disarms the
+          // read side) — the response direction may continue.
+          c->client_eof = true;
+          if (!c->req_body.done && c->req_body.mode == BodyFramer::kUntilEof)
+            c->req_body.done = true;
+          break;
+        } else if (r == -1) {
+          break;
+        } else {
+          mark_close(c);
+          return;
+        }
+      }
+      pump_request_body(c);
+      flush_upstream(c);
+    }
+    if (events & EPOLLOUT) {
+      c->ssl_want_write = false;
+      if (!flush_out(c)) {
+        mark_close(c);
+        return;
+      }
+      maybe_finish_response(c);
+      if (c->dead || c->state != ConnState::kProxying) return;
+    }
+    update_client_events(c);
+    update_upstream_events(c);
+  }
+
+  void flush_upstream(Conn* c) {
+    while (!c->upbuf.empty() && c->upstream_fd >= 0 && c->upstream_connected) {
+      ssize_t w = send(c->upstream_fd, c->upbuf.data(), c->upbuf.size(),
+                       MSG_NOSIGNAL);
       if (w > 0) {
-        pending->erase(0, static_cast<size_t>(w));
+        c->upbuf.erase(0, static_cast<size_t>(w));
       } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
         break;
       } else {
-        return false;
+        // Upstream write failure mid-request: 502 if nothing sent yet,
+        // else close.
+        if (c->resp_head_done) mark_close(c);
+        else respond_close(c, k502);
+        return;
       }
     }
-    if (!*src_eof && pending->size() < kMaxBuffered) {
-      char buf[16384];
-      ssize_t r;
-      while ((r = read(src, buf, sizeof(buf))) > 0) {
-        size_t off = 0;
-        while (off < static_cast<size_t>(r)) {
-          ssize_t w = send(dst, buf + off, static_cast<size_t>(r) - off,
-                           MSG_NOSIGNAL);
-          if (w > 0) {
-            off += static_cast<size_t>(w);
-          } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
-            pending->append(buf + off, static_cast<size_t>(r) - off);
-            break;
-          } else {
-            return false;
-          }
-        }
-        if (!pending->empty()) break;  // backpressure: stop reading
-      }
-      if (r == 0) *src_eof = true;
-    }
-    if (*src_eof && pending->empty()) return false;  // finished this way
-    return true;
   }
 
-  void on_proxy_event(Conn* c, int fd, uint32_t events) {
+  void on_upstream_event(Conn* c, uint32_t events) {
     c->last_active = now_;
-    if (fd == c->upstream_fd && !c->upstream_connected &&
-        (events & (EPOLLOUT | EPOLLERR))) {
+    if (!c->upstream_connected && (events & (EPOLLOUT | EPOLLERR))) {
       int err = 0;
       socklen_t len = sizeof(err);
       getsockopt(c->upstream_fd, SOL_SOCKET, SO_ERROR, &err, &len);
-      if (err != 0) {  // async connect failed -> 502, not an empty reset
-        epoll_ctl(ep_, EPOLL_CTL_DEL, c->upstream_fd, nullptr);
-        close(c->upstream_fd);
-        upstream_conn_.erase(c->upstream_fd);
-        c->upstream_fd = -1;
+      if (err != 0) {
+        close_upstream(c);
         respond_close(c, k502);
         return;
       }
       c->upstream_connected = true;
     }
-    if (events & (EPOLLHUP | EPOLLERR)) { mark_close(c); return; }
-    // Request direction: client -> upstream (upbuf holds the head).
-    if (!relay(c->fd, c->upstream_fd, &c->upbuf, &c->client_eof)) {
-      if (!c->client_eof) { mark_close(c); return; }
-      // client done sending; keep response direction alive
+    if (events & EPOLLOUT) flush_upstream(c);
+    if (c->dead || c->state != ConnState::kProxying) return;
+    if (events & EPOLLIN) {
+      char buf[16384];
+      for (;;) {
+        if (c->outbuf.size() > kMaxBuffered) break;  // backpressure
+        ssize_t r = read(c->upstream_fd, buf, sizeof(buf));
+        if (r > 0) {
+          on_upstream_data(c, buf, static_cast<size_t>(r));
+          if (c->dead || c->state != ConnState::kProxying) return;
+        } else if (r == 0) {
+          c->upstream_eof = true;
+          break;
+        } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+          break;
+        } else {
+          c->upstream_eof = true;
+          break;
+        }
+      }
     }
-    // Response direction: upstream -> client.
-    if (!relay(c->upstream_fd, c->fd, &c->outbuf, &c->upstream_eof)) {
+    if (events & (EPOLLHUP | EPOLLERR)) c->upstream_eof = true;
+    if (!flush_out(c)) {
       mark_close(c);
       return;
     }
-    uint32_t cl_ev = EPOLLIN;
-    if (!c->outbuf.empty()) cl_ev |= EPOLLOUT;
-    arm(c, c->fd, cl_ev);
-    uint32_t up_ev = EPOLLIN;
-    if (!c->upbuf.empty()) up_ev |= EPOLLOUT;
-    arm(c, c->upstream_fd, up_ev);
+    maybe_finish_response(c);
+    if (c->dead || c->state != ConnState::kProxying) return;
+    update_client_events(c);
+    update_upstream_events(c);
   }
 
-  void on_closing_writable(Conn* c) {
-    while (!c->outbuf.empty()) {
-      ssize_t w = send(c->fd, c->outbuf.data(), c->outbuf.size(),
-                       MSG_NOSIGNAL);
-      if (w > 0) {
-        c->outbuf.erase(0, static_cast<size_t>(w));
-      } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+  void on_upstream_data(Conn* c, const char* data, size_t len) {
+    if (!c->resp_head_done) {
+      c->resp_head_buf.append(data, len);
+      // Parse heads in a loop: 1xx interim responses (e.g. 100
+      // Continue for Expect: 100-continue POSTs) are relayed verbatim
+      // and the FINAL response head follows on the same connection.
+      for (;;) {
+        size_t he = c->resp_head_buf.find("\r\n\r\n");
+        if (he == std::string::npos) {
+          if (c->resp_head_buf.size() > kMaxHead) mark_close(c);
+          return;
+        }
+        std::string head = c->resp_head_buf.substr(0, he + 4);
+        RespHead rh = rewrite_response_head(head, c->req.keep_alive);
+        if (!rh.ok) {
+          respond_close(c, k502);
+          return;
+        }
+        if (rh.status >= 100 && rh.status < 200) {
+          c->outbuf += head;  // interim: forward as-is, keep parsing
+          c->resp_head_buf.erase(0, he + 4);
+          continue;
+        }
+        bool head_only = c->req.method == "HEAD" || rh.status == 204 ||
+                         rh.status == 304;
+        if (head_only) {
+          c->resp_body.reset_none();
+        } else if (rh.chunked) {
+          c->resp_body.reset_chunked();
+        } else if (rh.content_length >= 0) {
+          c->resp_body.reset_cl(rh.content_length);
+        } else {
+          c->resp_body.reset_eof();
+          c->close_after_response = true;  // EOF-delimited: client closes too
+        }
+        if (!c->req.keep_alive) c->close_after_response = true;
+        c->outbuf += rh.rewritten;
+        // Remaining bytes after the head are body bytes.
+        std::string rest = c->resp_head_buf.substr(he + 4);
+        c->resp_head_buf.clear();
+        c->resp_head_done = true;
+        if (!rest.empty()) {
+          size_t take = c->resp_body.consume(rest.data(), rest.size());
+          c->outbuf.append(rest, 0, take);
+          // bytes past the response end are junk; drop them
+        }
         return;
-      } else {
-        break;
       }
+    }
+    if (!c->resp_body.done) {
+      size_t take = c->resp_body.consume(data, len);
+      c->outbuf.append(data, take);
+    }
+  }
+
+  void maybe_finish_response(Conn* c) {
+    if (c->state != ConnState::kProxying || !c->resp_head_done) {
+      // EOF from upstream before any response head -> 502
+      if (c->state == ConnState::kProxying && c->upstream_eof &&
+          !c->resp_head_done)
+        respond_close(c, k502);
+      return;
+    }
+    bool body_done = c->resp_body.done ||
+                     (c->resp_body.mode == BodyFramer::kUntilEof &&
+                      c->upstream_eof);
+    if (!body_done) {
+      if (c->upstream_eof && !c->resp_body.done &&
+          c->resp_body.mode != BodyFramer::kUntilEof) {
+        // Truncated upstream response: relay what we have, then close.
+        c->close_after_response = true;
+        body_done = true;
+      } else {
+        return;
+      }
+    }
+    if (!c->outbuf.empty()) return;  // keep draining first
+    close_upstream(c);
+    if (c->close_after_response) {
+      mark_close(c);
+      return;
+    }
+    begin_request_cycle(c);
+  }
+
+  // -- TLS handshake --------------------------------------------------------
+
+  void on_handshake(Conn* c) {
+    c->last_active = now_;
+    c->ssl_want_write = false;
+    int r = SSL_do_handshake(c->ssl);
+    if (r == 1) {
+      if (c->acme_challenge) {
+        // tls-alpn-01: the validation server only needs the handshake
+        // (RFC 8737 §3); close once it completes.
+        mark_close(c);
+        return;
+      }
+      c->state = ConnState::kReadingHead;
+      update_client_events(c);
+      return;
+    }
+    int err = SSL_get_error(c->ssl, r);
+    ERR_clear_error();
+    if (err == SSL_ERROR_WANT_READ) {
+      update_client_events(c);
+      return;
+    }
+    if (err == SSL_ERROR_WANT_WRITE) {
+      c->ssl_want_write = true;
+      update_client_events(c);
+      return;
     }
     mark_close(c);
   }
 
-  void handle(Conn* c, int fd, uint32_t events) {
+  void handle(Conn* c, bool is_upstream, uint32_t events) {
     if (c->dead) return;  // stale event within this batch
+    if (is_upstream) {
+      if (c->state == ConnState::kProxying) on_upstream_event(c, events);
+      return;
+    }
     switch (c->state) {
+      case ConnState::kHandshake:
+        if (events & (EPOLLHUP | EPOLLERR)) mark_close(c);
+        else on_handshake(c);
+        break;
       case ConnState::kReadingHead:
-        if (fd == c->fd && (events & (EPOLLIN | EPOLLHUP)))
-          on_client_readable(c);
+        if (events & (EPOLLIN | EPOLLHUP)) on_client_readable(c);
+        else if (events & EPOLLOUT) {
+          c->ssl_want_write = false;
+          if (!flush_out(c)) mark_close(c);
+          else update_client_events(c);
+        }
         break;
       case ConnState::kAwaitingVerdict:
         if (events & (EPOLLHUP | EPOLLERR)) mark_close(c);
         break;
       case ConnState::kProxying:
-        on_proxy_event(c, fd, events);
+        if (events & (EPOLLHUP | EPOLLERR)) {
+          // client side error/hangup
+          mark_close(c);
+          return;
+        }
+        on_proxy_client_event(c, events);
         break;
       case ConnState::kClosing:
         if (events & (EPOLLHUP | EPOLLERR)) mark_close(c);
-        else if (fd == c->fd && (events & EPOLLOUT)) on_closing_writable(c);
+        else if (events & EPOLLOUT) {
+          c->ssl_want_write = false;
+          if (!flush_out(c) || c->outbuf.empty()) mark_close(c);
+        }
         break;
     }
   }
@@ -422,12 +1490,103 @@ class Server {
   int ep_;
   void* ring_;
   sockaddr_in upstream_;
+  sockaddr_in captcha_upstream_{};
+  bool has_captcha_upstream_ = false;
+  CaptchaGate* gate_;
+  TlsStore* tls_;
   std::unordered_set<Conn*> conns_;
   std::unordered_map<uint64_t, Conn*> awaiting_;
-  std::unordered_map<int, Conn*> upstream_conn_;
+  std::unordered_map<SSL*, Conn*> ssl_conn_;
   std::vector<Conn*> doomed_;
   time_t now_ = 0;
 };
+
+Server* g_server = nullptr;
+
+int alpn_select_cb(SSL* ssl, const unsigned char** out, unsigned char* outlen,
+                   const unsigned char* in, unsigned int inlen, void* arg);
+
+// ClientHello callback: inspect SNI + ALPN BEFORE any config decision
+// (the reference's LazyConfigAcceptor, listeners/mod.rs:112-154).
+// acme-tls/1 -> swap in the ephemeral challenge cert for the domain.
+int client_hello_cb(SSL* ssl, int* al, void* arg) {
+  (void)al;
+  TlsStore* store = static_cast<TlsStore*>(arg);
+  const unsigned char* ext = nullptr;
+  size_t ext_len = 0;
+  std::string sni;
+  if (SSL_client_hello_get0_ext(ssl, TLSEXT_TYPE_server_name, &ext,
+                                &ext_len) == 1)
+    sni = parse_sni_ext(ext, ext_len);
+  bool acme = false;
+  if (SSL_client_hello_get0_ext(ssl, TLSEXT_TYPE_alpn, &ext, &ext_len) == 1)
+    acme = alpn_ext_offers(ext, ext_len, "acme-tls/1");
+
+  Conn* c = g_server ? g_server->conn_for_ssl(ssl) : nullptr;
+  if (acme && !sni.empty() && !store->alpn_dir.empty()) {
+    // Challenge certs are ephemeral files written by the ACME client
+    // (host/acme.py); load fresh per handshake.
+    std::string cert = store->alpn_dir + "/" + sni + ".pem";
+    std::string key = store->alpn_dir + "/" + sni + ".key";
+    SSL_CTX* ch = make_server_ctx(cert, key);
+    if (ch != nullptr && c != nullptr) {
+      c->acme_challenge = true;
+      c->owned_ctx = ch;
+      // ALPN selection runs against the swapped-in context, which must
+      // therefore carry the callback too — RFC 8737 requires acme-tls/1
+      // to actually be negotiated, not just tolerated.
+      SSL_CTX_set_alpn_select_cb(ch, alpn_select_cb, nullptr);
+      SSL_set_SSL_CTX(ssl, ch);
+      return SSL_CLIENT_HELLO_SUCCESS;
+    }
+    if (ch) SSL_CTX_free(ch);
+    return SSL_CLIENT_HELLO_ERROR;  // no challenge staged for this name
+  }
+  SSL_CTX* chosen = store->match(sni);
+  if (chosen != nullptr) SSL_set_SSL_CTX(ssl, chosen);
+  return SSL_CLIENT_HELLO_SUCCESS;
+}
+
+// ALPN negotiation: acme-tls/1 for challenge handshakes (RFC 8737
+// REQUIRES the protocol be negotiated), http/1.1 otherwise.
+int alpn_select_cb(SSL* ssl, const unsigned char** out, unsigned char* outlen,
+                   const unsigned char* in, unsigned int inlen, void* arg) {
+  (void)arg;
+  Conn* c = g_server ? g_server->conn_for_ssl(ssl) : nullptr;
+  const char* want = (c != nullptr && c->acme_challenge) ? "acme-tls/1"
+                                                         : "http/1.1";
+  size_t wlen = strlen(want);
+  unsigned int i = 0;
+  while (i < inlen) {
+    unsigned int n = in[i];
+    if (i + 1 + n > inlen) break;
+    if (n == wlen && memcmp(in + i + 1, want, n) == 0) {
+      *out = in + i + 1;
+      *outlen = static_cast<unsigned char>(n);
+      return SSL_TLSEXT_ERR_OK;
+    }
+    i += 1 + n;
+  }
+  return SSL_TLSEXT_ERR_NOACK;  // no overlap: proceed without ALPN
+}
+
+bool parse_hostport(const char* s, sockaddr_in* out) {
+  std::string hp = s;
+  size_t colon = hp.rfind(':');
+  if (colon == std::string::npos) return false;
+  std::string host = hp.substr(0, colon);
+  std::string port = hp.substr(colon + 1);
+  addrinfo hints{};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 ||
+      res == nullptr)
+    return false;
+  std::memcpy(out, res->ai_addr, sizeof(*out));
+  freeaddrinfo(res);
+  return true;
+}
 
 }  // namespace
 
@@ -435,7 +1594,8 @@ int main(int argc, char** argv) {
   if (argc < 5) {
     std::fprintf(stderr,
                  "usage: %s <listen-port> <ring-file> <upstream-host> "
-                 "<upstream-port>\n",
+                 "<upstream-port> [--captcha-upstream host:port] "
+                 "[--jwks path] [--tls-dir dir] [--alpn-dir dir]\n",
                  argv[0]);
     return 2;
   }
@@ -445,7 +1605,27 @@ int main(int argc, char** argv) {
   const char* up_host = argv[3];
   const char* up_port = argv[4];
 
-  // Resolve the upstream (numeric or hostname) up front; fail fast.
+  const char* jwks_path = nullptr;
+  const char* tls_dir = nullptr;
+  const char* alpn_dir = nullptr;
+  sockaddr_in captcha_upstream{};
+  bool has_captcha = false;
+  for (int i = 5; i + 1 < argc; i += 2) {
+    if (strcmp(argv[i], "--captcha-upstream") == 0) {
+      if (!parse_hostport(argv[i + 1], &captcha_upstream)) {
+        std::fprintf(stderr, "bad --captcha-upstream\n");
+        return 2;
+      }
+      has_captcha = true;
+    } else if (strcmp(argv[i], "--jwks") == 0) {
+      jwks_path = argv[i + 1];
+    } else if (strcmp(argv[i], "--tls-dir") == 0) {
+      tls_dir = argv[i + 1];
+    } else if (strcmp(argv[i], "--alpn-dir") == 0) {
+      alpn_dir = argv[i + 1];
+    }
+  }
+
   addrinfo hints{};
   hints.ai_family = AF_INET;
   hints.ai_socktype = SOCK_STREAM;
@@ -459,14 +1639,49 @@ int main(int argc, char** argv) {
   freeaddrinfo(res);
 
   int rfd = open(ring_path, O_RDWR);
-  if (rfd < 0) { std::perror("open ring"); return 1; }
+  if (rfd < 0) {
+    std::perror("open ring");
+    return 1;
+  }
   struct stat st;
   fstat(rfd, &st);
-  void* ring = mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED,
-                    rfd, 0);
+  void* ring =
+      mmap(nullptr, st.st_size, PROT_READ | PROT_WRITE, MAP_SHARED, rfd, 0);
   if (ring == MAP_FAILED || pingoo_ring_attach(ring, nullptr) != 0) {
     std::fprintf(stderr, "ring attach failed\n");
     return 1;
+  }
+
+  CaptchaGate gate;
+  if (jwks_path != nullptr && !gate.load(jwks_path)) {
+    std::fprintf(stderr,
+                 "warning: JWKS unavailable at %s; all clients treated as "
+                 "unverified\n",
+                 jwks_path);
+  }
+
+  TlsStore tls_store;
+  SSL_CTX* base_ctx = nullptr;
+  if (tls_dir != nullptr) {
+    if (alpn_dir != nullptr) tls_store.alpn_dir = alpn_dir;
+    if (!load_tls_store(tls_dir, &tls_store)) {
+      std::fprintf(stderr, "no usable certificates in %s\n", tls_dir);
+      return 1;
+    }
+    base_ctx = tls_store.fallback != nullptr
+                   ? tls_store.fallback
+                   : (!tls_store.exact.empty()
+                          ? tls_store.exact.begin()->second
+                          : tls_store.wildcard.begin()->second);
+    // Install inspection callbacks on every loaded context (the
+    // connection's context can be swapped by the client-hello cb).
+    auto install = [&](SSL_CTX* ctx) {
+      SSL_CTX_set_client_hello_cb(ctx, client_hello_cb, &tls_store);
+      SSL_CTX_set_alpn_select_cb(ctx, alpn_select_cb, nullptr);
+    };
+    if (tls_store.fallback) install(tls_store.fallback);
+    for (auto& kv : tls_store.exact) install(kv.second);
+    for (auto& kv : tls_store.wildcard) install(kv.second);
   }
 
   int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
@@ -489,8 +1704,11 @@ int main(int argc, char** argv) {
   ev.data.ptr = nullptr;  // nullptr marks the listening socket
   epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev);
 
-  Server server(ep, ring, upstream);
-  std::printf("{\"listening\": %d}\n", listen_port);
+  Server server(ep, ring, upstream, has_captcha ? &captcha_upstream : nullptr,
+                &gate, tls_dir ? &tls_store : nullptr);
+  g_server = &server;
+  std::printf("{\"listening\": %d, \"tls\": %s}\n", listen_port,
+              tls_dir ? "true" : "false");
   std::fflush(stdout);
 
   time_t last_sweep = time(nullptr);
@@ -512,14 +1730,12 @@ int main(int argc, char** argv) {
           if (cfd < 0) break;
           int nd = 1;
           setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
-          server.add_client(cfd, peer);
+          server.add_client(cfd, peer, base_ctx);
         }
         continue;
       }
       SockRef* ref = static_cast<SockRef*>(events[i].data.ptr);
-      Conn* c = ref->conn;
-      int fd = ref->is_upstream ? c->upstream_fd : c->fd;
-      server.handle(c, fd, events[i].events);
+      server.handle(ref->conn, ref->is_upstream, events[i].events);
     }
     server.flush_doomed();
     if (now != last_sweep) {

@@ -25,14 +25,19 @@ from pingoo_tpu.host.acme import AcmeClient, AcmeManager
 class MockCa:
     """Tiny in-process ACME directory."""
 
-    def __init__(self, host="127.0.0.1"):
+    def __init__(self, host="127.0.0.1", challenge_type="http-01"):
         self.host = host
         self.port = None
         self.server = None
+        self.challenge_type = challenge_type
         self.orders: dict[str, dict] = {}
         self.authzs: dict[str, dict] = {}
         self.validated_keyauths: list[str] = []
         self.challenge_fetcher = None  # async (token) -> keyauth or None
+        # tls-alpn-01: async (domain) -> challenge cert DER or None,
+        # i.e. "connect with ALPN acme-tls/1 like a real CA would"
+        self.alpn_probe = None
+        self.account_thumbprint = None  # RFC 7638, captured at newAccount
         self.ca_key = ec.generate_private_key(ec.SECP256R1())
 
     def url(self, path):
@@ -88,9 +93,20 @@ class MockCa:
         return json.loads(base64.urlsafe_b64decode(payload + pad))
 
     async def handle_new_account(self, request):
+        import hashlib
+
         from aiohttp import web
 
-        await self._jws_payload(request)
+        doc = await request.json()
+        protected = json.loads(base64.urlsafe_b64decode(
+            doc["protected"] + "=" * (-len(doc["protected"]) % 4)))
+        jwk = protected.get("jwk", {})
+        # RFC 7638 thumbprint over the canonical required members.
+        canonical = json.dumps(
+            {k: jwk[k] for k in sorted(("crv", "kty", "x", "y")) if k in jwk},
+            separators=(",", ":"))
+        self.account_thumbprint = base64.urlsafe_b64encode(
+            hashlib.sha256(canonical.encode()).digest()).rstrip(b"=").decode()
         headers = self._nonce_headers()
         headers["Location"] = self.url("/account/1")
         return web.json_response({"status": "valid"}, status=201,
@@ -129,7 +145,7 @@ class MockCa:
             "status": authz["status"],
             "identifier": {"type": "dns", "value": authz["domain"]},
             "challenges": [{
-                "type": "http-01",
+                "type": self.challenge_type,
                 "url": self.url(f"/chal/{aid}"),
                 "token": authz["token"],
             }],
@@ -140,17 +156,52 @@ class MockCa:
 
         aid = request.match_info["aid"]
         authz = self.authzs[aid]
+        if self.challenge_type == "tls-alpn-01":
+            ok = await self._validate_tls_alpn(authz)
+        else:
+            ok = await self._validate_http01(authz)
+        authz["status"] = "valid" if ok else "invalid"
+        return web.json_response({"status": authz["status"]},
+                                 headers=self._nonce_headers())
+
+    async def _validate_http01(self, authz):
         # "Validate" by fetching the key authorization like a real CA.
         keyauth = None
         if self.challenge_fetcher is not None:
             keyauth = await self.challenge_fetcher(authz["token"])
         if keyauth and keyauth.startswith(authz["token"] + "."):
-            authz["status"] = "valid"
             self.validated_keyauths.append(keyauth)
-        else:
-            authz["status"] = "invalid"
-        return web.json_response({"status": authz["status"]},
-                                 headers=self._nonce_headers())
+            return True
+        return False
+
+    async def _validate_tls_alpn(self, authz):
+        """RFC 8737 §3 validation: fetch the challenge certificate over
+        an acme-tls/1 handshake and require a critical acmeIdentifier
+        extension carrying SHA256(key authorization)."""
+        import hashlib
+
+        from pingoo_tpu.host.acme import ACME_IDENTIFIER_OID
+
+        if self.alpn_probe is None or self.account_thumbprint is None:
+            return False
+        der = await self.alpn_probe(authz["domain"])
+        if der is None:
+            return False
+        cert = x509.load_der_x509_certificate(der)
+        sans = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value.get_values_for_type(x509.DNSName)
+        if sans != [authz["domain"]]:
+            return False
+        ext = next((e for e in cert.extensions
+                    if e.oid == ACME_IDENTIFIER_OID), None)
+        if ext is None or not ext.critical:
+            return False
+        keyauth = f"{authz['token']}.{self.account_thumbprint}"
+        expected = b"\x04\x20" + hashlib.sha256(keyauth.encode()).digest()
+        if ext.value.public_bytes() != expected:
+            return False
+        self.validated_keyauths.append(keyauth)
+        return True
 
     async def handle_finalize(self, request):
         from aiohttp import web

@@ -1,0 +1,646 @@
+"""Native data plane v2: keep-alive per-request verdicts, body framing,
+cookie gate (Ed25519 JWT), TLS termination with SNI, and tls-alpn-01 —
+all driven over real sockets against the C++ binary.
+
+Reference semantics under test: per-request rules evaluation
+(http_listener.rs:133-274), the captcha gate ordering (:200-236), the
+verified-client action loop (:251-264), and ClientHello-time challenge
+interception (listeners/mod.rs:112-154, acme.rs:180-242).
+"""
+
+import asyncio
+import hashlib
+import http.server
+import json
+import os
+import socket
+import ssl
+import subprocess
+import threading
+import time
+
+import pytest
+
+from pingoo_tpu import native_ring
+from pingoo_tpu.native_ring import Ring, RingSidecar
+
+pytestmark = pytest.mark.skipif(
+    not native_ring.ensure_built(), reason="native toolchain unavailable")
+
+HTTPD = os.path.join(native_ring.NATIVE_DIR, "httpd")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Upstream(http.server.BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):
+        body = f"up:{self.path}".encode()
+        self.send_response(200)
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self):
+        n = int(self.headers.get("content-length", 0))
+        body = b"post:" + self.rfile.read(n)
+        self.send_response(200)
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+class NativeStack:
+    """native httpd + ring sidecar + plain upstream (+ optional extras)."""
+
+    def __init__(self, tmp, rules, lists=None, jwks=None, captcha_port=None,
+                 tls_dir=None, alpn_dir=None):
+        from pingoo_tpu.compiler import compile_ruleset
+
+        self.upstream = http.server.HTTPServer(("127.0.0.1", 0), _Upstream)
+        threading.Thread(target=self.upstream.serve_forever,
+                         daemon=True).start()
+        plan = compile_ruleset(rules, lists or {})
+        self.ring_path = str(tmp / "ring")
+        self.ring = Ring(self.ring_path, capacity=1024, create=True)
+        self.sidecar = RingSidecar(self.ring, plan, lists or {}, max_batch=64)
+        threading.Thread(target=self.sidecar.run, daemon=True).start()
+        self.port = _free_port()
+        argv = [HTTPD, str(self.port), self.ring_path, "127.0.0.1",
+                str(self.upstream.server_address[1])]
+        if jwks:
+            argv += ["--jwks", jwks]
+        if captcha_port:
+            argv += ["--captcha-upstream", f"127.0.0.1:{captcha_port}"]
+        if tls_dir:
+            argv += ["--tls-dir", tls_dir]
+        if alpn_dir:
+            argv += ["--alpn-dir", alpn_dir]
+        self.proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                     stderr=subprocess.PIPE)
+        line = self.proc.stdout.readline()
+        assert b"listening" in line, line
+
+    def stop(self):
+        self.proc.kill()
+        self.proc.wait()
+        self.upstream.shutdown()
+        self.sidecar.stop()
+        self.ring.close()
+
+
+def recv_one_response(c):
+    """Read one content-length-framed HTTP response from the socket."""
+    data = b""
+    while b"\r\n\r\n" not in data:
+        ch = c.recv(65536)
+        if not ch:
+            return data
+        data += ch
+    head, rest = data.split(b"\r\n\r\n", 1)
+    cl = 0
+    for ln in head.split(b"\r\n"):
+        if ln.lower().startswith(b"content-length:"):
+            cl = int(ln.split(b":")[1])
+    while len(rest) < cl:
+        ch = c.recv(65536)
+        if not ch:
+            break
+        rest += ch
+    return head + b"\r\n\r\n" + rest[:cl]
+
+
+def raw_request(port, payload):
+    c = socket.create_connection(("127.0.0.1", port), timeout=10)
+    c.sendall(payload)
+    data = b""
+    c.settimeout(10)
+    try:
+        while True:
+            ch = c.recv(65536)
+            if not ch:
+                break
+            data += ch
+    except socket.timeout:
+        pass
+    c.close()
+    return data
+
+
+def _block_rules(marker="evil"):
+    from pingoo_tpu.config.schema import Action, RuleConfig
+    from pingoo_tpu.expr import compile_expression
+
+    return [RuleConfig(
+        name="r", actions=(Action.BLOCK,),
+        expression=compile_expression(
+            f'http_request.url.contains("{marker}")'))]
+
+
+class TestKeepAlive:
+    @pytest.fixture(scope="class")
+    def stack(self, tmp_path_factory):
+        st = NativeStack(tmp_path_factory.mktemp("ka"), _block_rules())
+        yield st
+        st.stop()
+
+    def test_every_request_on_a_connection_is_verdicted(self, stack):
+        """The WAF-bypass regression: request #2 on a kept-alive
+        connection must be evaluated, not blindly relayed."""
+        c = socket.create_connection(("127.0.0.1", stack.port), timeout=10)
+        c.sendall(b"GET /one HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n\r\n")
+        r1 = recv_one_response(c)
+        assert r1.startswith(b"HTTP/1.1 200") and b"up:/one" in r1
+        c.sendall(b"GET /evil HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n\r\n")
+        r2 = recv_one_response(c)
+        assert r2.startswith(b"HTTP/1.1 403")
+        c.close()
+
+    def test_pipelined_attack_blocked(self, stack):
+        c = socket.create_connection(("127.0.0.1", stack.port), timeout=10)
+        c.sendall(b"GET /a HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n\r\n"
+                  b"GET /b-evil HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n\r\n")
+        r1 = recv_one_response(c)
+        r2 = recv_one_response(c)
+        c.close()
+        assert r1.startswith(b"HTTP/1.1 200") and b"up:/a" in r1
+        assert r2.startswith(b"HTTP/1.1 403")
+
+    def test_post_body_then_reuse(self, stack):
+        c = socket.create_connection(("127.0.0.1", stack.port), timeout=10)
+        c.sendall(b"POST /p HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n"
+                  b"content-length: 10\r\n\r\nhello-body")
+        r1 = recv_one_response(c)
+        assert b"post:hello-body" in r1
+        c.sendall(b"GET /next HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n\r\n")
+        r2 = recv_one_response(c)
+        assert b"up:/next" in r2
+        c.close()
+
+    def test_oversized_ua_403(self, stack):
+        data = raw_request(
+            stack.port,
+            ("GET / HTTP/1.1\r\nhost: t\r\nuser-agent: " + "U" * 300 +
+             "\r\nconnection: close\r\n\r\n").encode())
+        assert data.startswith(b"HTTP/1.1 403")
+
+
+class TestCookieGateAndCaptchaFlow:
+    @pytest.fixture(scope="class")
+    def stack(self, tmp_path_factory):
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.engine.service import VerdictService
+        from pingoo_tpu.expr import compile_expression
+        from pingoo_tpu.host.captcha import CaptchaManager
+        from pingoo_tpu.host.httpd import HttpListener
+
+        tmp = tmp_path_factory.mktemp("captcha")
+        jwks = str(tmp / "jwks.json")
+        cap = CaptchaManager(jwks_path=jwks)
+        rules = [
+            RuleConfig(name="bot", actions=(Action.CAPTCHA,),
+                       expression=compile_expression(
+                           'http_request.user_agent.contains("sqlmap")')),
+            RuleConfig(name="cb", actions=(Action.CAPTCHA, Action.BLOCK),
+                       expression=compile_expression(
+                           'http_request.path == "/always-block"')),
+        ]
+        plan = compile_ruleset(rules, {})
+
+        # Python control plane serving the captcha API behind the native
+        # front (trust_xff so the client id binds the real client ip).
+        loop = asyncio.new_event_loop()
+
+        async def boot():
+            svc = VerdictService(plan, {}, use_device=False, max_wait_us=100)
+            lst = HttpListener("ctl", "127.0.0.1", 0, [], svc, {}, plan.rules,
+                               cap, trust_xff=True)
+            await svc.start()
+            await lst.bind()
+            asyncio.ensure_future(lst.serve_forever())
+            return lst
+
+        ctl = loop.run_until_complete(boot())
+        threading.Thread(target=loop.run_forever, daemon=True).start()
+
+        st = NativeStack(tmp, rules, jwks=jwks, captcha_port=ctl.bound_port)
+        yield st
+        st.stop()
+
+    def _req(self, stack, method, path, headers=None, body=b"",
+             ua="sqlmap/1.8"):
+        h = f"{method} {path} HTTP/1.1\r\nhost: t.test\r\nuser-agent: {ua}\r\n"
+        for k, v in (headers or {}).items():
+            h += f"{k}: {v}\r\n"
+        if body:
+            h += f"content-length: {len(body)}\r\n"
+        h += "connection: close\r\n\r\n"
+        data = raw_request(stack.port, h.encode() + body)
+        head, _, rest = data.partition(b"\r\n\r\n")
+        status = int(head.split(b" ")[1])
+        hdrs = {}
+        for ln in head.split(b"\r\n")[1:]:
+            k, _, v = ln.partition(b":")
+            hdrs[k.decode().lower()] = v.strip().decode()
+        return status, hdrs, rest
+
+    def test_full_flow_solve_then_verified_proxy(self, stack):
+        # 1) bot is redirected to the challenge
+        st, h, _ = self._req(stack, "GET", "/")
+        assert st == 302 and h.get("location") == "/__pingoo/captcha"
+        # 2) init + PoW via the proxied control plane
+        st, h, body = self._req(stack, "POST", "/__pingoo/captcha/api/init")
+        assert st == 200
+        payload = json.loads(body)
+        cookie = h["set-cookie"].split(";")[0]
+        nonce = 0
+        while True:
+            digest = hashlib.sha256(
+                (payload["challenge"] + str(nonce)).encode()).hexdigest()
+            if digest.startswith("0" * payload["difficulty"]):
+                break
+            nonce += 1
+        st, h, body = self._req(
+            stack, "POST", "/__pingoo/captcha/api/verify",
+            headers={"cookie": cookie, "content-type": "application/json"},
+            body=json.dumps({"nonce": str(nonce), "hash": digest}).encode())
+        assert st == 200 and json.loads(body)["ok"] is True
+        verified = h["set-cookie"].split(";")[0]
+        # 3) the verified client is PROXIED, not redirected — the C++
+        # plane verified the Ed25519 cookie itself.
+        st, h, body = self._req(stack, "GET", "/",
+                                headers={"cookie": verified})
+        assert st == 200 and b"up:/" in body
+        # 4) [Captcha, Block] still blocks a VERIFIED client (the
+        # verdict byte's bit-2 lane).
+        st, h, _ = self._req(stack, "GET", "/always-block",
+                             headers={"cookie": verified})
+        assert st == 403
+
+    def test_tampered_cookie_redirected(self, stack):
+        st, h, _ = self._req(
+            stack, "GET", "/",
+            headers={"cookie": "__pingoo_captcha_verified=ey.bad.sig"})
+        assert st == 302 and h.get("location") == "/__pingoo/captcha"
+
+    def test_captcha_path_reachable_with_bad_cookie(self, stack):
+        """Reference ordering: /__pingoo/captcha is served BEFORE the
+        cookie gate, so a stale cookie can always be cleared."""
+        st, _, _ = self._req(
+            stack, "POST", "/__pingoo/captcha/api/init",
+            headers={"cookie": "__pingoo_captcha_verified=ey.bad.sig"})
+        assert st == 200
+
+
+class TestTlsPlane:
+    @pytest.fixture(scope="class")
+    def stack(self, tmp_path_factory):
+        from pingoo_tpu.host.tlsmgr import generate_self_signed
+
+        tmp = tmp_path_factory.mktemp("tls")
+        tls_dir = tmp / "tls"
+        alpn_dir = tmp / "alpn"
+        tls_dir.mkdir()
+        alpn_dir.mkdir()
+        for name, domains in [("default", ["localhost"]),
+                              ("site.test", ["site.test"]),
+                              ("_.wild.test", ["*.wild.test"])]:
+            cert, key = generate_self_signed(domains)
+            (tls_dir / f"{name}.pem").write_bytes(cert)
+            (tls_dir / f"{name}.key").write_bytes(key)
+        cert, key = generate_self_signed(["chal.test"])
+        (alpn_dir / "chal.test.pem").write_bytes(cert)
+        (alpn_dir / "chal.test.key").write_bytes(key)
+        st = NativeStack(tmp, _block_rules(), tls_dir=str(tls_dir),
+                         alpn_dir=str(alpn_dir))
+        yield st
+        st.stop()
+
+    def _tls_conn(self, stack, server_name, alpn):
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        ctx.set_alpn_protocols(alpn)
+        raw = socket.create_connection(("127.0.0.1", stack.port), timeout=10)
+        return ctx.wrap_socket(raw, server_hostname=server_name)
+
+    def _cert_sans(self, sock):
+        from cryptography import x509
+
+        pem = ssl.DER_cert_to_PEM_cert(sock.getpeercert(True))
+        cert = x509.load_pem_x509_certificate(pem.encode())
+        san = cert.extensions.get_extension_for_class(
+            x509.SubjectAlternativeName).value
+        return san.get_values_for_type(x509.DNSName)
+
+    def test_https_request_verdicted_and_proxied(self, stack):
+        c = self._tls_conn(stack, "localhost", ["http/1.1"])
+        assert c.selected_alpn_protocol() == "http/1.1"
+        c.sendall(b"GET /hello HTTP/1.1\r\nhost: localhost\r\n"
+                  b"user-agent: ua\r\nconnection: close\r\n\r\n")
+        data = b""
+        try:
+            while True:
+                ch = c.recv(65536)
+                if not ch:
+                    break
+                data += ch
+        except ssl.SSLError:
+            pass
+        c.close()
+        assert data.startswith(b"HTTP/1.1 200") and b"up:/hello" in data
+
+    def test_https_attack_blocked(self, stack):
+        c = self._tls_conn(stack, "localhost", ["http/1.1"])
+        c.sendall(b"GET /x?evil HTTP/1.1\r\nhost: localhost\r\n"
+                  b"user-agent: ua\r\nconnection: close\r\n\r\n")
+        data = b""
+        try:
+            while True:
+                ch = c.recv(65536)
+                if not ch:
+                    break
+                data += ch
+        except ssl.SSLError:
+            pass
+        c.close()
+        assert data.startswith(b"HTTP/1.1 403")
+
+    def test_sni_selects_exact_and_wildcard_cert(self, stack):
+        c = self._tls_conn(stack, "site.test", ["http/1.1"])
+        assert self._cert_sans(c) == ["site.test"]
+        c.close()
+        c = self._tls_conn(stack, "a.wild.test", ["http/1.1"])
+        assert self._cert_sans(c) == ["*.wild.test"]
+        c.close()
+
+    def test_acme_tls_alpn_challenge(self, stack):
+        """RFC 8737: acme-tls/1 must be NEGOTIATED and the ephemeral
+        challenge certificate presented for the SNI name."""
+        c = self._tls_conn(stack, "chal.test", ["acme-tls/1"])
+        assert c.selected_alpn_protocol() == "acme-tls/1"
+        assert self._cert_sans(c) == ["chal.test"]
+        c.close()
+
+    def test_acme_tls_alpn_unknown_domain_refused(self, stack):
+        with pytest.raises((ssl.SSLError, ConnectionError, OSError)):
+            self._tls_conn(stack, "unknown.test", ["acme-tls/1"])
+
+
+class TestVerdictTimeoutFailsOpen:
+    def test_awaiting_verdict_connection_fails_open(self, tmp_path):
+        """A dead sidecar must not leak connections: after the verdict
+        timeout the request is proxied without a verdict (fail-open,
+        like the ring-full path)."""
+        st = NativeStack(tmp_path, _block_rules())
+        st.sidecar.stop()
+        time.sleep(0.3)  # let the drain loop exit
+        t0 = time.time()
+        data = raw_request(
+            st.port,
+            b"GET /no-verdict HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n"
+            b"connection: close\r\n\r\n")
+        took = time.time() - t0
+        st.stop()
+        assert data.startswith(b"HTTP/1.1 200") and b"up:/no-verdict" in data
+        assert took < 10, f"fail-open took {took:.1f}s"
+
+
+class TestTlsAlpn01EndToEnd:
+    def test_issuance_via_native_listener(self, tmp_path, loop_runner):
+        """Full tls-alpn-01 issuance: the ACME client stages the RFC
+        8737 challenge cert into --alpn-dir, the mock CA validates by a
+        REAL acme-tls/1 handshake against the native listener, and the
+        certificate is issued and installed."""
+        import sys
+
+        sys.path.insert(0, os.path.dirname(__file__))
+        from test_acme import MockCa
+
+        from pingoo_tpu.host.acme import AcmeManager
+        from pingoo_tpu.host.tlsmgr import generate_self_signed
+
+        tls_dir = tmp_path / "tls"
+        alpn_dir = tmp_path / "alpn"
+        tls_dir.mkdir()
+        alpn_dir.mkdir()
+        cert, key = generate_self_signed(["localhost"])
+        (tls_dir / "default.pem").write_bytes(cert)
+        (tls_dir / "default.key").write_bytes(key)
+
+        stack = NativeStack(tmp_path, _block_rules(), tls_dir=str(tls_dir),
+                            alpn_dir=str(alpn_dir))
+        try:
+            async def flow():
+                ca = MockCa(challenge_type="tls-alpn-01")
+                await ca.start()
+
+                async def probe(domain):
+                    def handshake():
+                        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+                        ctx.check_hostname = False
+                        ctx.verify_mode = ssl.CERT_NONE
+                        ctx.set_alpn_protocols(["acme-tls/1"])
+                        raw = socket.create_connection(
+                            ("127.0.0.1", stack.port), timeout=10)
+                        c = ctx.wrap_socket(raw, server_hostname=domain)
+                        if c.selected_alpn_protocol() != "acme-tls/1":
+                            c.close()
+                            return None
+                        der = c.getpeercert(True)
+                        c.close()
+                        return der
+
+                    return await asyncio.get_running_loop().run_in_executor(
+                        None, handshake)
+
+                ca.alpn_probe = probe
+                manager = AcmeManager(str(tls_dir), ["issued.test"],
+                                      directory_url=ca.url("/dir"),
+                                      alpn_dir=str(alpn_dir))
+                try:
+                    await manager.renew_all()
+                finally:
+                    await ca.stop()
+                    await manager.client.close()
+                return ca
+
+            ca = loop_runner.run(flow())
+        finally:
+            stack.stop()
+
+        assert len(ca.validated_keyauths) == 1
+        assert (tls_dir / "issued.test.pem").exists()
+        assert (tls_dir / "issued.test.key").exists()
+        # Challenge certs are ephemeral: cleaned up after the order.
+        assert list(alpn_dir.iterdir()) == []
+
+
+class TestResponseFraming:
+    @pytest.fixture()
+    def raw_stack(self, tmp_path):
+        """Native stack whose upstream is a raw socket server we script
+        per-test (python http.server can't speak chunked/100-continue)."""
+        from pingoo_tpu.compiler import compile_ruleset
+
+        handler_box = {}
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(8)
+        up_port = lsock.getsockname()[1]
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = lsock.accept()
+                except OSError:
+                    return
+                h = handler_box.get("handler")
+                if h:
+                    threading.Thread(target=h, args=(conn,),
+                                     daemon=True).start()
+                else:
+                    conn.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+
+        plan = compile_ruleset(_block_rules(), {})
+        ring = Ring(str(tmp_path / "ring"), capacity=256, create=True)
+        sidecar = RingSidecar(ring, plan, {}, max_batch=32)
+        threading.Thread(target=sidecar.run, daemon=True).start()
+        port = _free_port()
+        proc = subprocess.Popen(
+            [HTTPD, str(port), str(tmp_path / "ring"), "127.0.0.1",
+             str(up_port)], stdout=subprocess.PIPE)
+        assert b"listening" in proc.stdout.readline()
+
+        class S:
+            pass
+
+        s = S()
+        s.port = port
+        s.handler_box = handler_box
+        yield s
+        proc.kill()
+        proc.wait()
+        lsock.close()
+        sidecar.stop()
+        ring.close()
+
+    @staticmethod
+    def _read_head(conn):
+        data = b""
+        while b"\r\n\r\n" not in data:
+            ch = conn.recv(65536)
+            if not ch:
+                return data
+            data += ch
+        return data
+
+    def test_chunked_response_relayed_and_keepalive(self, raw_stack):
+        def handler(conn):
+            self._read_head(conn)
+            conn.sendall(b"HTTP/1.1 200 OK\r\ntransfer-encoding: chunked\r\n"
+                         b"connection: close\r\n\r\n"
+                         b"5\r\nhello\r\n6\r\n world\r\n0\r\n\r\n")
+            conn.close()
+
+        raw_stack.handler_box["handler"] = handler
+        c = socket.create_connection(("127.0.0.1", raw_stack.port),
+                                     timeout=10)
+        c.sendall(b"GET /c HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n\r\n")
+        data = b""
+        c.settimeout(10)
+        while b"0\r\n\r\n" not in data:
+            data += c.recv(65536)
+        assert data.startswith(b"HTTP/1.1 200")
+        assert b"hello" in data and b" world" in data
+        # upstream said connection: close, but the proxy reframes:
+        # chunked framing lets the client connection stay alive.
+        assert b"connection: keep-alive" in data.lower()
+        c.sendall(b"GET /c2 HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n\r\n")
+        data2 = b""
+        while b"0\r\n\r\n" not in data2:
+            data2 += c.recv(65536)
+        assert data2.startswith(b"HTTP/1.1 200")
+        c.close()
+
+    def test_100_continue_interim_passthrough(self, raw_stack):
+        def handler(conn):
+            head = self._read_head(conn)
+            assert b"expect" in head.lower()
+            conn.sendall(b"HTTP/1.1 100 Continue\r\n\r\n")
+            # read the body (4 bytes)
+            body = b""
+            while len(body) < 4:
+                body += conn.recv(1024)
+            resp = b"got:" + body
+            conn.sendall(b"HTTP/1.1 200 OK\r\ncontent-length: " +
+                         str(len(resp)).encode() + b"\r\n\r\n" + resp)
+            conn.close()
+
+        raw_stack.handler_box["handler"] = handler
+        c = socket.create_connection(("127.0.0.1", raw_stack.port),
+                                     timeout=10)
+        c.sendall(b"POST /e HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n"
+                  b"expect: 100-continue\r\ncontent-length: 4\r\n\r\n")
+        c.settimeout(10)
+        interim = self._read_head(c)
+        assert interim.startswith(b"HTTP/1.1 100")
+        c.sendall(b"BODY")
+        data = interim[len(b"HTTP/1.1 100 Continue\r\n\r\n"):]
+        while b"got:BODY" not in data:
+            ch = c.recv(65536)
+            if not ch:
+                break
+            data += ch
+        assert b"HTTP/1.1 200" in data and b"got:BODY" in data
+        c.close()
+
+    def test_half_closed_client_times_out_not_spins(self, raw_stack):
+        """A client that half-closes mid-proxy must be reaped by the
+        idle sweep (the EOF disarms the read side; no busy loop)."""
+        def handler(conn):
+            self._read_head(conn)
+            time.sleep(30)  # upstream never answers
+            conn.close()
+
+        raw_stack.handler_box["handler"] = handler
+        c = socket.create_connection(("127.0.0.1", raw_stack.port),
+                                     timeout=10)
+        c.sendall(b"GET /h HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n\r\n")
+        time.sleep(0.3)
+        c.shutdown(socket.SHUT_WR)  # half-close during proxying
+        # The connection must not consume CPU; give the sweep a moment
+        # and confirm the process is still healthy by a second request.
+        time.sleep(1.2)
+        data = raw_stack.handler_box  # keep reference
+        c2 = socket.create_connection(("127.0.0.1", raw_stack.port),
+                                      timeout=10)
+        c2.sendall(b"GET /evil HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n"
+                   b"connection: close\r\n\r\n")
+        resp = b""
+        c2.settimeout(10)
+        try:
+            while True:
+                ch = c2.recv(65536)
+                if not ch:
+                    break
+                resp += ch
+        except socket.timeout:
+            pass
+        assert resp.startswith(b"HTTP/1.1 403")
+        c.close()
+        c2.close()
+        assert data is raw_stack.handler_box
