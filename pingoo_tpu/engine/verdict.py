@@ -269,62 +269,172 @@ def _eval_bool(ir, leaves, B):
 # -- public API --------------------------------------------------------------
 
 
-def make_verdict_fn(plan: RulesetPlan):
-    """Build the jitted device verdict: (tables, arrays) -> [B, R_dev] bool.
+def _matched_cols(plan: RulesetPlan, tables, arrays):
+    """Traced body shared by the verdict/lane functions:
+    (tables, arrays) -> [B, R_dev] bool in device_rule_indices order.
 
-    Columns follow plan.device_rule_indices order. Rules whose IR is a
-    single leaf (the common WAF shape — one predicate per rule) read
-    their column straight out of the stacked leaf matrix with one
-    gather; only compound rules evaluate their boolean tree (error ->
-    no-match per pingoo/rules.rs:41-44 either way).
-    """
+    Rules whose IR is a single leaf (the common WAF shape — one
+    predicate per rule) read their column straight out of the stacked
+    leaf matrix with one gather; only compound rules evaluate their
+    boolean tree (error -> no-match per pingoo/rules.rs:41-44 either
+    way)."""
     device_rules = [r for r in plan.rules if not r.host]
     n_leaves = len(plan.leaves)
+    B = arrays["asn"].shape[0]
+    leaves = _eval_leaves(plan, tables, arrays, B)
+    # Effective per-leaf match columns (+ const true / false).
+    eff = [None] * n_leaves
+    for leaf_id, (v, e) in leaves.items():
+        eff[leaf_id] = v & ~e
+    base = eff + [
+        jnp.ones((B,), dtype=bool),  # column n_leaves: const true
+        jnp.zeros((B,), dtype=bool),  # column n_leaves + 1: const false
+    ]
+    extra_cols = []
+    rule_col: list[int] = []
+    for rule in device_rules:
+        if rule.always:
+            rule_col.append(n_leaves)
+        elif isinstance(rule.ir, BLeaf):
+            rule_col.append(rule.ir.leaf_id)
+        elif isinstance(rule.ir, BConst):
+            rule_col.append(n_leaves if rule.ir.value else n_leaves + 1)
+        elif isinstance(rule.ir, BErrConst):
+            rule_col.append(n_leaves + 1)
+        else:
+            v, e = _eval_bool(rule.ir, leaves, B)
+            rule_col.append(len(base) + len(extra_cols))
+            extra_cols.append(v & ~e)
+    if not rule_col:
+        return jnp.zeros((B, 0), dtype=bool)
+    allmat = jnp.stack(base + extra_cols, axis=1)  # [B, NL + 2 + extra]
+    return jnp.take(allmat, jnp.asarray(rule_col, dtype=jnp.int32), axis=1)
+
+
+def make_verdict_fn(plan: RulesetPlan):
+    """Jitted device verdict: (tables, arrays) -> [B, R_dev] bool."""
 
     @jax.jit
     def verdict(tables, arrays):
-        B = arrays["asn"].shape[0]
-        leaves = _eval_leaves(plan, tables, arrays, B)
-        # Effective per-leaf match columns (+ const true / false).
-        eff = [None] * n_leaves
-        for leaf_id, (v, e) in leaves.items():
-            eff[leaf_id] = v & ~e
-        base = eff + [
-            jnp.ones((B,), dtype=bool),  # column n_leaves: const true
-            jnp.zeros((B,), dtype=bool),  # column n_leaves + 1: const false
-        ]
-        extra_cols = []
-        rule_col: list[int] = []
-        for rule in device_rules:
-            if rule.always:
-                rule_col.append(n_leaves)
-            elif isinstance(rule.ir, BLeaf):
-                rule_col.append(rule.ir.leaf_id)
-            elif isinstance(rule.ir, BConst):
-                rule_col.append(n_leaves if rule.ir.value else n_leaves + 1)
-            elif isinstance(rule.ir, BErrConst):
-                rule_col.append(n_leaves + 1)
-            else:
-                v, e = _eval_bool(rule.ir, leaves, B)
-                rule_col.append(len(base) + len(extra_cols))
-                extra_cols.append(v & ~e)
-        if not rule_col:
-            return jnp.zeros((B, 0), dtype=bool)
-        allmat = jnp.stack(base + extra_cols, axis=1)  # [B, NL + 2 + extra]
-        return jnp.take(allmat, jnp.asarray(rule_col, dtype=jnp.int32), axis=1)
+        return _matched_cols(plan, tables, arrays)
 
     return verdict
 
 
+LANE_NONE = np.int32(2**30)  # "no rule": sorts after every real index
+
+
+def make_lane_fn(plan: RulesetPlan):
+    """Jitted device ACTION-LANE reduction: (tables, arrays) ->
+    (first_act_idx [B] i32, first_act_kind [B] i32, first_block_idx [B]
+    i32), all in ORIGINAL rule-index space.
+
+    This is the transfer-thin form of the verdict for the ring sidecar:
+    instead of shipping the [B, R_dev] match matrix off the device
+    (half a megabyte per 1k batch — which dominates when the chip sits
+    behind a network tunnel), the first-match reduction the action
+    semantics need runs on device and only three [B] lanes return.
+    Host-interpreted rules merge by index afterwards (merge_lanes)."""
+    device_rules = [r for r in plan.rules if not r.host]
+    orig_idx = np.array([r.index for r in device_rules], dtype=np.int32)
+    first_kind = np.array(
+        [(1 if r.actions[0] == Action.BLOCK else 2) if r.actions else 0
+         for r in device_rules], dtype=np.int32)
+    has_act = first_kind != 0
+    has_block = np.array([Action.BLOCK in r.actions for r in device_rules],
+                         dtype=bool)
+
+    @jax.jit
+    def lanes(tables, arrays):
+        matched = _matched_cols(plan, tables, arrays)  # [B, C]
+        B = matched.shape[0]
+        if matched.shape[1] == 0:
+            none = jnp.full((B,), LANE_NONE, dtype=jnp.int32)
+            return jnp.stack([none, jnp.zeros((B,), jnp.int32), none])
+        idx = jnp.asarray(orig_idx)[None, :]
+        act_idx = jnp.where(matched & jnp.asarray(has_act)[None, :], idx,
+                            LANE_NONE)
+        first_act_idx = jnp.min(act_idx, axis=1)
+        arg = jnp.argmin(act_idx, axis=1)
+        kind = jnp.where(first_act_idx < LANE_NONE,
+                         jnp.take(jnp.asarray(first_kind), arg), 0)
+        blk_idx = jnp.where(matched & jnp.asarray(has_block)[None, :], idx,
+                            LANE_NONE)
+        first_block_idx = jnp.min(blk_idx, axis=1)
+        # One stacked [3, B] array = ONE device->host transfer.
+        return jnp.stack([first_act_idx, kind, first_block_idx])
+
+    return lanes
+
+
+def host_rule_lanes(plan: RulesetPlan, batch, lists):
+    """Host-interpreted rules' contribution to the action lanes
+    (same triple as make_lane_fn, original-index space)."""
+    host_rules = plan.host_rules
+    B = batch.size
+    first_act = np.full(B, LANE_NONE, dtype=np.int32)
+    kind = np.zeros(B, dtype=np.int32)
+    first_block = np.full(B, LANE_NONE, dtype=np.int32)
+    if not host_rules:
+        return first_act, kind, first_block
+    from .batch import batch_to_contexts
+
+    contexts = batch_to_contexts(batch, lists)
+    for rule in host_rules:
+        r_kind = ((1 if rule.actions[0] == Action.BLOCK else 2)
+                  if rule.actions else 0)
+        r_block = Action.BLOCK in rule.actions
+        if not r_kind and not r_block:
+            continue
+        prog = rule.program
+        for i, ctx in enumerate(contexts):
+            if rule.index >= first_act[i] and (not r_block
+                                               or rule.index >= first_block[i]):
+                continue  # cannot improve either lane for this request
+            try:
+                m = execute_as_bool(prog, ctx)
+            except Exception:
+                m = False
+            if not m:
+                continue
+            if r_kind and rule.index < first_act[i]:
+                first_act[i] = rule.index
+                kind[i] = r_kind
+            if r_block and rule.index < first_block[i]:
+                first_block[i] = rule.index
+    return first_act, kind, first_block
+
+
+def merge_lanes(dev_lanes, host_lanes) -> tuple[np.ndarray, np.ndarray]:
+    """Combine device + host lane triples into the per-request action
+    pair (unverified 0/1/2, verified_block bool) — reproducing the
+    reference loop's first-match order across BOTH rule populations.
+    `dev_lanes` is the stacked [3, B] array from make_lane_fn."""
+    stacked = np.asarray(dev_lanes)
+    d_act, d_kind, d_blk = stacked[0], stacked[1], stacked[2]
+    h_act, h_kind, h_blk = host_lanes
+    host_wins = h_act < d_act
+    act_idx = np.where(host_wins, h_act, d_act)
+    kind = np.where(host_wins, h_kind, d_kind)
+    unverified = np.where(act_idx < LANE_NONE, kind, 0).astype(np.int32)
+    verified_block = np.minimum(d_blk, h_blk) < LANE_NONE
+    return unverified, verified_block
+
+
 def evaluate_batch(plan, verdict_fn, tables, batch, lists) -> np.ndarray:
     """Full match matrix [B, R] in original rule order (device + host)."""
-    arrays = batch.arrays
-    dev = np.asarray(verdict_fn(tables, arrays))
+    dev = verdict_fn(tables, batch.arrays)  # async dispatch (jax)
+    return finish_batch(plan, dev, batch, lists)
+
+
+def finish_batch(plan, dev, batch, lists) -> np.ndarray:
+    """Combine an in-flight device verdict with the host-interpreted
+    rules. Host rules run FIRST — jax dispatch is asynchronous, so the
+    interpreter work overlaps the device execution (and any transport
+    latency to a remote chip) instead of serializing after it."""
     R = len(plan.rules)
     B = batch.size
     out = np.zeros((B, R), dtype=bool)
-    for col, idx in enumerate(plan.device_rule_indices):
-        out[:, idx] = dev[:, col]
     host_rules = plan.host_rules
     if host_rules:
         from .batch import batch_to_contexts
@@ -335,6 +445,9 @@ def evaluate_batch(plan, verdict_fn, tables, batch, lists) -> np.ndarray:
             col_vals = out[:, rule.index]
             for i, ctx in enumerate(contexts):
                 col_vals[i] = execute_as_bool(prog, ctx)
+    dev = np.asarray(dev)  # block on the device result
+    for col, idx in enumerate(plan.device_rule_indices):
+        out[:, idx] = dev[:, col]
     return out
 
 

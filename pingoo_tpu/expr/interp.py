@@ -299,7 +299,7 @@ def _call(node: ast.Call, ctx: Context) -> object:
         if isinstance(arg, Regex):
             return arg.search(recv)
         if isinstance(arg, str):
-            return Regex(arg).search(recv)
+            return Regex.cached(arg).search(recv)
         raise EvalError(f"matches() requires String or Regex argument, got {type_name(arg)}")
     raise EvalError(f"unknown function {node.func!r}")  # pragma: no cover
 

@@ -22,6 +22,7 @@ no-matches (fail-open, pingoo/rules.rs:41-44).
 
 from __future__ import annotations
 
+import functools
 import ipaddress
 import re
 from typing import Union
@@ -114,6 +115,14 @@ class Regex:
             raise EvalError("non-byte string in matches()") from exc
         return self._re.search(data) is not None
 
+    @staticmethod
+    def cached(pattern: str) -> "Regex":
+        """Compile-once lookup for the interpreter hot path — host-rule
+        fallback evaluates `matches(lit)` per request, and re-compiling
+        the pattern each time dominated the whole host batch cost.
+        Failures are not cached (identical EvalError every call)."""
+        return _regex_cache(pattern)
+
     def __eq__(self, other: object) -> bool:
         if not isinstance(other, Regex):
             return NotImplemented
@@ -124,6 +133,11 @@ class Regex:
 
     def __repr__(self) -> str:
         return f"Regex({self.pattern!r})"
+
+
+@functools.lru_cache(maxsize=4096)
+def _regex_cache(pattern: str) -> Regex:
+    return Regex(pattern)
 
 
 def checked_i64(value: int) -> int:

@@ -114,6 +114,13 @@ class Server:
             acme_challenges = self.acme.challenges
             await self.acme.start_in_background()
 
+        # Deployment flag: set when this listener runs as the control
+        # plane behind the native data plane (which strips and re-injects
+        # x-forwarded-for) — the captcha client id must then bind the
+        # REAL client address or issued cookies never verify at the
+        # native gate. Never set it on an internet-facing listener.
+        trust_xff = os.environ.get("PINGOO_TRUST_XFF") == "1"
+
         services_by_name = {s.name: s for s in config.services}
         for listener_cfg in config.listeners:
             listener_services = [services_by_name[n]
@@ -134,6 +141,7 @@ class Server:
                     tls_context=(tls_manager.server_context()
                                  if listener_cfg.protocol.is_tls else None),
                     acme_challenges=acme_challenges,
+                    trust_xff=trust_xff,
                 )
                 await listener.bind()
                 self.http_listeners.append(listener)

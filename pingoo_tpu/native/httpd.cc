@@ -210,21 +210,44 @@ class CaptchaGate {
   // CaptchaManager, host/captcha.py). Returns false if unavailable —
   // the gate then treats every client as unverified (fail safe).
   bool load(const char* jwks_path) {
-    FILE* f = fopen(jwks_path, "r");
-    if (!f) return false;
+    path_ = jwks_path;
+    return reload();
+  }
+
+  bool reload() {
+    struct stat st;
+    if (stat(path_.c_str(), &st) != 0) return pkey_ != nullptr;
+    if (pkey_ != nullptr && st.st_mtime == loaded_mtime_) return true;
+    FILE* f = fopen(path_.c_str(), "r");
+    if (!f) return pkey_ != nullptr;
     std::string text;
     char buf[4096];
     size_t n;
     while ((n = fread(buf, 1, sizeof(buf), f)) > 0) text.append(buf, n);
     fclose(f);
     std::string x;
-    if (!json_str(text, "x", &x)) return false;
     std::string raw;
-    if (!b64url_decode(x, &raw) || raw.size() != 32) return false;
-    pkey_ = EVP_PKEY_new_raw_public_key(
+    if (!json_str(text, "x", &x) || !b64url_decode(x, &raw) ||
+        raw.size() != 32)
+      return pkey_ != nullptr;
+    EVP_PKEY* pk = EVP_PKEY_new_raw_public_key(
         EVP_PKEY_ED25519, nullptr,
         reinterpret_cast<const unsigned char*>(raw.data()), raw.size());
-    return pkey_ != nullptr;
+    if (pk == nullptr) return pkey_ != nullptr;
+    if (pkey_ != nullptr) EVP_PKEY_free(pkey_);
+    pkey_ = pk;
+    loaded_mtime_ = st.st_mtime;
+    return true;
+  }
+
+  // Re-stat the JWKS periodically so a control plane that starts (or
+  // rotates keys) AFTER this process does not leave every client
+  // permanently unverified — the same freshness discipline as the
+  // per-handshake challenge-cert reads.
+  void maybe_reload(time_t now) {
+    if (path_.empty() || now - last_check_ < 5) return;
+    last_check_ = now;
+    reload();
   }
 
   bool available() const { return pkey_ != nullptr; }
@@ -279,7 +302,10 @@ class CaptchaGate {
   }
 
  private:
+  std::string path_;
   EVP_PKEY* pkey_ = nullptr;
+  time_t loaded_mtime_ = 0;
+  time_t last_check_ = 0;
 };
 
 std::string captcha_client_id(const std::string& ip, const std::string& ua,
@@ -407,6 +433,7 @@ struct BodyFramer {
   enum CState { kSize, kData, kDataCrlf, kTrailer } cstate = kSize;
   std::string linebuf;
   bool done = false;
+  bool bad = false;  // malformed framing: caller must refuse/close
 
   void reset_none() { *this = BodyFramer(); done = true; }
   void reset_cl(long long n) {
@@ -455,11 +482,28 @@ struct BodyFramer {
         case kSize:
           linebuf.push_back(c);
           ++used;
-          if (linebuf.size() > 1024) { done = true; return used; }  // junk
+          if (linebuf.size() > 1024) {  // junk flood
+            bad = true;
+            done = true;
+            return used;
+          }
           if (linebuf.size() >= 2 &&
               linebuf.compare(linebuf.size() - 2, 2, "\r\n") == 0) {
-            long long sz = strtoll(linebuf.c_str(), nullptr, 16);
+            // Chunk size must be plain hex (extensions after ';' are
+            // tolerated); a leading '-' or garbage would make
+            // `remaining` negative and the cast in kData wrap to ~2^64.
+            char first = linebuf.empty() ? 0 : linebuf[0];
+            bool hex_start = (first >= '0' && first <= '9') ||
+                             (first >= 'a' && first <= 'f') ||
+                             (first >= 'A' && first <= 'F');
+            long long sz = hex_start ? strtoll(linebuf.c_str(), nullptr, 16)
+                                     : -1;
             linebuf.clear();
+            if (!hex_start || sz < 0 || sz > (1LL << 40)) {
+              bad = true;
+              done = true;
+              return used;
+            }
             if (sz == 0) {
               cstate = kTrailer;
             } else {
@@ -506,6 +550,8 @@ struct Parsed {
   std::string method, target, path, host, user_agent;
   std::string verified_cookie;  // __pingoo_captcha_verified value
   long long content_length = 0;
+  bool has_content_length = false;
+  bool bad_content_length = false;  // dup-with-different-value/garbage
   bool chunked = false;
   bool has_transfer_encoding = false;
   bool keep_alive = true;  // HTTP/1.1 default
@@ -558,7 +604,20 @@ Parsed parse_head(const std::string& head) {
       } else if (name == "user-agent") {
         p.user_agent = value;
       } else if (name == "content-length") {
-        p.content_length = strtoll(value.c_str(), nullptr, 10);
+        // RFC 7230 §3.3.3: reject non-numeric values and duplicates
+        // that disagree — silent last-wins framing would desync the
+        // proxy from any first-wins upstream (request smuggling).
+        bool numeric = !value.empty();
+        for (char ch : value)
+          if (ch < '0' || ch > '9') numeric = false;
+        long long v = numeric ? strtoll(value.c_str(), nullptr, 10) : -1;
+        if (!numeric || v < 0 ||
+            (p.has_content_length && v != p.content_length)) {
+          p.bad_content_length = true;
+        } else {
+          p.content_length = v;
+          p.has_content_length = true;
+        }
       } else if (name == "transfer-encoding") {
         p.has_transfer_encoding = true;
         if (lower(value).find("chunked") != std::string::npos)
@@ -624,6 +683,8 @@ std::string rewrite_request_head(const Parsed& p, const std::string& client_ip,
     pos = eol + 2;
   }
   out += "connection: close\r\n";
+  if (!p.chunked && p.has_content_length)
+    out += "content-length: " + std::to_string(p.content_length) + "\r\n";
   out += "x-forwarded-for: " + client_ip + "\r\n";
   out += std::string("x-forwarded-proto: ") + (tls ? "https" : "http") + "\r\n";
   if (!p.host.empty()) out += "x-forwarded-host: " + p.host + "\r\n";
@@ -638,7 +699,16 @@ std::string rewrite_request_head(const Parsed& p, const std::string& client_ip,
 // CL-trusting upstream would see a different body boundary.
 bool drop_request_header(const std::string& lname, bool chunked) {
   if (is_hop_header(lname)) return true;
-  if (chunked && lname == "content-length") return true;
+  // The proxy re-derives body framing and appends its own canonical
+  // content-length; forwarding the client's copies verbatim would let
+  // duplicate/odd values desync upstream framing (RFC 7230 §3.3.3).
+  if (lname == "content-length") return true;
+  (void)chunked;
+  // Identity headers the upstream must only ever receive from THIS
+  // proxy — client-supplied copies would spoof the trusted client IP
+  // (reference strips and re-sets the same set,
+  // http_proxy_service.rs:114-190).
+  if (lname.compare(0, 7, "pingoo-") == 0) return true;
   return lname == "x-forwarded-for" || lname == "x-forwarded-proto" ||
          lname == "x-forwarded-host";
 }
@@ -1040,6 +1110,10 @@ class Server {
       c->upbuf.append(c->inbuf, 0, take);
       c->inbuf.erase(0, take);
     }
+    if (c->req_body.bad) {  // malformed chunked framing mid-stream
+      mark_close(c);
+      return;
+    }
     if (c->req_body.done) c->req_body_forwarded = true;
   }
 
@@ -1149,9 +1223,11 @@ class Server {
     c->req = p;
     if (++c->requests_served > kMaxRequestsPerConn) c->req.keep_alive = false;
 
-    // A Transfer-Encoding we cannot frame (anything but chunked) would
-    // desync the proxy from the upstream: refuse it.
-    if (p.has_transfer_encoding && !p.chunked) {
+    // A Transfer-Encoding we cannot frame (anything but chunked), a
+    // malformed/conflicting Content-Length, or TE+CL together would
+    // desync the proxy from the upstream: refuse them (RFC 7230
+    // §3.3.3 smuggling rules).
+    if ((p.has_transfer_encoding && !p.chunked) || p.bad_content_length) {
       respond_close(c, k400);
       return;
     }
@@ -1194,6 +1270,7 @@ class Server {
     // (reference http_listener.rs:222-236) — here: redirect.
     std::string client_id = captcha_client_id(
         c->peer_ip, c->req.user_agent, c->req.host);
+    if (gate_ != nullptr) gate_->maybe_reload(now_);
     if (!c->req.verified_cookie.empty() && gate_ != nullptr &&
         gate_->available()) {
       if (gate_->verify(c->req.verified_cookie, client_id, now_)) {
@@ -1375,6 +1452,7 @@ class Server {
           size_t take = c->resp_body.consume(rest.data(), rest.size());
           c->outbuf.append(rest, 0, take);
           // bytes past the response end are junk; drop them
+          if (c->resp_body.bad) mark_close(c);
         }
         return;
       }
@@ -1383,6 +1461,7 @@ class Server {
       size_t take = c->resp_body.consume(data, len);
       c->outbuf.append(data, take);
     }
+    if (c->resp_body.bad) mark_close(c);  // malformed upstream chunking
   }
 
   void maybe_finish_response(Conn* c) {

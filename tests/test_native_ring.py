@@ -78,13 +78,13 @@ class TestRingBasics:
             assert flags.tolist() == [0, 0, 1]
             assert slots["url_len"].tolist() == [911, 6, 2048]
 
-            sidecar = RingSidecar(ring, plan, {}, max_batch=8)
             from pingoo_tpu.engine.batch import RequestBatch, bucket_arrays
+            from pingoo_tpu.engine.verdict import make_verdict_fn
 
             batch = RequestBatch(size=3,
                                  arrays=bucket_arrays(slots_to_arrays(slots)))
-            matched = evaluate_batch(plan, sidecar._verdict_fn,
-                                     sidecar._tables, batch, {})
+            matched = evaluate_batch(plan, make_verdict_fn(plan),
+                                     plan.device_tables(), batch, {})
             acts = first_action(plan, matched)
             assert acts.tolist() == [1, 0, 0]
         finally:

@@ -365,3 +365,40 @@ class TestDeviceParity:
                                 lists=lists)
         binding = plan.bindings[0]
         assert binding.kind == "ip_list_large"
+
+
+class TestLaneReductionParity:
+    def test_device_lane_fn_matches_full_matrix_oracle(self):
+        """The transfer-thin on-device lane reduction (make_lane_fn +
+        host_rule_lanes + merge_lanes — the ring sidecar's path) must
+        produce exactly the lanes derived from the full match matrix."""
+        import numpy as np
+
+        from pingoo_tpu.engine.verdict import (
+            action_lanes,
+            evaluate_batch,
+            host_rule_lanes,
+            make_lane_fn,
+            make_verdict_fn,
+            merge_lanes,
+        )
+        from pingoo_tpu.utils.crs import generate_ruleset, generate_traffic
+
+        rules, lists = generate_ruleset(200, with_lists=True,
+                                        list_sizes=(256, 64))
+        plan = compile_ruleset(rules, lists)
+        assert plan.host_rules, "corpus must include host-fallback rules"
+        tables = plan.device_tables()
+        reqs = generate_traffic(512, lists=lists, seed=11,
+                                attack_fraction=0.3)
+        batch = encode_requests(reqs)
+
+        matched = evaluate_batch(plan, make_verdict_fn(plan), tables,
+                                 batch, lists)
+        want_unv, want_vblk = action_lanes(plan, matched)
+        dev = make_lane_fn(plan)(tables, batch.arrays)
+        host = host_rule_lanes(plan, batch, lists)
+        got_unv, got_vblk = merge_lanes(np.asarray(dev), host)
+        np.testing.assert_array_equal(want_unv, got_unv)
+        np.testing.assert_array_equal(want_vblk, got_vblk)
+        assert (got_unv == 1).any()  # corpus actually blocks something
