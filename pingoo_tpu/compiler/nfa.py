@@ -211,9 +211,17 @@ class NfaBank:
     # w-1 advances into bit0 of w, and opt-closure escapes re-inject there.
     carry_mask: np.ndarray = field(
         default_factory=lambda: np.zeros(0, dtype=np.uint32))
+    # Bits that are sticky ACCEPT accumulators (self-looping on every
+    # byte). rep & ~sticky == 0 means the automaton has bounded memory
+    # (state at t depends only on the last `max_footprint` bytes), which
+    # enables the halo-parallel sequence scan (parallel/ring.py).
+    sticky_mask: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=np.uint32))
     # Static number of opt-propagation passes the scan needs
     # (1 + max word boundaries any optional run crosses).
     prop_passes: int = 1
+    # Largest single-pattern footprint in bits (>= its byte memory).
+    max_footprint: int = 0
     slots: list[PatternSlot] = field(default_factory=list)
 
     @property
@@ -324,9 +332,11 @@ class _BankBuilder:
         self.init_u: list[int] = []
         self.opt: list[int] = []
         self.rep: list[int] = []
+        self.sticky: list[int] = []
         self.carry: list[bool] = []
         self.dedicated: list[bool] = []
         self.max_passes = 1
+        self.max_footprint = 0
 
     def add_word(self, carry: bool, dedicated: bool) -> int:
         self.used.append(0)
@@ -335,6 +345,7 @@ class _BankBuilder:
         self.init_u.append(0)
         self.opt.append(0)
         self.rep.append(0)
+        self.sticky.append(0)
         self.carry.append(carry)
         self.dedicated.append(dedicated)
         return len(self.used) - 1
@@ -373,9 +384,11 @@ class _BankBuilder:
                 for b in range(256):
                     self.byte_rows[w][b] = self.byte_rows[w].get(b, 0) | bit(n)
                 self.rep[w] |= bit(n)
+                self.sticky[w] |= bit(n)
                 accept_mask |= bit(n)
                 n += 1
             self.used[w] += 1 + n
+            self.max_footprint = max(self.max_footprint, 1 + n)
         return PatternSlot(accepts=((w, accept_mask),),
                            always_match=False, empty_ok=False)
 
@@ -441,10 +454,14 @@ class _BankBuilder:
                     self.byte_rows[w][byte] = (
                         self.byte_rows[w].get(byte, 0) | (1 << b))
                 self.rep[w] |= 1 << b
+                self.sticky[w] |= 1 << b
                 accepts[w] = accepts.get(w, 0) | (1 << b)
             for i in sub.accept:
                 w, b = placed[i]
                 accepts[w] = accepts.get(w, 0) | (1 << b)
+            self.max_footprint = max(
+                self.max_footprint,
+                2 + len(sub.positions) + (1 if sub.sticky else 0))
         return PatternSlot(
             accepts=tuple(sorted(accepts.items())),
             always_match=False, empty_ok=False)
@@ -504,7 +521,9 @@ def build_bank(patterns: list[LinearPattern]) -> NfaBank:
     bank.opt = np.array(builder.opt, dtype=np.uint32)
     bank.rep = np.array(builder.rep, dtype=np.uint32)
     bank.carry_mask = np.array(builder.carry, dtype=np.uint32)
+    bank.sticky_mask = np.array(builder.sticky, dtype=np.uint32)
     bank.prop_passes = builder.max_passes
+    bank.max_footprint = builder.max_footprint
     return bank
 
 

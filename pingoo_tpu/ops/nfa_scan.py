@@ -50,6 +50,7 @@ class NfaTables:
     opt: jax.Array  # [W]
     rep: jax.Array  # [W]
     carry_mask: jax.Array  # [W] uint32: 1 where word w continues word w-1
+    sticky: jax.Array  # [W] uint32: sticky-accept accumulator bits
     # Accept extraction: J (word, mask) pairs; pattern p owns the pairs
     # member[:, p] selects (pairs are contiguous per pattern).
     accept_word: jax.Array  # [J] int32
@@ -61,14 +62,21 @@ class NfaTables:
     has_carry: bool = False
     extra_passes: int = 0  # opt-propagation passes beyond the first
     identity_accept: bool = True  # J == P with pair j belonging to slot j
+    # Bounded-memory property: every self-loop is a sticky accept
+    # accumulator, so the non-accept state at position t depends only on
+    # the last `max_footprint` bytes — the precondition for the
+    # halo-parallel sequence scan (parallel/ring.py halo_nfa_scan).
+    halo_ok: bool = False
+    max_footprint: int = 0
 
 
 jax.tree_util.register_dataclass(
     NfaTables,
     data_fields=["byte_table", "init_anchored", "init_unanchored", "opt",
-                 "rep", "carry_mask", "accept_word", "accept_mask",
+                 "rep", "carry_mask", "sticky", "accept_word", "accept_mask",
                  "accept_member", "slot_always", "slot_empty_ok"],
-    meta_fields=["has_carry", "extra_passes", "identity_accept"],
+    meta_fields=["has_carry", "extra_passes", "identity_accept", "halo_ok",
+                 "max_footprint"],
 )
 
 
@@ -109,6 +117,8 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
     for j, p in enumerate(pair_slot):
         member[j, p] = 1.0
 
+    halo_ok = bool(np.all((bank.rep & ~bank.sticky_mask) == 0)) \
+        if bank.num_words else True
     return NfaTables(
         byte_table=jnp.asarray(byte_table),
         init_anchored=jnp.asarray(pad(bank.init_anchored)),
@@ -116,6 +126,7 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
         opt=jnp.asarray(pad(bank.opt)),
         rep=jnp.asarray(pad(bank.rep)),
         carry_mask=jnp.asarray(pad(bank.carry_mask)),
+        sticky=jnp.asarray(pad(bank.sticky_mask)),
         accept_word=jnp.asarray(np.array(acc_word or [0], dtype=np.int32)),
         accept_mask=jnp.asarray(np.array(acc_mask or [0], dtype=np.uint32)),
         accept_member=jnp.asarray(member),
@@ -126,6 +137,8 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
         has_carry=bank.has_carry,
         extra_passes=max(bank.prop_passes - 1, 0),
         identity_accept=identity,
+        halo_ok=halo_ok,
+        max_footprint=int(bank.max_footprint),
     )
 
 
@@ -149,6 +162,10 @@ def scan_chunk(
     lengths = lengths.astype(jnp.int32)
     has_carry = tables.has_carry
     passes = 1 + tables.extra_passes
+    # Only the halo scan passes a (traced, possibly negative) t_offset;
+    # the plain/ring paths pass a non-negative Python int, so the t >= 0
+    # warm-up gate stays OUT of their traced hot step.
+    t_can_be_negative = not (isinstance(t_offset, int) and t_offset >= 0)
 
     def shift_words(x):
         """[B, W] -> value of word w-1 moved into word w (word 0 gets 0)."""
@@ -171,7 +188,10 @@ def scan_chunk(
                 esc = (x < opt).astype(jnp.uint32)
                 adv = adv | (shift_words(esc) & carry_mask)
         S_new = (adv | (S & rep)) & bc
-        S = jnp.where((t < lengths)[:, None], S_new, S)
+        live = t < lengths
+        if t_can_be_negative:  # halo warm-up prefix on device 0
+            live = (t >= 0) & live
+        S = jnp.where(live[:, None], S_new, S)
         return S, None
 
     # unroll amortizes loop bookkeeping and lets XLA fuse across steps
@@ -187,12 +207,17 @@ def init_scan_state(B: int, W: int) -> jax.Array:
     return jnp.zeros((B, W), dtype=jnp.uint32)
 
 
-def extract_slots(tables: NfaTables, state: jax.Array,
-                  lengths: jax.Array) -> jax.Array:
-    """Per-pattern verdicts [B, P] from the final state."""
+def extract_slots(tables: NfaTables, state: jax.Array, lengths: jax.Array,
+                  pair_hit: jax.Array | None = None) -> jax.Array:
+    """Per-pattern verdicts [B, P] from the final state.
+
+    `pair_hit` overrides the default per-accept-pair hit matrix — the
+    halo scan passes its sticky/owner-gated variant and reuses the
+    identical pair->slot reduction and empty/always lanes here."""
     lengths = lengths.astype(jnp.int32)
-    lanes = jnp.take(state, tables.accept_word, axis=1)  # [B, J]
-    pair_hit = (lanes & tables.accept_mask[None, :]) != 0
+    if pair_hit is None:
+        lanes = jnp.take(state, tables.accept_word, axis=1)  # [B, J]
+        pair_hit = (lanes & tables.accept_mask[None, :]) != 0
     if tables.identity_accept:
         hit = pair_hit  # J == P, pair j IS slot j
     else:

@@ -1,14 +1,17 @@
-"""Mesh construction, dp/tp/sp shardings, and the sp ring NFA scan."""
+"""Mesh construction, dp/tp/sp shardings, and the sp NFA scans
+(concurrent halo scan + sequential ring fallback)."""
 
 from .. import ops as _ops  # noqa: F401  (x64 before tracing)
 from .mesh import batch_shardings, make_mesh, pad_tables_for_tp, table_shardings
-from .ring import ring_nfa_scan, shard_batch_for_ring
+from .ring import halo_nfa_scan, ring_nfa_scan, shard_batch_for_ring, sp_nfa_scan
 
 __all__ = [
     "batch_shardings",
+    "halo_nfa_scan",
     "make_mesh",
     "pad_tables_for_tp",
     "ring_nfa_scan",
     "shard_batch_for_ring",
+    "sp_nfa_scan",
     "table_shardings",
 ]

@@ -82,6 +82,7 @@ def table_shardings(mesh: Mesh, tables: Mapping[str, Any]) -> dict:
             opt=w,
             rep=w,
             carry_mask=w,
+            sticky=w,
             accept_word=repl,
             accept_mask=repl,
             accept_member=repl,
@@ -155,6 +156,7 @@ def pad_tables_for_tp(np_tables: dict, tp: int) -> dict:
                 opt=pad_axis(np.asarray(val.opt), 0, tp),
                 rep=pad_axis(np.asarray(val.rep), 0, tp),
                 carry_mask=pad_axis(np.asarray(val.carry_mask), 0, tp),
+                sticky=pad_axis(np.asarray(val.sticky), 0, tp),
             )
         else:
             out[key] = val

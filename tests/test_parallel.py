@@ -229,3 +229,115 @@ class TestTpMultiWordHalo:
             gold = _re.compile(src)
             for i, d in enumerate(inputs):
                 assert got[i, col] == (gold.search(d) is not None), (col, d)
+
+
+class TestHaloScan:
+    """halo_nfa_scan: TRUE concurrent sequence parallelism (one halo
+    exchange, then every sp stage scans its own chunk at once)."""
+
+    SOURCES = [r"abc", "x" * 40, r"<svg[^>]{0,40}onload", r"\.php$",
+               "b" * 45 + "$", r"\babc\b", "e{0,60}f", r"^GET /[a-z]{1,8}$",
+               r"qq", r"a{2,4}b"]
+
+    def _bank(self):
+        patterns = []
+        for src in self.SOURCES:
+            patterns.extend(compile_regex(src))
+        bank = build_bank(patterns)
+        tables = bank_to_tables(bank)
+        assert tables.halo_ok, "corpus must be halo-eligible (no x*/x+)"
+        assert bank.has_carry  # multi-word spans present
+        return tables
+
+    def _inputs(self, rng, L):
+        inputs = [b"x" * 40, b"p" * 50 + b"x" * 40 + b"q" * 20,
+                  b"<svg " + b"a" * 40 + b"onload", b"b" * 45,
+                  b"z" * 70 + b"b" * 45, b"index.php", b"x/y.php",
+                  b"GET /abc", b" abc ", b"xabc", b"e" * 59 + b"f",
+                  b"aaab", b"", b"q" * L]
+        alphabet = b"xab<svg>onload .phpGET/eqcf"
+        for _ in range(18):
+            k = rng.randint(0, L)
+            inputs.append(bytes(rng.choice(alphabet) for _ in range(k)))
+        return inputs
+
+    @pytest.mark.parametrize("sp", [2, 4])
+    def test_halo_matches_plain_scan(self, devices, sp):
+        rng = random.Random(99)
+        tables = self._bank()
+        L = 256  # chunks >= the 64-bit max footprint at sp=4
+        inputs = self._inputs(rng, L)
+        B = len(inputs)
+        data = np.zeros((B, L), dtype=np.uint8)
+        lens = np.zeros(B, dtype=np.int32)
+        for i, d in enumerate(inputs):
+            data[i, : len(d)] = np.frombuffer(d[:L], dtype=np.uint8)
+            lens[i] = min(len(d), L)
+
+        want = np.asarray(nfa_scan(tables, data, lens))
+        mesh = make_mesh(dp=2, tp=1, sp=sp)
+        from pingoo_tpu.parallel import halo_nfa_scan
+
+        data_s, lens_s = shard_batch_for_ring(mesh, data, lens)
+        got = np.asarray(halo_nfa_scan(mesh, tables, data_s, lens_s))
+        np.testing.assert_array_equal(got, want)
+
+    def test_matches_straddling_chunk_boundaries(self, devices):
+        """Matches whose span crosses chunk cuts must be caught by the
+        halo warm-up; $-accepts must come from the chunk owner."""
+        tables = self._bank()
+        L = 256  # sp=4 -> 64-byte chunks (= the bank's max footprint)
+        cases = [
+            b"p" * 40 + b"x" * 40,            # literal across cut at 64
+            b"p" * 100 + b"x" * 40,           # across cut at 128
+            b"z" * 40 + b"<svg " + b"a" * 30 + b"onload",  # opt run across
+            b"w" * 100 + b"b" * 45,           # $-accept at len 145 (chunk 2)
+            b"w" * 211 + b"b" * 45,           # $-accept at exactly L
+            b"n" * 90 + b"x" * 39,            # near-miss (39 < 40)
+            b"p" * 63 + b"x" * 40,            # match starts 1 byte pre-cut
+            b"x" * 40,                        # entirely in chunk 0
+        ]
+        B = len(cases)
+        data = np.zeros((B, L), dtype=np.uint8)
+        lens = np.zeros(B, dtype=np.int32)
+        for i, d in enumerate(cases):
+            data[i, : len(d)] = np.frombuffer(d[:L], dtype=np.uint8)
+            lens[i] = min(len(d), L)
+        want = np.asarray(nfa_scan(tables, data, lens))
+        mesh = make_mesh(dp=2, tp=1, sp=4)
+        from pingoo_tpu.parallel import halo_nfa_scan
+
+        data_s, lens_s = shard_batch_for_ring(mesh, data, lens)
+        got = np.asarray(halo_nfa_scan(mesh, tables, data_s, lens_s))
+        np.testing.assert_array_equal(got, want)
+
+    def test_sp_dispatch_falls_back_for_unbounded_loops(self, devices):
+        """x+ / x* banks have unbounded state memory: sp_nfa_scan must
+        use the sequential ring and still agree with the plain scan."""
+        patterns = []
+        for src in [r"ab+c", r"x[0-9]*y", r"abc"]:
+            patterns.extend(compile_regex(src))
+        tables = bank_to_tables(build_bank(patterns))
+        assert not tables.halo_ok
+
+        rng = random.Random(3)
+        L = 64
+        inputs = [b"abc", b"ab" + b"b" * 40 + b"c", b"x" + b"7" * 50 + b"y",
+                  b"xy", b"abbbc", b""]
+        alphabet = b"abcxy0123456789"
+        for _ in range(10):
+            k = rng.randint(0, L)
+            inputs.append(bytes(rng.choice(alphabet) for _ in range(k)))
+        B = len(inputs)
+        data = np.zeros((B, L), dtype=np.uint8)
+        lens = np.zeros(B, dtype=np.int32)
+        for i, d in enumerate(inputs):
+            data[i, : len(d)] = np.frombuffer(d[:L], dtype=np.uint8)
+            lens[i] = min(len(d), L)
+        want = np.asarray(nfa_scan(tables, data, lens))
+        from pingoo_tpu.parallel import sp_nfa_scan
+
+        mesh = make_mesh(dp=2, tp=1, sp=4)
+        data_s, lens_s = shard_batch_for_ring(mesh, data, lens)
+        got = np.asarray(sp_nfa_scan(mesh, tables, data_s, lens_s))
+        np.testing.assert_array_equal(got, want)
