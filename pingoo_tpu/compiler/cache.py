@@ -25,11 +25,11 @@ from ..config.schema import RuleConfig
 from ..expr.values import Ip
 from .plan import RulesetPlan, compile_ruleset
 
-FORMAT_VERSION = 4  # bump when plan/table layout changes
+FORMAT_VERSION = 5  # bump when plan/table layout changes
 
 
 def ruleset_fingerprint(rules: list[RuleConfig], lists: dict,
-                        field_specs=None) -> str:
+                        field_specs=None, routes=None) -> str:
     from .lowering import DEFAULT_FIELD_SPECS
 
     h = hashlib.sha256()
@@ -39,6 +39,10 @@ def ruleset_fingerprint(rules: list[RuleConfig], lists: dict,
         h.update(rule.name.encode())
         h.update((rule.expression.source if rule.expression else "").encode())
         h.update(",".join(a.value for a in rule.actions).encode())
+        h.update(b"\x00")
+    for name, program in routes or []:
+        h.update(b"\x02" + name.encode() + b"\x03")
+        h.update((program.source if program else "").encode())
         h.update(b"\x00")
     for name in sorted(lists):
         h.update(name.encode())
@@ -56,16 +60,17 @@ def compile_ruleset_cached(
     lists: dict,
     cache_dir: Optional[str] = None,
     field_specs=None,
+    routes=None,
 ) -> RulesetPlan:
     """compile_ruleset with a transparent on-disk artifact cache."""
     if cache_dir is None:
-        return compile_ruleset(rules, lists, field_specs)
-    fingerprint = ruleset_fingerprint(rules, lists, field_specs)
+        return compile_ruleset(rules, lists, field_specs, routes=routes)
+    fingerprint = ruleset_fingerprint(rules, lists, field_specs, routes=routes)
     path = os.path.join(cache_dir, f"ruleset-{fingerprint[:32]}.plan")
     plan = _load(path, fingerprint)
     if plan is not None:
         return plan
-    plan = compile_ruleset(rules, lists, field_specs)
+    plan = compile_ruleset(rules, lists, field_specs, routes=routes)
     _save(path, fingerprint, plan)
     return plan
 

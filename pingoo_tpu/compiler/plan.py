@@ -82,6 +82,8 @@ class RulesetPlan:
     # static (host-side numpy) table constructors' outputs:
     np_tables: dict[str, Any] = dc_field(default_factory=dict)
     stats: dict[str, int] = dc_field(default_factory=dict)
+    # service name -> pseudo-rule column for its route predicate
+    route_index: dict[str, int] = dc_field(default_factory=dict)
 
     def device_tables(self) -> dict[str, Any]:
         """Materialize all tables as device arrays (a pytree)."""
@@ -110,45 +112,64 @@ def compile_ruleset(
     rules: list[RuleConfig],
     lists: dict[str, list],
     field_specs: Optional[dict[str, int]] = None,
+    routes: Optional[list[tuple[str, Optional[Program]]]] = None,
 ) -> RulesetPlan:
+    """Compile WAF rules (+ optional service `route:` predicates) into
+    one plan. Routes become extra actionless pseudo-rule columns of the
+    SAME batched verdict — route semantics are exactly rule semantics
+    (exact-true match, error -> no-match, no expression -> match-all;
+    reference services/mod.rs match_request + http_proxy_service.rs:
+    84-95), so the per-request route interpretation on the listener hot
+    path collapses into the batch. `plan.route_index[name]` gives each
+    service's column in the match matrix."""
     field_specs = dict(field_specs or DEFAULT_FIELD_SPECS)
     registry = LeafRegistry()
     lowerer = Lowerer(lists, registry, field_specs)
 
-    planned: list[PlannedRule] = []
-    for idx, rule in enumerate(rules):
-        if rule.expression is None:
+    def lower_one(name: str, actions, idx: int,
+                  program: Optional[Program]) -> PlannedRule:
+        if program is None:
             # No expression -> always matches (pingoo/rules.rs:48-50).
-            planned.append(
-                PlannedRule(name=rule.name, actions=rule.actions, index=idx,
-                            ir=None, program=None, host=False, always=True)
-            )
-            continue
+            return PlannedRule(name=name, actions=actions, index=idx,
+                               ir=None, program=None, host=False, always=True)
         mark = registry.mark()
         try:
-            ir = lowerer.lower_rule(rule.expression.root)
-            planned.append(
-                PlannedRule(name=rule.name, actions=rule.actions, index=idx,
-                            ir=ir, program=rule.expression, host=False)
-            )
+            ir = lowerer.lower_rule(program.root)
+            return PlannedRule(name=name, actions=actions, index=idx,
+                               ir=ir, program=program, host=False)
         except LowerError:
             registry.rollback(mark)  # don't ship a host rule's partial leaves
-            planned.append(
-                PlannedRule(name=rule.name, actions=rule.actions, index=idx,
-                            ir=None, program=rule.expression, host=True)
-            )
+            return PlannedRule(name=name, actions=actions, index=idx,
+                               ir=None, program=program, host=True)
+
+    planned: list[PlannedRule] = []
+    for idx, rule in enumerate(rules):
+        planned.append(lower_one(rule.name, rule.actions, idx,
+                                 rule.expression))
+    route_index: dict[str, int] = {}
+    for name, program in routes or []:
+        idx = len(planned)
+        route_index[name] = idx
+        planned.append(lower_one(f"route:{name}", (), idx, program))
 
     plan = RulesetPlan(
         field_specs=field_specs,
         rules=planned,
         leaves=registry.leaves,
         bindings={},
+        route_index=route_index,
     )
     _assemble_tables(plan)
+    # Stats count REAL rules only — route pseudo-columns get their own
+    # counters so bench/metrics numbers don't inflate with services.
+    real = planned[: len(rules)]
+    pseudo = planned[len(rules):]
     plan.stats = {
-        "rules": len(planned),
-        "device_rules": sum(1 for r in planned if not r.host),
-        "host_rules": sum(1 for r in planned if r.host),
+        "rules": len(real),
+        "device_rules": sum(1 for r in real if not r.host),
+        "host_rules": sum(1 for r in real if r.host),
+        "routes": len(pseudo),
+        "host_routes": sum(1 for r in pseudo if r.host),
         "leaves": len(registry.leaves),
     }
     return plan

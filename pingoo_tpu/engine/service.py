@@ -71,6 +71,11 @@ class Verdict:
     # actions for captcha-verified clients but still blocks on any
     # matched rule carrying Block (http_listener.rs:251-264).
     verified_block: bool = False
+    # True when the engine failed and this verdict is the fail-open
+    # placeholder: `matched` is all-False garbage, so consumers that
+    # read non-action columns (service routing) must fall back to
+    # interpretation instead of trusting it.
+    degraded: bool = False
 
     @property
     def block(self) -> bool:
@@ -209,7 +214,8 @@ class VerdictService:
                 for _, fut in pending:
                     if not fut.done():
                         fut.set_result(Verdict(
-                            action=0, matched=np.zeros(R, dtype=bool)))
+                            action=0, matched=np.zeros(R, dtype=bool),
+                            degraded=True))
 
     async def _run_batch(self, pending: list) -> None:
         reqs = [r for r, _ in pending]

@@ -157,6 +157,7 @@ class HttpListener:
         tls_context=None,
         acme_challenges: Optional[dict] = None,
         trust_xff: bool = False,
+        route_indices: Optional[list] = None,
     ):
         self.name = name
         self.host = host
@@ -174,6 +175,10 @@ class HttpListener:
         # id must bind to the REAL client address, not the proxy's.
         # Only enable behind a trusted front — XFF is client-forgeable.
         self.trust_xff = trust_xff
+        # Per-service columns of the batched verdict carrying the route
+        # predicates (plan.route_index); None entries (or no list) fall
+        # back to per-request interpretation of service.route.
+        self.route_indices = route_indices
         self.stats = ListenerStats()
         self._server: Optional[asyncio.AbstractServer] = None
 
@@ -379,10 +384,25 @@ class HttpListener:
         if action == 2:
             return self._serve_captcha()
 
-        # ROUTING LOOP (:266-270).
-        route_ctx = request_tuple_to_context(tup, self.lists)
-        for service in self.services:
-            if match_route(service.route, route_ctx):
+        # ROUTING LOOP (:266-270): route predicates ride the SAME
+        # batched verdict as the rules (plan route pseudo-columns) —
+        # no per-request tree-walk on the hot path. Services without a
+        # compiled column interpret their route inline (same semantics).
+        route_ctx = None
+        for j, service in enumerate(self.services):
+            idx = (self.route_indices[j]
+                   if self.route_indices and j < len(self.route_indices)
+                   else None)
+            if idx is not None and not verdict.degraded:
+                routed = bool(verdict.matched[idx])
+            else:
+                # No compiled column, or the engine failed and matched
+                # is a fail-open placeholder: interpret the route so a
+                # broken engine degrades to slow routing, not to 404s.
+                if route_ctx is None:
+                    route_ctx = request_tuple_to_context(tup, self.lists)
+                routed = match_route(service.route, route_ctx)
+            if routed:
                 return await service.handle(req, request_ctx)
         return not_found_response()
 

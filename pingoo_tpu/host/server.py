@@ -76,8 +76,12 @@ class Server:
         use_device = self.use_device and ensure_jax_backend()
         from ..compiler.cache import compile_ruleset_cached
 
+        # Service route predicates compile into the same plan as extra
+        # verdict columns (rules AND routing decided by one batch).
+        routes = [(s.name, s.route) for s in config.services]
         plan = compile_ruleset_cached(
-            list(config.rules), lists, cache_dir=self.cache_dir)
+            list(config.rules), lists, cache_dir=self.cache_dir,
+            routes=routes)
         bot_params = None
         if self.bot_score_params_path:
             from ..models.botscore import load_params
@@ -142,6 +146,12 @@ class Server:
                                  if listener_cfg.protocol.is_tls else None),
                     acme_challenges=acme_challenges,
                     trust_xff=trust_xff,
+                    # Columns are looked up by the BUILT services' names:
+                    # build_http_services may drop non-http entries, so a
+                    # positional zip against the config list could hand a
+                    # service another service's route column.
+                    route_indices=[plan.route_index.get(s.name)
+                                   for s in http_services],
                 )
                 await listener.bind()
                 self.http_listeners.append(listener)

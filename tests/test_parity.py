@@ -402,3 +402,55 @@ class TestLaneReductionParity:
         np.testing.assert_array_equal(want_unv, got_unv)
         np.testing.assert_array_equal(want_vblk, got_vblk)
         assert (got_unv == 1).any()  # corpus actually blocks something
+
+
+class TestRoutePseudoRules:
+    def test_route_columns_match_interpreter(self):
+        """Service route predicates compiled as verdict pseudo-columns
+        must agree with per-request match_route interpretation —
+        including a host-fallback route and a route-less service."""
+        from pingoo_tpu.host.services import match_route
+
+        sources = RULE_SOURCES[:6]
+        rules = make_rules(sources)
+        routes = [
+            ("api", compile_expression(
+                'http_request.path.starts_with("/api")')),
+            ("geo", compile_expression(
+                'client.country == "RU" && http_request.method == "GET"')),
+            ("hostfb", compile_expression(
+                'http_request.host + "" == "example.com"')),  # host-eval
+            ("errroute", compile_expression(
+                'lists["missing"].contains(client.ip)')),  # error -> false
+            ("all", None),  # no route -> match everything
+        ]
+        plan = compile_ruleset(rules, LISTS, routes=routes)
+        assert set(plan.route_index) == {"api", "geo", "hostfb", "errroute",
+                                         "all"}
+        rng = random.Random(77)
+        reqs = random_requests(rng, 48)
+        batch = encode_requests(reqs)
+        matched = evaluate_batch(plan, make_verdict_fn(plan),
+                                 plan.device_tables(), batch, LISTS)
+        contexts = batch_to_contexts(batch, LISTS)
+        for name, program in routes:
+            col = plan.route_index[name]
+            for i, ctx in enumerate(contexts):
+                want = match_route(program, ctx)
+                assert bool(matched[i, col]) == want, (name, i, reqs[i])
+
+    def test_route_pseudo_rules_never_act(self):
+        """Actionless route columns must not leak into action lanes."""
+        from pingoo_tpu.engine.verdict import action_lanes
+
+        rules = make_rules(['http_request.path == "/blocked"'])
+        routes = [("all", None)]  # matches EVERY request
+        plan = compile_ruleset(rules, LISTS, routes=routes)
+        batch = encode_requests([RequestTuple(path="/blocked"),
+                                 RequestTuple(path="/ok")])
+        matched = evaluate_batch(plan, make_verdict_fn(plan),
+                                 plan.device_tables(), batch, LISTS)
+        unverified, verified_block = action_lanes(plan, matched)
+        assert unverified.tolist() == [1, 0]
+        assert verified_block.tolist() == [True, False]
+        assert matched[:, plan.route_index["all"]].all()
