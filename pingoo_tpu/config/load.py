@@ -245,7 +245,9 @@ def parse_upstream(text: str) -> Upstream:
     """Parse an upstream URL (reference config_file.rs:280-333)."""
     parts = urlsplit(text)
     scheme = parts.scheme
-    if scheme not in ("tcp", "http", "https"):
+    # h2 = cleartext HTTP/2 prior knowledge (the reference's hyper
+    # client negotiates h1/h2 instead; explicit scheme here).
+    if scheme not in ("tcp", "http", "https", "h2"):
         raise ConfigError(f"{text} is not a valid URL: {scheme or '(none)'} is not a valid protocol")
     hostname = parts.hostname or ""
     if not hostname:
@@ -259,17 +261,19 @@ def parse_upstream(text: str) -> Upstream:
     except ValueError:
         raise ConfigError(f"{text} is not a valid URL: bad port")
     if port is None:
-        port = {"http": 80, "https": 443}.get(scheme)
+        port = {"http": 80, "https": 443, "h2": 80}.get(scheme)
         if port is None:
             raise ConfigError(f"{text} is not a valid URL: port is missing")
     tls = scheme == "https"
+    h2 = scheme == "h2"
     if hostname == "localhost":
-        return Upstream(hostname=hostname, port=port, tls=tls, ip="127.0.0.1")
+        return Upstream(hostname=hostname, port=port, tls=tls,
+                        ip="127.0.0.1", h2=h2)
     try:
         ipaddress.ip_address(hostname)
     except ValueError:
-        return Upstream(hostname=hostname, port=port, tls=tls, ip=None)
-    return Upstream(hostname=hostname, port=port, tls=tls, ip=hostname)
+        return Upstream(hostname=hostname, port=port, tls=tls, ip=None, h2=h2)
+    return Upstream(hostname=hostname, port=port, tls=tls, ip=hostname, h2=h2)
 
 
 def _parse_services(raw: Mapping[str, Any]) -> dict[str, ServiceConfig]:

@@ -89,6 +89,9 @@ class Upstream:
     port: int
     tls: bool
     ip: Optional[str] = None  # None -> hostname needs DNS discovery
+    # h2:// scheme — proxy upstream over HTTP/2 prior knowledge (the
+    # reference's hyper client speaks h1/h2, http_proxy_service.rs:54-71).
+    h2: bool = False
 
 
 @dataclass(frozen=True)

@@ -131,7 +131,7 @@ class ServiceRegistry:
                     seen.add(ip)
                     resolved.append(Upstream(hostname=target.hostname,
                                              port=target.port, tls=target.tls,
-                                             ip=ip))
+                                             ip=ip, h2=target.h2))
                 self._dns_cache[cache_key] = resolved
                 ups.extend(resolved)
             if ups:

@@ -209,8 +209,33 @@ class HttpListener:
 
     async def _serve_connection(self, reader: asyncio.StreamReader,
                                 writer: asyncio.StreamWriter) -> None:
-        conn = h11.Connection(h11.SERVER)
         peer = writer.get_extra_info("peername") or ("0.0.0.0", 0)
+        # HTTP/2 detection (reference hyper auto builder,
+        # http_listener.rs:276-278): ALPN "h2" on TLS connections, the
+        # 24-byte client preface on cleartext (prior knowledge).
+        initial = b""
+        ssl_obj = writer.get_extra_info("ssl_object")
+        if ssl_obj is not None:
+            if ssl_obj.selected_alpn_protocol() == "h2":
+                await self._serve_h2(reader, writer, peer)
+                return
+        else:
+            from .h2 import H2_PREFACE, available as h2_available
+
+            if h2_available():
+                while (len(initial) < len(H2_PREFACE)
+                       and H2_PREFACE.startswith(initial)):
+                    chunk = await reader.read(len(H2_PREFACE) - len(initial))
+                    if not chunk:
+                        break
+                    initial += chunk
+                if initial == H2_PREFACE:
+                    await self._serve_h2(reader, writer, peer,
+                                         initial=initial)
+                    return
+        conn = h11.Connection(h11.SERVER)
+        if initial:
+            conn.receive_data(initial)
         try:
             while True:
                 event = await self._next_event(conn, reader)
@@ -295,6 +320,105 @@ class HttpListener:
             writer.write(conn.send(h11.Data(data=body)))
         writer.write(conn.send(h11.EndOfMessage()))
         await writer.drain()
+
+    # -- HTTP/2 connection loop ---------------------------------------------
+
+    async def _serve_h2(self, reader, writer, peer, initial=b"") -> None:
+        """Serve one h2 connection: every stream's request runs through
+        the SAME handle_request hot path as h1 (the reference's hyper
+        auto builder likewise multiplexes into one service_fn). Streams
+        are handled CONCURRENTLY — one slow upstream must not stall the
+        other multiplexed streams or frame processing — with writes
+        serialized through a lock."""
+        from .h2 import H2ServerSession
+
+        write_lock = asyncio.Lock()
+        tasks: set = set()
+
+        async def flush():
+            out = session.pull()
+            if out:
+                async with write_lock:
+                    writer.write(out)
+                    await writer.drain()
+
+        async def handle_stream(sid, hdrs, body):
+            req = self._h2_to_request(hdrs, body)
+            if req is None:
+                session.submit_response(sid, 400,
+                                        [("content-type", "text/plain")],
+                                        b"Bad Request")
+                await flush()
+                return
+            response = await self.handle_request(req, peer)
+            body_out = response.body
+            content_length = None
+            if response.stream_path is not None:
+                if req.method == "HEAD":
+                    # Advertise the real entity size without reading it.
+                    body_out = b""
+                    content_length = os.path.getsize(response.stream_path)
+                else:
+                    # h2 responses are submitted whole; large static
+                    # files load here (streamed DATA frames are a
+                    # future refinement).
+                    with open(response.stream_path, "rb") as f:
+                        body_out = f.read()
+            elif req.method == "HEAD":
+                content_length = len(response.body)
+                body_out = b""
+            session.submit_response(sid, response.status, response.headers,
+                                    body_out, content_length=content_length)
+            await flush()
+
+        def on_request(sid, hdrs, body):
+            task = asyncio.ensure_future(handle_stream(sid, hdrs, body))
+            tasks.add(task)
+            task.add_done_callback(tasks.discard)
+
+        session = H2ServerSession(on_request)
+        try:
+            if initial and not session.feed(initial):
+                return
+            while True:
+                await flush()
+                data = await reader.read(65536)
+                if not data or not session.feed(data):
+                    break
+        except (OSError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            for task in list(tasks):
+                task.cancel()
+            try:
+                await flush()
+            except OSError:
+                pass
+            session.close()
+            try:
+                writer.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _h2_to_request(hdrs: list, body: bytes) -> Optional[Request]:
+        """h2 pseudo-headers -> the Request shape the h1 path uses; the
+        :authority travels as a host header (get_host reads it like
+        hyper's uri.host for h2, http_listener.rs:284-289)."""
+        pseudo = {k: v for k, v in hdrs if k.startswith(b":")}
+        method = pseudo.get(b":method")
+        path = pseudo.get(b":path")
+        if not method or not path:
+            return None
+        headers = [(k.decode("latin-1"), v.decode("latin-1"))
+                   for k, v in hdrs if not k.startswith(b":")]
+        authority = pseudo.get(b":authority")
+        if authority:
+            headers.insert(0, ("host", authority.decode("latin-1")))
+        target = path.decode("latin-1")
+        return Request(method=method.decode("latin-1"), target=target,
+                       path=target.split("?", 1)[0], headers=headers,
+                       body=body)
 
     # -- the hot path --------------------------------------------------------
 

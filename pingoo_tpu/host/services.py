@@ -69,6 +69,8 @@ class HttpProxyService:
         self.route = config.route
         self.registry = registry
         self._session = None
+        self._h2_conns: dict = {}  # (host, port) -> H2UpstreamConnection
+        self._h2_lock = None  # created lazily on the serving loop
 
     async def _get_session(self):
         if self._session is None:
@@ -85,6 +87,30 @@ class HttpProxyService:
         if self._session is not None:
             await self._session.close()
             self._session = None
+        for conn in self._h2_conns.values():
+            await conn.close()
+        self._h2_conns.clear()
+
+    async def _h2_conn(self, host: str, port: int):
+        """Pooled h2 prior-knowledge upstream connection (the reference's
+        hyper client pools h1/h2 alike, http_proxy_service.rs:54-71).
+        Creation is serialized per service (concurrent first requests
+        must not each open a connection and leak the losers), and a dead
+        connection is closed before its replacement goes in."""
+        from .h2 import H2UpstreamConnection
+
+        if self._h2_lock is None:
+            self._h2_lock = asyncio.Lock()
+        key = (host, port)
+        async with self._h2_lock:
+            conn = self._h2_conns.get(key)
+            if conn is None or not conn.alive:
+                if conn is not None:
+                    await conn.close()
+                conn = H2UpstreamConnection(host, port)
+                await asyncio.wait_for(conn.connect(), CONNECT_TIMEOUT_S)
+                self._h2_conns[key] = conn
+            return conn
 
     async def handle(self, req, request_ctx) -> Response:
         upstreams = self.registry.get_upstreams(self.name)
@@ -116,6 +142,27 @@ class HttpProxyService:
         if request_ctx.geoip_enabled:
             headers.append(("Pingoo-Client-Country", request_ctx.country))
             headers.append(("Pingoo-Client-Asn", str(request_ctx.asn)))
+
+        if getattr(upstream, "h2", False):
+            try:
+                conn = await self._h2_conn(target_host, upstream.port)
+                # No total timeout — the h1 path has none either (only
+                # the connect timeout); long-poll upstreams must behave
+                # identically over both protocols.
+                status, resp_headers, body = await conn.request(
+                    req.method, upstream.hostname, req.target, headers,
+                    req.body or b"")
+                out_headers = [
+                    (n, v) for n, v in resp_headers
+                    if n.lower() not in HOP_BY_HOP_HEADERS
+                    and n.lower() not in RESPONSE_STRIP_HEADERS
+                    and n.lower() != "content-length"
+                ]
+                out_headers.append(("server", "pingoo"))
+                return Response(status, out_headers, body)
+            except Exception:
+                return Response(502, [("content-type", "text/plain"),
+                                      ("server", "pingoo")], b"Bad Gateway")
 
         try:
             session = await self._get_session()

@@ -81,6 +81,16 @@ def _make_context(cert_path: str, key_path: str) -> ssl.SSLContext:
     ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
     ctx.minimum_version = ssl.TLSVersion.TLSv1_3  # TLS 1.3-only
     ctx.load_cert_chain(cert_path, key_path)
+    # Advertise h2 + http/1.1 like the reference's hyper auto builder
+    # (http_listener.rs:276-278); the listener dispatches on the
+    # negotiated protocol. Skipped when libnghttp2 is absent.
+    try:
+        from .h2 import available as h2_available
+
+        ctx.set_alpn_protocols(
+            ["h2", "http/1.1"] if h2_available() else ["http/1.1"])
+    except (ImportError, NotImplementedError):
+        pass
     return ctx
 
 
