@@ -353,9 +353,24 @@ class _BankBuilder:
     # -- single-word path (first-fit sharing, the common case) ---------------
 
     def pack_shared(self, subs: list[_ScanPattern], need: int) -> PatternSlot:
+        # First-fit over shared words AND the free tails of dedicated
+        # span words: a span's final word rarely ends at bit 31, and the
+        # tail bits above it are safe to share — the guard bit absorbs
+        # the shift out of the span's top position, and any escape out
+        # of the tail's bit 31 only lands where carry is enabled, which
+        # the word AFTER a span's last word never is. The load-bearing
+        # invariant: a non-final span word is always exactly full
+        # (pack_span's place() only opens a new word at used == 32), so
+        # any dedicated word with free bits IS its span's last word —
+        # asserted below so a packing refactor that breaks it fails
+        # loudly instead of corrupting shared patterns.
         w = -1
         for idx, used in enumerate(self.used):
-            if not self.dedicated[idx] and used + need <= WORD_BITS:
+            if used + need <= WORD_BITS:
+                if self.dedicated[idx]:
+                    assert not (idx + 1 < len(self.carry)
+                                and self.carry[idx + 1]), \
+                        "tail-sharing a non-final span word"
                 w = idx
                 break
         if w == -1:
