@@ -222,6 +222,10 @@ class NfaBank:
     prop_passes: int = 1
     # Largest single-pattern footprint in bits (>= its byte memory).
     max_footprint: int = 0
+    # Per-word: True for words allocated to a multi-word span (single-
+    # word patterns may still share a span's LAST word's free tail).
+    dedicated: np.ndarray = field(
+        default_factory=lambda: np.zeros(0, dtype=bool))
     slots: list[PatternSlot] = field(default_factory=list)
 
     @property
@@ -539,6 +543,7 @@ def build_bank(patterns: list[LinearPattern]) -> NfaBank:
     bank.sticky_mask = np.array(builder.sticky, dtype=np.uint32)
     bank.prop_passes = builder.max_passes
     bank.max_footprint = builder.max_footprint
+    bank.dedicated = np.array(builder.dedicated, dtype=bool)
     return bank
 
 

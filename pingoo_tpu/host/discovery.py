@@ -21,13 +21,27 @@ from __future__ import annotations
 import asyncio
 import json
 import socket
+import time
 from typing import Iterable, Optional
 
 from ..config.schema import ServiceConfig, Upstream
+from ..logging_utils import get_logger
+
+log = get_logger(__name__)
 
 REFRESH_INTERVAL_S = 2.0
 DOCKER_SERVICE_LABEL = "pingoo.service"
 DOCKER_PORT_LABEL = "pingoo.port"
+# The reference clamps resolver TTLs (dns.rs:97-105): positive answers
+# live at least 60 s (no re-resolve on every 2 s tick) and at most 2 h;
+# a failing resolver serves the last-known addresses for up to the
+# negative cap before the upstream drops.
+DNS_POSITIVE_MIN_TTL_S = 60.0
+DNS_POSITIVE_MAX_TTL_S = 7200.0
+DNS_NEGATIVE_MAX_TTL_S = 1800.0
+# Problem containers are warned about once per idle window, via a cache
+# so ids don't accumulate forever (docker.rs:20-22,39 moka time_to_idle).
+DOCKER_WARN_IDLE_S = 600.0
 
 
 class ServiceRegistry:
@@ -52,7 +66,12 @@ class ServiceRegistry:
         self.enable_docker = enable_docker
         self.enable_dns = enable_dns
         self._task: Optional[asyncio.Task] = None
-        self._dns_cache: dict[tuple, list[Upstream]] = {}
+        # (hostname, port) -> (resolved bare IPs, resolved-at timestamp).
+        # Bare IPs, NOT Upstream objects: two services may point at the
+        # same host:port with different tls/h2 flags, and each target
+        # must rebuild its own Upstreams from the shared addresses.
+        self._dns_cache: dict[tuple, tuple[list[str], float]] = {}
+        self._docker_warned: dict[str, float] = {}  # container id -> warned-at
 
     # -- reads (hot path) ----------------------------------------------------
 
@@ -101,41 +120,62 @@ class ServiceRegistry:
 
     # -- DNS -----------------------------------------------------------------
 
+    async def _getaddrinfo(self, hostname: str, port: int):
+        """Resolver seam (stubbed in tests for TTL-behavior checks)."""
+        loop = asyncio.get_running_loop()
+        return await loop.getaddrinfo(hostname, port,
+                                      type=socket.SOCK_STREAM)
+
     async def _discover_dns(self) -> dict[str, list[Upstream]]:
         if not self.enable_dns or not self._dns_targets:
             return {}
-        loop = asyncio.get_running_loop()
         out: dict[str, list[Upstream]] = {}
+        now = time.monotonic()
         for service, targets in self._dns_targets.items():
             ups: list[Upstream] = []
             for target in targets:
+                def build(ips):
+                    return [Upstream(hostname=target.hostname,
+                                     port=target.port, tls=target.tls,
+                                     ip=ip, h2=target.h2) for ip in ips]
+
                 cache_key = (target.hostname, target.port)
-                try:
-                    infos = await loop.getaddrinfo(
-                        target.hostname, target.port, type=socket.SOCK_STREAM)
-                except OSError:
-                    # Transient resolver failure: keep the last known
-                    # addresses for this hostname rather than dropping
-                    # the upstream (reference keeps last state on
-                    # discoverer failure, service_registry.rs:112-119).
-                    ups.extend(self._dns_cache.get(cache_key, []))
+                cached, resolved_at = self._dns_cache.get(
+                    cache_key, ([], -1e18))
+                age = now - resolved_at
+                if cached and age < DNS_POSITIVE_MIN_TTL_S:
+                    # Positive-TTL floor: don't hammer the resolver on
+                    # every 2 s tick (dns.rs positive_min_ttl = 60 s).
+                    ups.extend(build(cached))
                     continue
-                resolved = []
-                seen = set()
+                try:
+                    infos = await self._getaddrinfo(target.hostname,
+                                                    target.port)
+                except OSError:
+                    # Resolver failure: serve the last-known addresses up
+                    # to the negative cap (dns.rs negative_max_ttl 1800 s;
+                    # reference also keeps last state on discoverer
+                    # failure, service_registry.rs:112-119).
+                    if cached and age < DNS_NEGATIVE_MAX_TTL_S:
+                        ups.extend(build(cached))
+                    continue
+                ips: list[str] = []
                 for _family, _type, _proto, _canon, sockaddr in infos:
                     ip = sockaddr[0]
                     if ip == "::1":
                         ip = "127.0.0.1"  # dns.rs:73-75 workaround
-                    if ip in seen:
-                        continue
-                    seen.add(ip)
-                    resolved.append(Upstream(hostname=target.hostname,
-                                             port=target.port, tls=target.tls,
-                                             ip=ip, h2=target.h2))
-                self._dns_cache[cache_key] = resolved
-                ups.extend(resolved)
+                    if ip not in ips:
+                        ips.append(ip)
+                self._dns_cache[cache_key] = (ips, now)
+                ups.extend(build(ips))
             if ups:
                 out[service] = ups
+        # Positive-TTL ceiling: entries never serve past 2 h without a
+        # successful re-resolution (dns.rs positive_max_ttl = 7200 s).
+        self._dns_cache = {
+            k: v for k, v in self._dns_cache.items()
+            if now - v[1] < DNS_POSITIVE_MAX_TTL_S
+        }
         return out
 
     # -- Docker --------------------------------------------------------------
@@ -153,11 +193,14 @@ class ServiceRegistry:
             service = labels.get(DOCKER_SERVICE_LABEL)
             if not service:
                 continue
+            cid = container.get("Id", "?")
             port = None
             if DOCKER_PORT_LABEL in labels:
                 try:
                     port = int(labels[DOCKER_PORT_LABEL])
                 except ValueError:
+                    self._warn_container(
+                        cid, f"invalid {DOCKER_PORT_LABEL} label")
                     continue
             else:
                 ports = container.get("Ports") or []
@@ -166,6 +209,9 @@ class ServiceRegistry:
                 if len(private) == 1:
                     port = private[0]
             if port is None:
+                self._warn_container(
+                    cid, "no usable port (ambiguous or missing; set "
+                         f"{DOCKER_PORT_LABEL})")
                 continue
             networks = ((container.get("NetworkSettings") or {})
                         .get("Networks") or {})
@@ -175,10 +221,27 @@ class ServiceRegistry:
                     ip = net["IPAddress"]
                     break
             if not ip:
+                self._warn_container(cid, "no bridge-network IP address")
                 continue
             out.setdefault(service, []).append(
                 Upstream(hostname=ip, port=port, tls=False, ip=ip))
         return out
+
+    def _warn_container(self, cid: str, problem: str) -> None:
+        """Warn about a problem container once per idle window, with the
+        cache pruned so departed container ids don't accumulate
+        (reference docker.rs:20-22,39 warned_containers moka cache)."""
+        now = time.monotonic()
+        self._docker_warned = {
+            k: ts for k, ts in self._docker_warned.items()
+            if now - ts < DOCKER_WARN_IDLE_S
+        }
+        if cid in self._docker_warned:
+            self._docker_warned[cid] = now  # refresh the idle timer
+            return
+        self._docker_warned[cid] = now
+        log.warning(f"docker discovery: skipping container {cid[:12]}: "
+                    f"{problem}")
 
 
 async def _docker_list_containers(socket_path: str) -> list[dict]:

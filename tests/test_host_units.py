@@ -335,3 +335,68 @@ class TestOverflowRouting:
         ])
         assert batch.overflow.tolist() == [True, False]
         assert "overflow" not in batch.arrays  # never rides the pytree
+
+
+class TestDiscoveryTtlAndWarnOnce:
+    def _registry_with_dns_target(self):
+        from pingoo_tpu.config.schema import ServiceConfig, Upstream
+        from pingoo_tpu.host.discovery import ServiceRegistry
+
+        svc = ServiceConfig(
+            name="s", route=None,
+            http_proxy=(Upstream(hostname="backend.test", port=9000,
+                                 tls=False, ip=None),))
+        return ServiceRegistry([svc], enable_docker=False, enable_dns=True)
+
+    def test_dns_positive_min_ttl_suppresses_reresolve(self, loop_runner):
+        """dns.rs positive_min_ttl=60s equivalent: a fresh answer is not
+        re-resolved on every 2s tick."""
+        reg = self._registry_with_dns_target()
+        calls = {"n": 0}
+
+        async def stub(hostname, port):
+            calls["n"] += 1
+            return [(2, 1, 6, "", ("10.0.0.5", port))]
+
+        reg._getaddrinfo = stub
+        for _ in range(5):
+            loop_runner.run(reg.discover())
+        assert calls["n"] == 1  # floor: one resolution within the window
+        assert [u.ip for u in reg.get_upstreams("s")] == ["10.0.0.5"]
+
+    def test_dns_failure_serves_last_known_within_negative_ttl(
+            self, loop_runner):
+        reg = self._registry_with_dns_target()
+        state = {"fail": False}
+
+        async def stub(hostname, port):
+            if state["fail"]:
+                raise OSError("resolver down")
+            return [(2, 1, 6, "", ("10.0.0.7", port))]
+
+        reg._getaddrinfo = stub
+        loop_runner.run(reg.discover())
+        # Age the cache past the positive floor, then fail the resolver.
+        key = ("backend.test", 9000)
+        ups, ts = reg._dns_cache[key]
+        reg._dns_cache[key] = (ups, ts - 120)
+        state["fail"] = True
+        loop_runner.run(reg.discover())
+        assert [u.ip for u in reg.get_upstreams("s")] == ["10.0.0.7"]
+        # Past the negative cap the stale answer drops.
+        reg._dns_cache[key] = (ups, ts - 4000)
+        loop_runner.run(reg.discover())
+        assert reg.get_upstreams("s") == []
+
+    def test_docker_problem_container_warned_once(self, caplog):
+        import logging
+
+        from pingoo_tpu.host.discovery import ServiceRegistry
+
+        reg = ServiceRegistry([], enable_docker=True, enable_dns=False)
+        with caplog.at_level(logging.WARNING):
+            for _ in range(3):
+                reg._warn_container("abc123def456", "no usable port")
+        warnings = [r for r in caplog.records
+                    if "abc123def456"[:12] in r.getMessage()]
+        assert len(warnings) == 1  # once per idle window, not per tick

@@ -405,11 +405,19 @@ def test_span_tail_sharing_fuzz():
             spans.append((src, len(patterns), len(patterns) + len(alts)))
             patterns.extend(alts)
         bank = build_bank(patterns)
-        # Did any shared slot land inside a dedicated span word?
-        # (Detect via accepts of single-word patterns pointing at words
-        # that also carry span state — approximate by counting banks
-        # whose word count is below the no-sharing baseline.)
-        tested_shared += 1 if bank.has_carry else 0
+        # Count banks where a SMALL pattern's accept actually landed in
+        # a dedicated span word (tail sharing really happened).
+        from pingoo_tpu.compiler.nfa import scan_bits_needed
+
+        col = 0
+        shared_here = False
+        for lp in patterns:
+            n_accepts = bank.slots[col].accepts
+            if (scan_bits_needed(lp) <= 32 and len(n_accepts) == 1
+                    and bank.dedicated[n_accepts[0][0]]):
+                shared_here = True
+            col += 1
+        tested_shared += 1 if shared_here else 0
         inputs = gen_inputs(rng, n=20)
         for src in sources:
             ch = src[0]
@@ -423,4 +431,6 @@ def test_span_tail_sharing_fuzz():
             got = out[:, lo:hi].any(axis=1)
             for i, d in enumerate(inputs):
                 assert got[i] == (gold.search(d) is not None), (src, d)
-    assert tested_shared >= 50
+    # Shuffled order means sharing only occurs when a span precedes
+    # the small patterns and no earlier shared word fits them first.
+    assert tested_shared >= 10
