@@ -395,10 +395,12 @@ class H2ClientSession(_Session):
             self._session, None, arr, len(nv_list),
             ctypes.byref(provider) if body else None, None)
         del keep  # nv bytes were copied by nghttp2 during the call
-        if stream_id > 0 and body:
-            # The provider struct itself is copied at submit time; the
-            # body bytes are served later through _data_read from the
-            # stream entry, so only that needs to stay alive.
+        if stream_id > 0:
+            # ALWAYS materialize the stream entry — a server can
+            # RST_STREAM before any response headers arrive, and
+            # _on_stream_close only surfaces the failure for tracked
+            # streams. (The provider struct is copied at submit time;
+            # body bytes are served later through _data_read.)
             st = self._stream(stream_id)
             st.send_body = body
             st.send_off = 0

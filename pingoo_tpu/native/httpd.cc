@@ -68,18 +68,24 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
 #include <unordered_map>
 #include <unordered_set>
+#include <utility>
 #include <vector>
 
+#include "nghttp2_shim.h"
 #include "ossl_shim.h"
 #include "pingoo_ring.h"
 
 namespace {
+
+const char kH2Preface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kH2PrefaceLen = 24;
 
 constexpr size_t kMaxHead = 32 * 1024;
 constexpr size_t kMaxBuffered = 1 << 20;  // per-direction backlog cap
@@ -452,13 +458,17 @@ struct BodyFramer {
   }
 
   // How many of data[0..len) belong to the current message. Sets done.
-  size_t consume(const char* data, size_t len) {
+  // With `payload` set, the message's PAYLOAD bytes (de-chunked — no
+  // chunk-size lines or trailers) are appended to it; the h2 bridge
+  // re-frames upstream h1 responses and must not leak h1 framing.
+  size_t consume(const char* data, size_t len, std::string* payload = nullptr) {
     if (done) return 0;
     switch (mode) {
       case kNone:
         done = true;
         return 0;
       case kUntilEof:
+        if (payload) payload->append(data, len);
         return len;  // done only at EOF (caller decides)
       case kContentLength: {
         size_t take = static_cast<size_t>(remaining) < len
@@ -466,15 +476,17 @@ struct BodyFramer {
                           : len;
         remaining -= static_cast<long long>(take);
         if (remaining == 0) done = true;
+        if (payload) payload->append(data, take);
         return take;
       }
       case kChunked:
-        return consume_chunked(data, len);
+        return consume_chunked(data, len, payload);
     }
     return 0;
   }
 
-  size_t consume_chunked(const char* data, size_t len) {
+  size_t consume_chunked(const char* data, size_t len,
+                         std::string* payload = nullptr) {
     size_t used = 0;
     while (used < len && !done) {
       char c = data[used];
@@ -517,6 +529,7 @@ struct BodyFramer {
                             ? static_cast<size_t>(remaining)
                             : (len - used);
           remaining -= static_cast<long long>(take);
+          if (payload) payload->append(data + used, take);
           used += take;
           if (remaining == 0) cstate = kDataCrlf;
           break;
@@ -556,8 +569,20 @@ struct Parsed {
   bool has_transfer_encoding = false;
   bool keep_alive = true;  // HTTP/1.1 default
   bool ok = false;
-  std::string raw_head;  // original head (without final CRLF CRLF)
+  std::string raw_head;  // original head (h1; empty for h2 streams)
+  // h2 streams carry their full header list here instead of raw_head.
+  std::vector<std::pair<std::string, std::string>> h2_headers;
 };
+
+// One multiplexed HTTP/2 request in flight on a connection.
+struct H2Stream {
+  Parsed p;
+  std::string body;
+  bool complete = false;
+};
+
+std::string strip_host_port(const std::string& value);
+std::string extract_verified_cookie(const std::string& value);
 
 // Parse a request head (request line + headers).
 Parsed parse_head(const std::string& head) {
@@ -590,17 +615,7 @@ Parsed parse_head(const std::string& head) {
       std::string name = lower(head.substr(pos, colon - pos));
       std::string value = trim(head.substr(colon + 1, eol - colon - 1));
       if (name == "host") {
-        size_t port_colon = value.rfind(':');
-        // bracketed IPv6 hosts keep their brackets, strip only a port
-        if (value.size() && value[0] == '[') {
-          size_t close = value.find(']');
-          p.host = close == std::string::npos ? value
-                                              : value.substr(0, close + 1);
-        } else {
-          p.host = port_colon == std::string::npos
-                       ? value
-                       : value.substr(0, port_colon);
-        }
+        p.host = strip_host_port(value);
       } else if (name == "user-agent") {
         p.user_agent = value;
       } else if (name == "content-length") {
@@ -627,21 +642,7 @@ Parsed parse_head(const std::string& head) {
         if (v.find("close") != std::string::npos) p.keep_alive = false;
         if (v.find("keep-alive") != std::string::npos) p.keep_alive = true;
       } else if (name == "cookie" && p.verified_cookie.empty()) {
-        // find __pingoo_captcha_verified=...
-        size_t cp = 0;
-        while (cp < value.size()) {
-          size_t semi = value.find(';', cp);
-          std::string part = trim(value.substr(
-              cp, semi == std::string::npos ? std::string::npos : semi - cp));
-          size_t eq = part.find('=');
-          if (eq != std::string::npos &&
-              part.substr(0, eq) == "__pingoo_captcha_verified") {
-            p.verified_cookie = part.substr(eq + 1);
-            break;
-          }
-          if (semi == std::string::npos) break;
-          cp = semi + 1;
-        }
+        p.verified_cookie = extract_verified_cookie(value);
       }
     }
     pos = eol + 2;
@@ -649,6 +650,53 @@ Parsed parse_head(const std::string& head) {
   p.raw_head = head;
   p.ok = true;
   return p;
+}
+
+// "name: value" lines of an h1 head (after the start line) -> pairs.
+void parse_header_lines(
+    const std::string& head,
+    std::vector<std::pair<std::string, std::string>>* out) {
+  size_t le = head.find("\r\n");
+  size_t pos = le == std::string::npos ? head.size() : le + 2;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos || eol == pos) break;
+    size_t colon = head.find(':', pos);
+    if (colon != std::string::npos && colon < eol) {
+      out->emplace_back(head.substr(pos, colon - pos),
+                        trim(head.substr(colon + 1, eol - colon - 1)));
+    }
+    pos = eol + 2;
+  }
+}
+
+// Strip a :port (IPv6-bracket aware) — the shared host normalization
+// for h1 Host headers and h2 :authority (get_host semantics).
+std::string strip_host_port(const std::string& value) {
+  if (!value.empty() && value[0] == '[') {
+    size_t close = value.find(']');
+    return close == std::string::npos ? value : value.substr(0, close + 1);
+  }
+  size_t port_colon = value.rfind(':');
+  return port_colon == std::string::npos ? value
+                                         : value.substr(0, port_colon);
+}
+
+// Extract __pingoo_captcha_verified from a Cookie header value.
+std::string extract_verified_cookie(const std::string& value) {
+  size_t cp = 0;
+  while (cp < value.size()) {
+    size_t semi = value.find(';', cp);
+    std::string part = trim(value.substr(
+        cp, semi == std::string::npos ? std::string::npos : semi - cp));
+    size_t eq = part.find('=');
+    if (eq != std::string::npos &&
+        part.substr(0, eq) == "__pingoo_captcha_verified")
+      return part.substr(eq + 1);
+    if (semi == std::string::npos) break;
+    cp = semi + 1;
+  }
+  return "";
 }
 
 bool is_hop_header(const std::string& lname) {
@@ -783,6 +831,7 @@ enum class ConnState {
   kReadingHead,
   kAwaitingVerdict,
   kProxying,
+  kH2,       // HTTP/2 connection (nghttp2 session owns framing)
   kClosing,  // drain outbuf, then close
 };
 
@@ -829,7 +878,24 @@ struct Conn {
   time_t last_active = 0;
   SockRef client_ref;
   SockRef upstream_ref;
+
+  // -- HTTP/2 mode (state == kH2) --
+  nghttp2_session* h2 = nullptr;
+  std::unordered_map<int32_t, H2Stream> h2_streams;
+  std::vector<int32_t> h2_ready;   // completed requests awaiting service
+  int32_t h2_active = 0;           // stream currently verdicting/proxying
+  // Per-stream response bodies served through the data provider (a
+  // client flow-control stall can defer DATA past the next stream).
+  std::unordered_map<int32_t, std::pair<std::string, size_t>> h2_send;
+  std::string h2_resp_head;        // upstream h1 response head (collect)
+  std::string h2_resp_body;        // de-framed upstream response payload
+  int h2_resp_status = 502;        // parsed once at head completion
+  std::vector<std::pair<std::string, std::string>> h2_resp_hdrs;
+  time_t verdict_at = 0;           // when the active ticket was enqueued
 };
+
+class Server;
+Server* g_server = nullptr;
 
 const char k403[] =
     "HTTP/1.1 403 Forbidden\r\nserver: pingoo\r\n"
@@ -904,6 +970,10 @@ class Server {
 
   void flush_doomed() {
     for (Conn* c : doomed_) {
+      if (c->h2 != nullptr) {
+        nghttp2_session_del(c->h2);
+        c->h2 = nullptr;
+      }
       if (c->ssl) {
         SSL_shutdown(c->ssl);
         ssl_conn_.erase(c->ssl);
@@ -939,14 +1009,22 @@ class Server {
           // A stalled/crashed sidecar must not leak connections: fail
           // OPEN like the ring-full path (pingoo/rules.rs:41-44).
           if (idle > kVerdictTimeoutS) {
-            if (c->ticket != UINT64_MAX) {
-              awaiting_.erase(c->ticket);
-              c->ticket = UINT64_MAX;
-            }
+            drop_ticket(c);
             start_proxy(c, upstream_);
           }
           break;
         case ConnState::kProxying:
+          if (idle > kProxyIdleTimeoutS) mark_close(c);
+          break;
+        case ConnState::kH2:
+          // A stream stuck awaiting a verdict fails open on its own
+          // timer (frame activity keeps last_active fresh, so the
+          // ticket gets a dedicated timestamp).
+          if (c->ticket != UINT64_MAX &&
+              now_ - c->verdict_at > kVerdictTimeoutS) {
+            drop_ticket(c);
+            start_proxy(c, upstream_);
+          }
           if (idle > kProxyIdleTimeoutS) mark_close(c);
           break;
       }
@@ -1025,6 +1103,11 @@ class Server {
         // read side at EOF / at the buffered cap.
         if (!c->client_eof && c->inbuf.size() < kMaxBuffered) ev = EPOLLIN;
         break;
+      case ConnState::kH2:
+        // Frame ingest continues while a stream verdicts/proxies (other
+        // streams keep multiplexing in).
+        if (!c->client_eof) ev = EPOLLIN;
+        break;
       case ConnState::kClosing:
         ev = 0;
         break;
@@ -1041,7 +1124,9 @@ class Server {
     uint32_t ev = 0;
     // Same level-trigger discipline: stop reading an EOF'd upstream and
     // pause reads while the client-side buffer is at its cap.
-    if (!c->upstream_eof && c->outbuf.size() < kMaxBuffered) ev = EPOLLIN;
+    if (!c->upstream_eof && c->outbuf.size() < kMaxBuffered &&
+        c->h2_resp_body.size() <= kMaxBuffered)
+      ev = EPOLLIN;
     if (!c->upbuf.empty() || !c->upstream_connected) ev |= EPOLLOUT;
     epoll_event e{};
     e.events = ev;
@@ -1074,6 +1159,37 @@ class Server {
     c->upstream_eof = false;
   }
 
+  // Protocol-appropriate 502 (canned close for h1, stream response +
+  // next-stream processing for h2). Tears the failed upstream down
+  // FIRST: h2_finish_stream may immediately start the next stream's
+  // proxy, which must not race an fd still registered in epoll.
+  void respond_502(Conn* c) {
+    close_upstream(c);
+    if (c->state == ConnState::kH2) {
+      c->h2_resp_head.clear();
+      c->h2_resp_body.clear();
+      c->resp_head_done = false;
+      h2_respond_simple(c, c->h2_active, 502, "Bad Gateway");
+      h2_flush(c);
+    } else {
+      respond_close(c, k502);
+    }
+  }
+
+  // Abort the active h2 stream without fabricating a response (e.g. a
+  // truncated upstream body must NOT become a well-formed short 200).
+  void h2_abort_active(Conn* c) {
+    close_upstream(c);
+    c->h2_resp_head.clear();
+    c->h2_resp_body.clear();
+    c->resp_head_done = false;
+    if (c->h2_active != 0)
+      nghttp2_submit_rst_stream(c->h2, 0, c->h2_active,
+                                NGHTTP2_INTERNAL_ERROR);
+    h2_finish_stream(c);
+    h2_flush(c);
+  }
+
   void start_proxy(Conn* c, const sockaddr_in& target) {
     int ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     if (ufd < 0 ||
@@ -1081,19 +1197,28 @@ class Server {
                  sizeof(target)) != 0 &&
          errno != EINPROGRESS)) {
       if (ufd >= 0) close(ufd);
-      respond_close(c, k502);
+      respond_502(c);
       return;
     }
     c->upstream_fd = ufd;
-    c->state = ConnState::kProxying;
     c->resp_head_buf.clear();
     c->resp_head_done = false;
     c->upstream_eof = false;
     c->last_active = now_;
 
-    // Rewritten head + whatever request-body bytes are already buffered.
-    c->upbuf = rewrite_request_head(c->req, c->peer_ip, c->ssl != nullptr);
-    pump_request_body(c);
+    if (c->state == ConnState::kH2) {
+      // h2 stream: state stays kH2; the synthesized head embeds the
+      // whole buffered request body.
+      c->upbuf = h2_upstream_head(c);
+      c->req_body_forwarded = true;
+      c->h2_resp_head.clear();
+      c->h2_resp_body.clear();
+    } else {
+      c->state = ConnState::kProxying;
+      // Rewritten head + whatever request-body bytes are buffered.
+      c->upbuf = rewrite_request_head(c->req, c->peer_ip, c->ssl != nullptr);
+      pump_request_body(c);
+    }
 
     epoll_event ue{};
     ue.events = EPOLLOUT | EPOLLIN;
@@ -1137,21 +1262,30 @@ class Server {
   // Verdict byte: bits 0-1 unverified action, bit 2 verified-block
   // (native_ring.py RingSidecar) — the reference loop skips Captcha
   // actions for verified clients but still blocks on Block
-  // (http_listener.rs:251-264).
+  // (http_listener.rs:251-264). Applies to the h1 cycle or the h2
+  // connection's active stream.
   void apply_verdict(Conn* c, uint8_t action) {
+    bool h2 = c->state == ConnState::kH2;
+    uint8_t decided;  // 0 proxy, 1 block, 2 captcha
     if (c->captcha_verified) {
-      if (action & 4) {
-        respond_close(c, k403);
-      } else {
-        start_proxy(c, upstream_);
-      }
-      return;
+      decided = (action & 4) ? 1 : 0;
+    } else {
+      decided = action & 3;
     }
-    uint8_t unverified = action & 3;
-    if (unverified == 1) {
-      respond_close(c, k403);
-    } else if (unverified == 2) {
-      respond_close(c, kCaptcha);
+    if (decided == 1) {
+      if (h2) {
+        h2_respond_simple(c, c->h2_active, 403, "Forbidden");
+        h2_flush(c);
+      } else {
+        respond_close(c, k403);
+      }
+    } else if (decided == 2) {
+      if (h2) {
+        h2_respond_redirect(c, c->h2_active);
+        h2_flush(c);
+      } else {
+        respond_close(c, kCaptcha);
+      }
     } else {
       start_proxy(c, upstream_);
     }
@@ -1202,7 +1336,27 @@ class Server {
 
   void try_process_head(Conn* c, bool eof) {
     if (c->state != ConnState::kReadingHead) {
-      if (eof && c->state != ConnState::kProxying) mark_close(c);
+      if (eof && c->state != ConnState::kProxying &&
+          c->state != ConnState::kH2)
+        mark_close(c);
+      return;
+    }
+    // HTTP/2 detection: every h2 client (ALPN-negotiated or cleartext
+    // prior knowledge) opens with the 24-byte preface (RFC 7540 §3.5),
+    // mirroring the reference's hyper auto h1/h2 builder.
+    size_t cmp = std::min(c->inbuf.size(), kH2PrefaceLen);
+    if (cmp > 0 && std::memcmp(c->inbuf.data(), kH2Preface, cmp) == 0) {
+      if (c->inbuf.size() < kH2PrefaceLen) {
+        if (eof) mark_close(c);
+        return;  // wait for the full preface
+      }
+      if (!start_h2(c)) {
+        mark_close(c);
+        return;
+      }
+      std::string initial;
+      initial.swap(c->inbuf);
+      h2_pump(c, initial.data(), initial.size());
       return;
     }
     size_t head_end = c->inbuf.find("\r\n\r\n");
@@ -1242,12 +1396,46 @@ class Server {
     }
     c->req_body_forwarded = c->req_body.done;
 
+    Policy outcome = run_policy(c);
+    switch (outcome) {
+      case Policy::kBlock:
+        respond_close(c, k403);
+        return;
+      case Policy::kCaptchaRedirect:
+        respond_close(c, kCaptcha);
+        return;
+      case Policy::kCaptchaUpstream:
+        start_proxy(c, captcha_upstream_);
+        return;
+      case Policy::kFailOpenProxy:
+        start_proxy(c, upstream_);
+        return;
+      case Policy::kAwaitVerdict:
+        c->state = ConnState::kAwaitingVerdict;
+        update_client_events(c);  // quiesce until the verdict arrives
+        return;
+    }
+  }
+
+  // The shared per-request WAF policy (reference hot path,
+  // http_listener.rs:196-264): UA gate, host cap, captcha-path routing,
+  // cookie verification, ring enqueue. Protocol-agnostic — the h1 cycle
+  // and the h2 stream loop both act on the returned decision. Reads
+  // c->req; sets c->captcha_verified and, for kAwaitVerdict,
+  // c->ticket + the awaiting_ map entry.
+  enum class Policy {
+    kBlock,            // 403 (UA gate or captcha upstream missing)
+    kCaptchaRedirect,  // redirect to the challenge
+    kCaptchaUpstream,  // proxy to the control plane
+    kFailOpenProxy,    // ring full: proxy without a verdict
+    kAwaitVerdict,     // enqueued; verdict callback decides
+  };
+
+  Policy run_policy(Conn* c) {
     // Empty or oversized UA -> 403 before the ring. The >= is the
     // reference's own explicit check (http_listener.rs:196).
-    if (p.user_agent.empty() || p.user_agent.size() >= 256) {
-      respond_close(c, k403);
-      return;
-    }
+    if (c->req.user_agent.empty() || c->req.user_agent.size() >= 256)
+      return Policy::kBlock;
     // Over-long host becomes EMPTY, not truncated (get_host,
     // http_listener.rs:284-296).
     if (c->req.host.size() > 256) c->req.host.clear();
@@ -1256,14 +1444,9 @@ class Server {
     // they come BEFORE the cookie gate, exactly like the reference
     // (http_listener.rs:200-204 precede :222-236), or a client with a
     // stale cookie could never reach the challenge to clear it.
-    if (c->req.path.compare(0, 17, "/__pingoo/captcha") == 0) {
-      if (has_captcha_upstream_) {
-        start_proxy(c, captcha_upstream_);
-      } else {
-        respond_close(c, k403);
-      }
-      return;
-    }
+    if (c->req.path.compare(0, 17, "/__pingoo/captcha") == 0)
+      return has_captcha_upstream_ ? Policy::kCaptchaUpstream
+                                   : Policy::kBlock;
 
     // Captcha-verified cookie (Ed25519 JWT against the shared JWKS).
     // An INVALID present cookie serves the challenge immediately
@@ -1271,13 +1454,13 @@ class Server {
     std::string client_id = captcha_client_id(
         c->peer_ip, c->req.user_agent, c->req.host);
     if (gate_ != nullptr) gate_->maybe_reload(now_);
+    c->captcha_verified = false;
     if (!c->req.verified_cookie.empty() && gate_ != nullptr &&
         gate_->available()) {
       if (gate_->verify(c->req.verified_cookie, client_id, now_)) {
         c->captcha_verified = true;
       } else {
-        respond_close(c, kCaptcha);
-        return;
+        return Policy::kCaptchaRedirect;
       }
     }
 
@@ -1294,13 +1477,338 @@ class Server {
     if (ticket == UINT64_MAX) {
       // Verdict ring full (sidecar stalled): FAIL OPEN — proxy without
       // a verdict (pingoo/rules.rs:41-44).
-      start_proxy(c, upstream_);
-      return;
+      return Policy::kFailOpenProxy;
     }
     c->ticket = ticket;
-    c->state = ConnState::kAwaitingVerdict;
+    c->verdict_at = now_;
     awaiting_[ticket] = c;
-    update_client_events(c);  // quiesce until the verdict arrives
+    return Policy::kAwaitVerdict;
+  }
+
+  // -- HTTP/2 mode -----------------------------------------------------------
+  //
+  // nghttp2 owns framing/HPACK/flow control; requests surface through
+  // the callbacks below and run the SAME run_policy/ring/proxy path as
+  // h1. Streams are serviced one at a time per connection (frame
+  // ingest keeps multiplexing; service is sequential — the Python
+  // plane's h2 listener handles streams concurrently).
+
+  bool start_h2(Conn* c) {
+    nghttp2_session_callbacks* cbs = nullptr;
+    if (nghttp2_session_callbacks_new(&cbs) != 0) return false;
+    nghttp2_session_callbacks_set_on_header_callback(cbs, h2_on_header);
+    nghttp2_session_callbacks_set_on_frame_recv_callback(cbs,
+                                                         h2_on_frame_recv);
+    nghttp2_session_callbacks_set_on_data_chunk_recv_callback(
+        cbs, h2_on_data_chunk);
+    nghttp2_session_callbacks_set_on_stream_close_callback(
+        cbs, h2_on_stream_close);
+    int rv = nghttp2_session_server_new(&c->h2, cbs, c);
+    nghttp2_session_callbacks_del(cbs);
+    if (rv != 0) return false;
+    // Bound per-connection stream state: without this SETTINGS entry
+    // RFC 7540 defaults to UNLIMITED concurrent streams — one client
+    // could park thousands of buffered requests (the h1 plane's
+    // kMaxHead/kMaxRequestsPerConn caps would be bypassed).
+    nghttp2_settings_entry iv[] = {
+        {NGHTTP2_SETTINGS_MAX_CONCURRENT_STREAMS, 128}};
+    nghttp2_submit_settings(c->h2, 0, iv, 1);
+    c->state = ConnState::kH2;
+    return true;
+  }
+
+  // Feed bytes to the session, service ready streams, flush output.
+  void h2_pump(Conn* c, const char* data, size_t len) {
+    if (len > 0) {
+      ssize_t n = nghttp2_session_mem_recv(
+          c->h2, reinterpret_cast<const uint8_t*>(data), len);
+      if (n < 0 || static_cast<size_t>(n) != len) {
+        mark_close(c);
+        return;
+      }
+    }
+    h2_process_next(c);
+    h2_flush(c);
+    if (!c->dead && !nghttp2_session_want_read(c->h2) &&
+        !nghttp2_session_want_write(c->h2))
+      mark_close(c);  // session finished (GOAWAY processed)
+  }
+
+  void h2_flush(Conn* c) {
+    for (;;) {
+      const uint8_t* out = nullptr;
+      ssize_t n = nghttp2_session_mem_send(c->h2, &out);
+      if (n <= 0) break;
+      c->outbuf.append(reinterpret_cast<const char*>(out),
+                       static_cast<size_t>(n));
+    }
+    if (!flush_out(c)) {
+      mark_close(c);
+      return;
+    }
+    update_client_events(c);
+  }
+
+  void h2_process_next(Conn* c) {
+    while (c->h2_active == 0 && !c->h2_ready.empty()) {
+      int32_t sid = c->h2_ready.front();
+      c->h2_ready.erase(c->h2_ready.begin());
+      auto it = c->h2_streams.find(sid);
+      if (it == c->h2_streams.end()) continue;  // reset meanwhile
+      c->h2_active = sid;
+      c->req = it->second.p;
+      Policy outcome = run_policy(c);
+      switch (outcome) {
+        case Policy::kBlock:
+          h2_respond_simple(c, sid, 403, "Forbidden");
+          break;
+        case Policy::kCaptchaRedirect:
+          h2_respond_redirect(c, sid);
+          break;
+        case Policy::kCaptchaUpstream:
+          start_proxy(c, captcha_upstream_);
+          return;  // one stream in flight
+        case Policy::kFailOpenProxy:
+          start_proxy(c, upstream_);
+          return;
+        case Policy::kAwaitVerdict:
+          return;  // verdict callback resumes this stream
+      }
+    }
+  }
+
+  void h2_finish_stream(Conn* c) {
+    c->h2_active = 0;
+    h2_process_next(c);
+  }
+
+  void h2_submit(Conn* c, int32_t sid, int status,
+                 const std::vector<std::pair<std::string, std::string>>&
+                     headers,
+                 std::string body) {
+    std::string status_s = std::to_string(status);
+    std::vector<nghttp2_nv> nva;
+    std::vector<std::string> keep;  // backing storage for nv pointers
+    auto push = [&](const std::string& n, const std::string& v) {
+      keep.push_back(n);
+      const std::string& nn = keep.back();
+      keep.push_back(v);
+      const std::string& vv = keep.back();
+      nghttp2_nv nv{};
+      nv.name = reinterpret_cast<uint8_t*>(const_cast<char*>(nn.data()));
+      nv.value = reinterpret_cast<uint8_t*>(const_cast<char*>(vv.data()));
+      nv.namelen = nn.size();
+      nv.valuelen = vv.size();
+      nv.flags = NGHTTP2_NV_FLAG_NONE;
+      nva.push_back(nv);
+    };
+    // keep must not reallocate after pointers are taken
+    keep.reserve(headers.size() * 2 + 8);
+    nva.reserve(headers.size() + 4);
+    push(":status", status_s);
+    for (const auto& kv : headers) {
+      std::string lname = lower(kv.first);
+      if (is_hop_header(lname) || lname == "content-length" ||
+          lname == "transfer-encoding" || lname == "server" ||
+          lname == "alt-svc" || lname.compare(0, 8, "x-accel-") == 0)
+        continue;  // connection-specific headers are illegal in h2
+      push(lname, kv.second);
+    }
+    push("server", "pingoo");
+    push("content-length", std::to_string(body.size()));
+    c->h2_send[sid] = {std::move(body), 0};
+    nghttp2_data_provider prd{};
+    prd.read_callback = h2_data_read;
+    if (nghttp2_submit_response(c->h2, sid, nva.data(), nva.size(), &prd) !=
+        0)
+      c->h2_send.erase(sid);
+  }
+
+  void h2_respond_simple(Conn* c, int32_t sid, int status,
+                         const char* text) {
+    h2_submit(c, sid, status,
+              {{"content-type", "text/plain"}}, text);
+    h2_finish_stream(c);
+  }
+
+  void h2_respond_redirect(Conn* c, int32_t sid) {
+    h2_submit(c, sid, 302, {{"location", "/__pingoo/captcha"}}, "");
+    h2_finish_stream(c);
+  }
+
+  // Synthesized upstream h1 request head for the active h2 stream
+  // (h2 streams have no raw h1 head to rewrite).
+  std::string h2_upstream_head(Conn* c) {
+    const Parsed& p = c->req;
+    std::string out = p.method + " " + p.target + " HTTP/1.1\r\n";
+    if (!p.host.empty()) out += "host: " + p.host + "\r\n";
+    for (const auto& kv : p.h2_headers) {
+      if (drop_request_header(kv.first, false) || kv.first == "host")
+        continue;
+      out += kv.first + ": " + kv.second + "\r\n";
+    }
+    const H2Stream& st = c->h2_streams[c->h2_active];
+    out += "connection: close\r\n";
+    if (!st.body.empty())
+      out += "content-length: " + std::to_string(st.body.size()) + "\r\n";
+    out += "x-forwarded-for: " + std::string(c->peer_ip) + "\r\n";
+    out += std::string("x-forwarded-proto: ") +
+           (c->ssl != nullptr ? "https" : "http") + "\r\n";
+    if (!p.host.empty()) out += "x-forwarded-host: " + p.host + "\r\n";
+    out += "pingoo-client-ip: " + std::string(c->peer_ip) + "\r\n\r\n";
+    out += st.body;
+    return out;
+  }
+
+  // Collected upstream response -> h2 response for the active stream
+  // (status/headers were parsed once at head completion).
+  void h2_complete_response(Conn* c) {
+    int32_t sid = c->h2_active;
+    std::string body = std::move(c->h2_resp_body);
+    c->h2_resp_body.clear();
+    int status = c->h2_resp_status;
+    std::vector<std::pair<std::string, std::string>> headers;
+    headers.swap(c->h2_resp_hdrs);
+    close_upstream(c);
+    c->h2_resp_head.clear();
+    c->resp_head_done = false;
+    if (c->req.method == "HEAD") body.clear();
+    h2_submit(c, sid, status, headers, std::move(body));
+    h2_finish_stream(c);
+    h2_flush(c);
+  }
+
+  static int h2_on_header(nghttp2_session*, const void* frame,
+                          const uint8_t* name, size_t namelen,
+                          const uint8_t* value, size_t valuelen, uint8_t,
+                          void* user_data) {
+    Conn* c = static_cast<Conn*>(user_data);
+    const auto* hd = static_cast<const nghttp2_frame_hd*>(frame);
+    H2Stream& st = c->h2_streams[hd->stream_id];
+    std::string n(reinterpret_cast<const char*>(name), namelen);
+    std::string v(reinterpret_cast<const char*>(value), valuelen);
+    Parsed& p = st.p;
+    if (n == ":method") {
+      p.method = v;
+    } else if (n == ":path") {
+      p.target = v;
+      size_t q = v.find('?');
+      p.path = q == std::string::npos ? v : v.substr(0, q);
+    } else if (n == ":authority") {
+      p.host = strip_host_port(v);
+    } else if (!n.empty() && n[0] == ':') {
+      // other pseudo-headers ignored
+    } else {
+      if (n == "user-agent") p.user_agent = trim(v);
+      if (n == "cookie" && p.verified_cookie.empty())
+        p.verified_cookie = extract_verified_cookie(v);
+      p.h2_headers.emplace_back(lower(n), v);
+    }
+    return 0;
+  }
+
+  static int h2_on_frame_recv(nghttp2_session*, const void* frame,
+                              void* user_data) {
+    Conn* c = static_cast<Conn*>(user_data);
+    const auto* hd = static_cast<const nghttp2_frame_hd*>(frame);
+    if ((hd->type == NGHTTP2_FRAME_HEADERS ||
+         hd->type == NGHTTP2_FRAME_DATA) &&
+        (hd->flags & NGHTTP2_FLAG_END_STREAM)) {
+      auto it = c->h2_streams.find(hd->stream_id);
+      if (it != c->h2_streams.end() && !it->second.complete) {
+        it->second.complete = true;
+        it->second.p.ok = !it->second.p.method.empty() &&
+                          !it->second.p.target.empty();
+        c->h2_ready.push_back(hd->stream_id);
+      }
+    }
+    return 0;
+  }
+
+  static int h2_on_data_chunk(nghttp2_session*, uint8_t, int32_t stream_id,
+                              const uint8_t* data, size_t len,
+                              void* user_data) {
+    Conn* c = static_cast<Conn*>(user_data);
+    H2Stream& st = c->h2_streams[stream_id];
+    if (st.body.size() + len > kMaxBuffered)
+      return NGHTTP2_ERR_CALLBACK_FAILURE;
+    st.body.append(reinterpret_cast<const char*>(data), len);
+    return 0;
+  }
+
+  static int h2_on_stream_close(nghttp2_session*, int32_t stream_id,
+                                uint32_t, void* user_data) {
+    Conn* c = static_cast<Conn*>(user_data);
+    c->h2_streams.erase(stream_id);
+    c->h2_send.erase(stream_id);
+    if (c->h2_active == stream_id && g_server != nullptr) {
+      // Peer reset the in-flight stream: abandon its verdict/upstream.
+      g_server->drop_ticket(c);
+      g_server->close_upstream(c);
+      c->h2_resp_head.clear();
+      c->h2_resp_body.clear();
+      c->resp_head_done = false;
+      c->h2_active = 0;
+      g_server->h2_process_next(c);
+    }
+    return 0;
+  }
+
+  static ssize_t h2_data_read(nghttp2_session*, int32_t stream_id,
+                              uint8_t* buf, size_t length,
+                              uint32_t* data_flags, nghttp2_data_source*,
+                              void* user_data) {
+    Conn* c = static_cast<Conn*>(user_data);
+    auto it = c->h2_send.find(stream_id);
+    if (it == c->h2_send.end()) {
+      *data_flags = NGHTTP2_DATA_FLAG_EOF;
+      return 0;
+    }
+    const std::string& body = it->second.first;
+    size_t& off = it->second.second;
+    size_t n = std::min(body.size() - off, length);
+    if (n > 0) {
+      std::memcpy(buf, body.data() + off, n);
+      off += n;
+    }
+    if (off >= body.size()) {
+      *data_flags = NGHTTP2_DATA_FLAG_EOF;
+      c->h2_send.erase(it);
+    }
+    return static_cast<ssize_t>(n);
+  }
+
+  void drop_ticket(Conn* c) {
+    if (c->ticket != UINT64_MAX) {
+      awaiting_.erase(c->ticket);
+      c->ticket = UINT64_MAX;
+    }
+  }
+
+  void on_h2_event(Conn* c, uint32_t events) {
+    c->last_active = now_;
+    if (events & EPOLLIN) {
+      char buf[16384];
+      for (;;) {
+        ssize_t r = t_read(c, buf, sizeof(buf));
+        if (r > 0) {
+          h2_pump(c, buf, static_cast<size_t>(r));
+          if (c->dead) return;
+        } else if (r == 0) {
+          mark_close(c);
+          return;
+        } else if (r == -1) {
+          break;
+        } else {
+          mark_close(c);
+          return;
+        }
+      }
+    }
+    if (events & EPOLLOUT) {
+      c->ssl_want_write = false;
+      h2_flush(c);
+    }
   }
 
   // -- proxy phase ----------------------------------------------------------
@@ -1355,11 +1863,16 @@ class Server {
       } else {
         // Upstream write failure mid-request: 502 if nothing sent yet,
         // else close.
-        if (c->resp_head_done) mark_close(c);
-        else respond_close(c, k502);
+        if (c->resp_head_done && c->state != ConnState::kH2) mark_close(c);
+        else respond_502(c);
         return;
       }
     }
+  }
+
+  bool proxy_live(Conn* c) const {
+    return c->state == ConnState::kProxying ||
+           (c->state == ConnState::kH2 && c->upstream_fd >= 0);
   }
 
   void on_upstream_event(Conn* c, uint32_t events) {
@@ -1370,21 +1883,26 @@ class Server {
       getsockopt(c->upstream_fd, SOL_SOCKET, SO_ERROR, &err, &len);
       if (err != 0) {
         close_upstream(c);
-        respond_close(c, k502);
+        respond_502(c);
         return;
       }
       c->upstream_connected = true;
     }
     if (events & EPOLLOUT) flush_upstream(c);
-    if (c->dead || c->state != ConnState::kProxying) return;
+    if (c->dead || !proxy_live(c)) return;
     if (events & EPOLLIN) {
       char buf[16384];
       for (;;) {
-        if (c->outbuf.size() > kMaxBuffered) break;  // backpressure
+        if (c->outbuf.size() > kMaxBuffered ||
+            c->h2_resp_body.size() > kMaxBuffered)
+          break;  // backpressure
         ssize_t r = read(c->upstream_fd, buf, sizeof(buf));
         if (r > 0) {
-          on_upstream_data(c, buf, static_cast<size_t>(r));
-          if (c->dead || c->state != ConnState::kProxying) return;
+          if (c->state == ConnState::kH2)
+            h2_on_upstream_data(c, buf, static_cast<size_t>(r));
+          else
+            on_upstream_data(c, buf, static_cast<size_t>(r));
+          if (c->dead || !proxy_live(c)) return;
         } else if (r == 0) {
           c->upstream_eof = true;
           break;
@@ -1402,9 +1920,75 @@ class Server {
       return;
     }
     maybe_finish_response(c);
-    if (c->dead || c->state != ConnState::kProxying) return;
+    if (c->dead || !proxy_live(c)) return;
     update_client_events(c);
     update_upstream_events(c);
+  }
+
+  // h2 mode: upstream h1 response is COLLECTED (head parsed, body
+  // de-framed — chunk metadata must not leak into h2 DATA frames).
+  void h2_on_upstream_data(Conn* c, const char* data, size_t len) {
+    size_t off = 0;
+    if (!c->resp_head_done) {
+      c->h2_resp_head.append(data, len);
+      for (;;) {
+        size_t he = c->h2_resp_head.find("\r\n\r\n");
+        if (he == std::string::npos) {
+          if (c->h2_resp_head.size() > kMaxHead) mark_close(c);
+          return;
+        }
+        // 1xx interim responses have no h2 representation we forward;
+        // skip to the final head.
+        int status = 0;
+        if (c->h2_resp_head.size() >= 12 &&
+            c->h2_resp_head.compare(0, 7, "HTTP/1.") == 0 &&
+            c->h2_resp_head[8] == ' ')
+          status = atoi(c->h2_resp_head.c_str() + 9);
+        if (status >= 100 && status < 200) {
+          c->h2_resp_head.erase(0, he + 4);
+          continue;
+        }
+        std::string rest = c->h2_resp_head.substr(he + 4);
+        c->h2_resp_head.erase(he + 4);
+        // Body framing from the head.
+        RespHead rh = rewrite_response_head(c->h2_resp_head, false);
+        bool head_only = c->req.method == "HEAD" || rh.status == 204 ||
+                         rh.status == 304;
+        if (!rh.ok) {
+          respond_502(c);
+          return;
+        }
+        // Parse the response metadata ONCE; h2_complete_response sends
+        // exactly this (no second parser over the same bytes).
+        c->h2_resp_status = rh.status;
+        c->h2_resp_hdrs.clear();
+        parse_header_lines(c->h2_resp_head, &c->h2_resp_hdrs);
+        if (head_only) c->resp_body.reset_none();
+        else if (rh.chunked) c->resp_body.reset_chunked();
+        else if (rh.content_length >= 0)
+          c->resp_body.reset_cl(rh.content_length);
+        else c->resp_body.reset_eof();
+        c->resp_head_done = true;
+        if (!rest.empty()) {
+          c->resp_body.consume(rest.data(), rest.size(), &c->h2_resp_body);
+          if (c->resp_body.bad) {
+            mark_close(c);
+            return;
+          }
+        }
+        break;
+      }
+    } else if (!c->resp_body.done) {
+      c->resp_body.consume(data + off, len - off, &c->h2_resp_body);
+      if (c->resp_body.bad) {
+        mark_close(c);
+        return;
+      }
+    }
+    // Responses are submitted whole; one larger than the buffer cap can
+    // never complete — abort the stream instead of stalling the
+    // connection (the Python h2 plane handles arbitrary sizes).
+    if (c->h2_resp_body.size() > kMaxBuffered) h2_abort_active(c);
   }
 
   void on_upstream_data(Conn* c, const char* data, size_t len) {
@@ -1465,6 +2049,24 @@ class Server {
   }
 
   void maybe_finish_response(Conn* c) {
+    if (c->state == ConnState::kH2) {
+      if (c->upstream_fd < 0) return;  // no proxy in flight
+      if (!c->resp_head_done) {
+        if (c->upstream_eof) respond_502(c);  // EOF before any head
+        return;
+      }
+      if (c->resp_body.done ||
+          (c->resp_body.mode == BodyFramer::kUntilEof && c->upstream_eof)) {
+        h2_complete_response(c);
+      } else if (c->upstream_eof) {
+        // Truncated CL/chunked response: a rebuilt content-length would
+        // certify the partial body as complete — reset the stream so
+        // the client sees the failure (the h1 path relays the original
+        // framing and closes, which is equally detectable).
+        h2_abort_active(c);
+      }
+      return;
+    }
     if (c->state != ConnState::kProxying || !c->resp_head_done) {
       // EOF from upstream before any response head -> 502
       if (c->state == ConnState::kProxying && c->upstream_eof &&
@@ -1528,7 +2130,7 @@ class Server {
   void handle(Conn* c, bool is_upstream, uint32_t events) {
     if (c->dead) return;  // stale event within this batch
     if (is_upstream) {
-      if (c->state == ConnState::kProxying) on_upstream_event(c, events);
+      if (proxy_live(c)) on_upstream_event(c, events);
       return;
     }
     switch (c->state) {
@@ -1555,6 +2157,13 @@ class Server {
         }
         on_proxy_client_event(c, events);
         break;
+      case ConnState::kH2:
+        if (events & (EPOLLHUP | EPOLLERR)) {
+          mark_close(c);
+          return;
+        }
+        on_h2_event(c, events);
+        break;
       case ConnState::kClosing:
         if (events & (EPOLLHUP | EPOLLERR)) mark_close(c);
         else if (events & EPOLLOUT) {
@@ -1579,8 +2188,6 @@ class Server {
   std::vector<Conn*> doomed_;
   time_t now_ = 0;
 };
-
-Server* g_server = nullptr;
 
 int alpn_select_cb(SSL* ssl, const unsigned char** out, unsigned char* outlen,
                    const unsigned char* in, unsigned int inlen, void* arg);
@@ -1632,19 +2239,28 @@ int alpn_select_cb(SSL* ssl, const unsigned char** out, unsigned char* outlen,
                    const unsigned char* in, unsigned int inlen, void* arg) {
   (void)arg;
   Conn* c = g_server ? g_server->conn_for_ssl(ssl) : nullptr;
-  const char* want = (c != nullptr && c->acme_challenge) ? "acme-tls/1"
-                                                         : "http/1.1";
-  size_t wlen = strlen(want);
-  unsigned int i = 0;
-  while (i < inlen) {
-    unsigned int n = in[i];
-    if (i + 1 + n > inlen) break;
-    if (n == wlen && memcmp(in + i + 1, want, n) == 0) {
-      *out = in + i + 1;
-      *outlen = static_cast<unsigned char>(n);
-      return SSL_TLSEXT_ERR_OK;
+  bool acme = c != nullptr && c->acme_challenge;
+  // Server preference order (the reference's hyper auto builder serves
+  // h1+h2, http_listener.rs:276-278); every h2 client still sends the
+  // RFC 7540 preface, which is what actually switches the connection.
+  const char* prefs_normal[] = {"h2", "http/1.1"};
+  const char* prefs_acme[] = {"acme-tls/1"};
+  const char** prefs = acme ? prefs_acme : prefs_normal;
+  size_t nprefs = acme ? 1 : 2;
+  for (size_t p = 0; p < nprefs; ++p) {
+    const char* want = prefs[p];
+    size_t wlen = strlen(want);
+    unsigned int i = 0;
+    while (i < inlen) {
+      unsigned int n = in[i];
+      if (i + 1 + n > inlen) break;
+      if (n == wlen && memcmp(in + i + 1, want, n) == 0) {
+        *out = in + i + 1;
+        *outlen = static_cast<unsigned char>(n);
+        return SSL_TLSEXT_ERR_OK;
+      }
+      i += 1 + n;
     }
-    i += 1 + n;
   }
   return SSL_TLSEXT_ERR_NOACK;  // no overlap: proceed without ALPN
 }

@@ -644,3 +644,268 @@ class TestResponseFraming:
         c.close()
         c2.close()
         assert data is raw_stack.handler_box
+
+
+class TestNativeH2:
+    """HTTP/2 on the C++ data plane (nghttp2 ABI shim): cleartext prior
+    knowledge and TLS ALPN, per-stream verdicts through the ring."""
+
+    @pytest.fixture(scope="class")
+    def stack(self, tmp_path_factory):
+        from pingoo_tpu.host import h2 as h2mod
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+        st = NativeStack(tmp_path_factory.mktemp("nh2"), _block_rules())
+        yield st
+        st.stop()
+
+    def _request(self, port, method, path, headers, body=b"", ssl_ctx=None,
+                 server_hostname=None):
+        import asyncio
+
+        from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+        async def flow():
+            conn = H2UpstreamConnection("127.0.0.1", port)
+            await conn.connect(ssl=ssl_ctx, server_hostname=server_hostname)
+            try:
+                return await asyncio.wait_for(
+                    conn.request(method, "t.test", path, headers, body), 10)
+            finally:
+                await conn.close()
+
+        return asyncio.run(flow())
+
+    def test_prior_knowledge_verdicts(self, stack):
+        st, _, body = self._request(stack.port, "GET", "/ok",
+                                    [("user-agent", "ua")])
+        assert st == 200 and b"up:/ok" in body
+        st, _, _ = self._request(stack.port, "GET", "/x?evil",
+                                 [("user-agent", "ua")])
+        assert st == 403
+
+    def test_post_body_forwarded(self, stack):
+        st, _, body = self._request(stack.port, "POST", "/p",
+                                    [("user-agent", "ua")], b"h2-native")
+        assert st == 200 and b"post:h2-native" in body
+
+    def test_empty_ua_blocked(self, stack):
+        st, _, _ = self._request(stack.port, "GET", "/", [])
+        assert st == 403
+
+    def test_multiplexed_streams_sequential_service(self, stack):
+        import asyncio
+
+        from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+        async def flow():
+            conn = H2UpstreamConnection("127.0.0.1", stack.port)
+            await conn.connect()
+            try:
+                return await asyncio.gather(
+                    conn.request("GET", "t.test", "/a",
+                                 [("user-agent", "ua")]),
+                    conn.request("GET", "t.test", "/b?evil",
+                                 [("user-agent", "ua")]),
+                    conn.request("GET", "t.test", "/c",
+                                 [("user-agent", "ua")]),
+                )
+            finally:
+                await conn.close()
+
+        a, b, c = asyncio.run(flow())
+        assert a[0] == 200 and b"/a" in a[2]
+        assert b[0] == 403
+        assert c[0] == 200 and b"/c" in c[2]
+
+    def test_h1_coexists(self, stack):
+        data = raw_request(
+            stack.port,
+            b"GET /h1 HTTP/1.1\r\nhost: t\r\nuser-agent: ua\r\n"
+            b"connection: close\r\n\r\n")
+        assert data.startswith(b"HTTP/1.1 200") and b"up:/h1" in data
+
+
+class TestNativeH2OverTls:
+    def test_alpn_h2_and_verdicts(self, tmp_path):
+        from pingoo_tpu.host import h2 as h2mod
+        from pingoo_tpu.host.tlsmgr import generate_self_signed
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+        tls_dir = tmp_path / "tls"
+        tls_dir.mkdir()
+        cert, key = generate_self_signed(["localhost"])
+        (tls_dir / "default.pem").write_bytes(cert)
+        (tls_dir / "default.key").write_bytes(key)
+        stack = NativeStack(tmp_path, _block_rules(), tls_dir=str(tls_dir))
+        try:
+            import asyncio
+
+            from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            ctx.set_alpn_protocols(["h2"])
+
+            async def flow():
+                conn = H2UpstreamConnection("127.0.0.1", stack.port)
+                await conn.connect(ssl=ctx, server_hostname="localhost")
+                try:
+                    ok = await asyncio.wait_for(
+                        conn.request("GET", "t.test", "/tls",
+                                     [("user-agent", "ua")]), 10)
+                    bad = await asyncio.wait_for(
+                        conn.request("GET", "t.test", "/x?evil",
+                                     [("user-agent", "ua")]), 10)
+                    return ok, bad
+                finally:
+                    await conn.close()
+
+            ok, bad = asyncio.run(flow())
+            assert ok[0] == 200 and b"up:/tls" in ok[2]
+            assert bad[0] == 403
+        finally:
+            stack.stop()
+
+
+class TestNativeH2ChunkedUpstream:
+    def test_chunked_upstream_deframed(self, tmp_path):
+        """An h1 upstream answering chunked must reach the h2 client as
+        clean DATA frames (no chunk metadata leaking)."""
+        from pingoo_tpu.host import h2 as h2mod
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+
+        handler_box = {}
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(8)
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = lsock.accept()
+                except OSError:
+                    return
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    ch = conn.recv(65536)
+                    if not ch:
+                        break
+                    data += ch
+                conn.sendall(b"HTTP/1.1 200 OK\r\n"
+                             b"transfer-encoding: chunked\r\n\r\n"
+                             b"5\r\nhello\r\n6\r\n-world\r\n0\r\n\r\n")
+                conn.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.native_ring import Ring, RingSidecar
+
+        plan = compile_ruleset(_block_rules(), {})
+        ring = Ring(str(tmp_path / "ring"), capacity=256, create=True)
+        sidecar = RingSidecar(ring, plan, {}, max_batch=32)
+        threading.Thread(target=sidecar.run, daemon=True).start()
+        port = _free_port()
+        proc = subprocess.Popen(
+            [HTTPD, str(port), str(tmp_path / "ring"), "127.0.0.1",
+             str(lsock.getsockname()[1])], stdout=subprocess.PIPE)
+        assert b"listening" in proc.stdout.readline()
+        try:
+            import asyncio
+
+            from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+            async def flow():
+                conn = H2UpstreamConnection("127.0.0.1", port)
+                await conn.connect()
+                try:
+                    return await asyncio.wait_for(
+                        conn.request("GET", "t.test", "/c",
+                                     [("user-agent", "ua")]), 10)
+                finally:
+                    await conn.close()
+
+            status, headers, body = asyncio.run(flow())
+            assert status == 200
+            assert body == b"hello-world"  # de-chunked, exact payload
+        finally:
+            proc.kill()
+            proc.wait()
+            lsock.close()
+            sidecar.stop()
+            ring.close()
+
+
+class TestNativeH2TruncatedUpstream:
+    def test_truncated_cl_response_resets_stream(self, tmp_path):
+        """An upstream dying mid content-length body must NOT become a
+        well-formed short response over h2 — the stream is reset so the
+        client can see the failure."""
+        from pingoo_tpu.host import h2 as h2mod
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(8)
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = lsock.accept()
+                except OSError:
+                    return
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    ch = conn.recv(65536)
+                    if not ch:
+                        break
+                    data += ch
+                conn.sendall(b"HTTP/1.1 200 OK\r\ncontent-length: 1000"
+                             b"\r\n\r\npartial")
+                conn.close()  # truncated
+
+        threading.Thread(target=serve, daemon=True).start()
+
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.native_ring import Ring, RingSidecar
+
+        plan = compile_ruleset(_block_rules(), {})
+        ring = Ring(str(tmp_path / "ring"), capacity=256, create=True)
+        sidecar = RingSidecar(ring, plan, {}, max_batch=32)
+        threading.Thread(target=sidecar.run, daemon=True).start()
+        port = _free_port()
+        proc = subprocess.Popen(
+            [HTTPD, str(port), str(tmp_path / "ring"), "127.0.0.1",
+             str(lsock.getsockname()[1])], stdout=subprocess.PIPE)
+        assert b"listening" in proc.stdout.readline()
+        try:
+            import asyncio
+
+            from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+            async def flow():
+                conn = H2UpstreamConnection("127.0.0.1", port)
+                await conn.connect()
+                try:
+                    with pytest.raises(ConnectionError, match="reset"):
+                        await asyncio.wait_for(
+                            conn.request("GET", "t.test", "/t",
+                                         [("user-agent", "ua")]), 10)
+                finally:
+                    await conn.close()
+
+            asyncio.run(flow())
+        finally:
+            proc.kill()
+            proc.wait()
+            lsock.close()
+            sidecar.stop()
+            ring.close()
