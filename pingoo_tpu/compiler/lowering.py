@@ -301,8 +301,19 @@ class Lowerer:
 
     def lower_rule(self, root: ast.Node) -> object:
         """Lower a rule expression to BoolIR. Raises LowerError."""
+        self._rule_scan_bits = 0  # per-RULE NFA footprint accumulator
         val = self.lower(root)
         return self._as_bool(val)
+
+    def _charge_scan_bits(self, bits: int) -> None:
+        """Count NFA state bits against the per-rule cap — across ALL of
+        the rule's matches()/contains() predicates, so one rule can't
+        blow up the bank's lane count through many medium literals."""
+        from .nfa import MAX_RULE_SCAN_BITS
+
+        self._rule_scan_bits = getattr(self, "_rule_scan_bits", 0) + bits
+        if self._rule_scan_bits > MAX_RULE_SCAN_BITS:
+            raise LowerError("rule NFA footprint exceeds the per-rule bit cap")
 
     # -- helpers -------------------------------------------------------------
 
@@ -446,14 +457,18 @@ class Lowerer:
                     alts = repat.compile_regex(arg.value)
                     from .nfa import MAX_SCAN_BITS, scan_bits_needed
 
+                    total = 0
                     for lp in alts:
-                        if scan_bits_needed(lp) > MAX_SCAN_BITS:
+                        need = scan_bits_needed(lp)
+                        total += need
+                        if need > MAX_SCAN_BITS:
                             raise repat.Unsupported(
                                 "expanded pattern exceeds the multi-word cap")
                 except repat.Unsupported as exc:
                     raise LowerError(f"regex outside device subset: {exc}")
                 except Exception:
                     return LErr()  # invalid regex raises EvalError in interp
+                self._charge_scan_bits(total)
                 leaf = self.reg.add(
                     NfaPred(field=recv.field, kind="regex", pattern=arg.value))
                 return LBool(BLeaf(leaf))
@@ -490,6 +505,7 @@ class Lowerer:
 
                 if len(lit) + 2 > MAX_SCAN_BITS:  # guard + positions + sticky
                     raise LowerError("contains literal too long for NFA span")
+                self._charge_scan_bits(len(lit) + 2)
                 leaf = self.reg.add(
                     NfaPred(field=recv.field, kind="contains", pattern=arg.value))
                 return LBool(BLeaf(leaf))

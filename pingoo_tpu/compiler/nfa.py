@@ -45,6 +45,10 @@ WORD_BITS = 32
 # positions + sticky bits across all alternatives). 128 bits = a 4-word
 # span; anything larger is Unsupported -> host-interpreted rule.
 MAX_SCAN_BITS = 128
+# Cap on ONE RULE's total footprint across all its alternatives (wide
+# alternations split across slots): 24 words worth of state. Keeps a
+# single pathological rule from doubling the whole bank's lane count.
+MAX_RULE_SCAN_BITS = 768
 
 
 def _skippable(p: Pos) -> bool:
@@ -74,17 +78,19 @@ def simulate(lp: LinearPattern, data: bytes) -> bool:
         return False
     m = len(lp.positions)
     if m == 0 or lp.min_len == 0:
-        if not (lp.anchor_start and lp.anchor_end):
+        if not (lp.anchor_start and (lp.anchor_end or lp.anchor_end_abs)):
             return True
-        # ^...$ with nothing required: empty input, or empty before a
-        # lone trailing newline, or fall through to the NFA (m>0).
-        if len(data) == 0 or data == b"\n":
+        # ^...$ with nothing required: empty input, or (non-abs $ only)
+        # empty before a lone trailing newline, or fall through to the
+        # NFA (m>0).
+        if len(data) == 0 or (data == b"\n" and not lp.anchor_end_abs):
             return True
         if m == 0:
             return False
     first_word = _is_word(next(iter(lp.positions[0].bytes))) if m else False
     last_word = _is_word(next(iter(lp.positions[-1].bytes))) if m else False
-    if lp.anchor_end and lp.boundary_end and not last_word:
+    if (lp.anchor_end or lp.anchor_end_abs) and lp.boundary_end \
+            and not last_word:
         return False  # boundary can never hold at end-of-input
     last_set = _last_set(lp)
     active: set[int] = set()
@@ -94,8 +100,8 @@ def simulate(lp: LinearPattern, data: bytes) -> bool:
     ends_nl = len(data) > 0 and data[-1] == 0x0A
     for t, c in enumerate(data):
         cur_word = _is_word(c)
-        if lp.boundary_end and not lp.anchor_end and pend and \
-                cur_word != last_word:
+        if lp.boundary_end and not (lp.anchor_end or lp.anchor_end_abs) \
+                and pend and cur_word != last_word:
             matched = True
         inject = (t == 0) or not lp.anchor_start
         if lp.boundary_start and inject:
@@ -116,15 +122,21 @@ def simulate(lp: LinearPattern, data: bytes) -> bool:
         hit = bool(active & last_set)
         if lp.boundary_end:
             pend = hit
-        elif not lp.anchor_end and hit:
+        elif not (lp.anchor_end or lp.anchor_end_abs) and hit:
             matched = True
         if lp.anchor_end and ends_nl and t == len(data) - 2 and hit:
             matched = True  # accept just before the trailing newline
         prev_word = cur_word
     if lp.boundary_end and not lp.anchor_end:
         # End of input confirms a pending accept when the last consumed
-        # char is a word char (EOS is the non-word side).
+        # char is a word char (EOS is the non-word side). For \b\Z the
+        # fixed `matched` above stays False, so only the final-position
+        # pend (+ word-ness, guaranteed by the early-out) accepts.
         return matched or (pend and last_word)
+    if lp.anchor_end_abs:
+        # Absolute end: accept only from the final state (no trailing-\n
+        # tolerance, so `matched` never fires for abs patterns).
+        return bool(active & last_set)
     if lp.anchor_end:
         return matched or bool(active & last_set)
     return matched
@@ -269,14 +281,18 @@ def _expand_scan_patterns(lp: LinearPattern) -> list[_ScanPattern]:
     m = len(base)
     base_last = frozenset(_last_set(lp))
 
-    if lp.anchor_end and lp.boundary_end and m and not is_word_byte(
-            next(iter(base[-1].bytes))):
-        # \b$ with a non-word last class: the boundary can never hold at
-        # end-of-input (simulate() has the same early-out).
+    if (lp.anchor_end or lp.anchor_end_abs) and lp.boundary_end and m \
+            and not is_word_byte(next(iter(base[-1].bytes))):
+        # \b$ / \b\Z with a non-word last class: the boundary can never
+        # hold at end-of-input (simulate() has the same early-out).
         return []
 
     variants: list[tuple[tuple[Pos, ...], frozenset[int], bool]] = []
-    if lp.anchor_end:
+    if lp.anchor_end_abs:
+        # Absolute end (\Z / mid-$ lowering): accept only from the final
+        # scan state — no appended-\n alternative, no sticky bit.
+        variants.append((base, base_last, False))
+    elif lp.anchor_end:
         pos = base + (Pos(bytes=_NEWLINE),)
         variants.append((pos, base_last | {m}, False))
     elif lp.boundary_end:
@@ -318,7 +334,8 @@ def scan_bits_needed(lp: LinearPattern) -> int:
     included). Must be <= MAX_SCAN_BITS for device residency."""
     if lp.never_match:
         return 0
-    if lp.min_len == 0 and not (lp.anchor_start and lp.anchor_end):
+    if lp.min_len == 0 and not (
+            lp.anchor_start and (lp.anchor_end or lp.anchor_end_abs)):
         return 0  # always-match: no device state
     total = 0
     for sp in _expand_scan_patterns(lp):
@@ -503,8 +520,9 @@ def build_bank(patterns: list[LinearPattern]) -> NfaBank:
 
     for lp in patterns:
         m = len(lp.positions)
-        always = lp.min_len == 0 and not (lp.anchor_start and lp.anchor_end)
-        empty_ok = lp.min_len == 0 and lp.anchor_start and lp.anchor_end
+        ends = lp.anchor_end or lp.anchor_end_abs
+        always = lp.min_len == 0 and not (lp.anchor_start and ends)
+        empty_ok = lp.min_len == 0 and lp.anchor_start and ends
         no_match = PatternSlot(accepts=(), always_match=False, empty_ok=False)
         if lp.never_match:
             bank.slots.append(no_match)

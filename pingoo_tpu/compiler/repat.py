@@ -34,7 +34,7 @@ from typing import Iterable
 # word; the binding limit is nfa.MAX_SCAN_BITS on the EXPANDED footprint
 # (checked at lowering), this is just a sanity bound before expansion.
 MAX_POSITIONS = 126  # 1 guard + 126 positions + 1 sticky = 128 bits
-MAX_CROSS_PRODUCT = 16  # cap on alternation expansion
+MAX_CROSS_PRODUCT = 48  # cap on alternation expansion (alternatives/rule)
 MAX_REPEAT_EXPANSION = 96
 
 
@@ -72,6 +72,10 @@ class LinearPattern:
     positions: list[Pos] = field(default_factory=list)
     anchor_start: bool = False
     anchor_end: bool = False
+    # Absolute end-of-input anchor (\z / \Z, and the lowering of a
+    # mid-pattern $ whose suffix consumed the trailing newline): accepts
+    # at the final byte only, WITHOUT $'s before-trailing-\n tolerance.
+    anchor_end_abs: bool = False
     boundary_start: bool = False
     boundary_end: bool = False
     never_match: bool = False  # statically unsatisfiable (e.g. a\bb)
@@ -121,38 +125,82 @@ def compile_regex(pattern: str) -> list[LinearPattern]:
     alts = parser.parse_alternation(top=True)
     if parser.i < len(parser.data):
         raise Unsupported(f"unexpected {chr(parser.data[parser.i])!r}")
-    out = []
     expanded: list[list[_Item]] = []
     for alt in alts:
-        expanded.extend(_expand_alts(alt))
+        expanded.extend(_expand_alts(alt, at_start=True))
     if len(expanded) > MAX_CROSS_PRODUCT:
         raise Unsupported("too many alternation branches")
-    for alt in expanded:
+    # Anchor/boundary lowering pre-passes (each may fan one alternative
+    # out into several, or statically eliminate it):
+    #   mid-pattern $  -> end-anchored alternatives (see _lower_mid_dollar)
+    #   \b next to an optional position -> case-split on its presence
+    final: list[list[_Item]] = []
+    for items in expanded:
+        for v in _lower_mid_dollar(items):
+            final.extend(_split_boundary_optionals(v))
+    if len(final) > MAX_CROSS_PRODUCT:
+        raise Unsupported("too many alternation branches")
+    out = []
+    for alt in final:
         lp = _to_linear(alt)
         if len(lp.positions) > MAX_POSITIONS:
             raise Unsupported(f"pattern expands to >{MAX_POSITIONS} positions")
         out.append(lp)
+    if not out:
+        # Every alternative was statically unsatisfiable.
+        out.append(LinearPattern(never_match=True))
     return out
 
 
-def _expand_alts(items: list[_Item]) -> list[list[_Item]]:
-    """Cross-product expansion of group alternations into flat sequences."""
+def _expand_alts(items: list[_Item],
+                 at_start: bool = False) -> list[list[_Item]]:
+    """Cross-product expansion of group alternations into flat sequences.
+
+    `at_start` is True when nothing in the overall pattern can precede
+    `items` (compile_regex's top-level call; propagated through groups
+    while the accumulated prefix is still empty). It licenses the repeat
+    truncation below.
+    """
     seqs: list[list[_Item]] = [[]]
     for item in items:
+        start_here = at_start and all(len(s) == 0 for s in seqs)
         if item.alts is not None:
             branches: list[list[_Item]] = []
             for alt in item.alts:
-                branches.extend(_expand_alts(alt))
+                branches.extend(_expand_alts(alt, start_here))
             new_seqs = []
             for seq in seqs:
                 for branch in branches:
                     new_seqs.append(seq + branch)
             seqs = new_seqs
         elif item.seq is not None and (item.min_rep, item.max_rep) == (1, 1):
-            inner = _expand_alts(item.seq)
+            inner = _expand_alts(item.seq, start_here)
             new_seqs = []
             for seq in seqs:
                 for branch in inner:
+                    new_seqs.append(seq + branch)
+            seqs = new_seqs
+        elif item.seq is not None:
+            # Quantified multi-position group Y{lo,hi} -> alternation of
+            # exact repetition counts. With NOTHING before it in an
+            # unanchored search pattern, Y{lo,hi}X is match-equivalent to
+            # Y{lo}X (any occurrence of Y{k}X, k >= lo, contains a
+            # Y{lo}X occurrence over its last lo repetitions), so the
+            # fan-out collapses to one branch — the lowering that keeps
+            # CRS-style `(\.\./){3,12}etc/...` on device.
+            lo, hi = item.min_rep, item.max_rep
+            if start_here:
+                hi = lo
+            if hi == -1:
+                raise Unsupported("unbounded repeat of multi-char group")
+            if hi - lo + 1 > MAX_CROSS_PRODUCT or hi > MAX_REPEAT_EXPANSION:
+                raise Unsupported("repeat expansion too large")
+            branches = []
+            for k in range(lo, hi + 1):
+                branches.extend(_expand_alts(list(item.seq) * k, start_here))
+            new_seqs = []
+            for seq in seqs:
+                for branch in branches:
                     new_seqs.append(seq + branch)
             seqs = new_seqs
         else:
@@ -160,6 +208,137 @@ def _expand_alts(items: list[_Item]) -> list[list[_Item]]:
         if len(seqs) > MAX_CROSS_PRODUCT:
             raise Unsupported("too many alternation branches")
     return seqs
+
+
+def _item_nullable(item: "_Item") -> bool:
+    """Can this position item consume zero bytes?"""
+    if item.pos is None:
+        return False
+    if (item.min_rep, item.max_rep) == (1, 1):
+        return item.pos.quant in (Quant.OPT, Quant.STAR)
+    return item.min_rep == 0
+
+
+def _item_can_consume_one(item: "_Item") -> bool:
+    """Can this position item consume exactly one byte?"""
+    if item.pos is None:
+        return False
+    if (item.min_rep, item.max_rep) == (1, 1):
+        return True  # ONE/OPT/STAR/PLUS all admit a single repetition
+    return item.min_rep <= 1 and (item.max_rep == -1 or item.max_rep >= 1)
+
+
+def _lower_mid_dollar(items: list["_Item"]) -> list[list["_Item"]]:
+    """Lower a mid-pattern `$` into end-anchored alternatives.
+
+    `$` asserts (Python-re bytes semantics, the parity oracle) that the
+    current position is end-of-input or just before one trailing '\\n'.
+    For X $ Y that leaves exactly two ways Y can succeed:
+
+      * at end-of-input — Y must match empty        -> alternative X$
+      * before the trailing newline — Y must consume exactly that '\\n'
+        (and nothing else)                          -> alternative X'\\n'
+        anchored at ABSOLUTE end (no further \\n tolerance: a$\\n must
+        not match "a\\n\\n")
+
+    Returns [] when neither applies (the pattern is unsatisfiable) and
+    [items] unchanged when there is no mid-pattern $ or the suffix has
+    shapes we leave to host fallback.
+    """
+    idx = None
+    for i, it in enumerate(items):
+        if it.anchor == "$" and i != len(items) - 1:
+            idx = i
+            break
+    if idx is None:
+        return [items]
+    x_items = items[:idx]
+    y_items = items[idx + 1:]
+    if any(it.anchor in ("^", "b", "A", "Z") for it in y_items):
+        return [items]  # _to_linear reports these Unsupported
+    y_pos = [it for it in y_items if it.pos is not None]
+    alts: list[list[_Item]] = []
+    if all(_item_nullable(it) for it in y_pos):
+        # Further $ items in Y hold trivially at either end position.
+        alts.append(x_items + [_Item(anchor="$")])
+    else:
+        for j, it in enumerate(y_items):
+            if it.pos is None or 0x0A not in it.pos.bytes or \
+                    not _item_can_consume_one(it):
+                continue
+            rest = [k for k in y_items[:j] + y_items[j + 1:]
+                    if k.pos is not None]
+            if all(_item_nullable(k) for k in rest):
+                alts.append(x_items +
+                            [_Item(pos=Pos(bytes=frozenset([0x0A]))),
+                             _Item(anchor="Z")])
+                break
+    return alts
+
+
+def _leading_edge_optional(item: "_Item") -> bool:
+    # An item's first expanded position is optional exactly when the
+    # item can consume zero bytes.
+    return _item_nullable(item)
+
+
+def _trailing_edge_optional(item: "_Item") -> bool:
+    if (item.min_rep, item.max_rep) == (1, 1):
+        return item.pos.quant in (Quant.OPT, Quant.STAR)
+    return item.max_rep != -1 and item.max_rep > item.min_rep
+
+
+def _split_leading(item: "_Item") -> list[list["_Item"]]:
+    """Case-split an optional-leading-edge item: absent | present."""
+    if (item.min_rep, item.max_rep) == (1, 1):
+        q = Quant.ONE if item.pos.quant == Quant.OPT else Quant.PLUS
+        return [[], [_Item(pos=Pos(bytes=item.pos.bytes, quant=q))]]
+    # {0,hi} -> absent | {1,hi}
+    return [[], [_Item(pos=item.pos, min_rep=1, max_rep=item.max_rep)]]
+
+
+def _split_trailing(item: "_Item") -> list[list["_Item"]]:
+    """Case-split an optional-trailing-edge item into exact counts."""
+    if (item.min_rep, item.max_rep) == (1, 1):
+        q = Quant.ONE if item.pos.quant == Quant.OPT else Quant.PLUS
+        return [[], [_Item(pos=Pos(bytes=item.pos.bytes, quant=q))]]
+    return [([_Item(pos=item.pos, min_rep=k, max_rep=k)] if k else [])
+            for k in range(item.min_rep, item.max_rep + 1)]
+
+
+def _split_boundary_optionals(items: list["_Item"]) -> list[list["_Item"]]:
+    """Case-split positions with an optional edge adjacent to a \\b.
+
+    A \\b's truth depends on the word-ness of its immediate neighbors;
+    when a neighbor position may be skipped the neighbor identity is
+    dynamic, which the static mid-\\b lowering in _to_linear can't
+    express. Splitting on the optional's presence makes every branch
+    statically decidable: select\\b\\s*\\( becomes select\\( | select\\s+\\(.
+    """
+    for i, it in enumerate(items):
+        if it.anchor != "b":
+            continue
+        nxt = items[i + 1] if i + 1 < len(items) else None
+        prv = items[i - 1] if i > 0 else None
+        repl: list[list[_Item]] | None = None
+        lo_i = hi_i = i
+        if nxt is not None and nxt.pos is not None and \
+                _leading_edge_optional(nxt):
+            repl = _split_leading(nxt)
+            lo_i, hi_i = i + 1, i + 2
+        elif prv is not None and prv.pos is not None and \
+                _trailing_edge_optional(prv):
+            repl = _split_trailing(prv)
+            lo_i, hi_i = i - 1, i
+        if repl is not None:
+            out: list[list[_Item]] = []
+            for r in repl:
+                out.extend(_split_boundary_optionals(
+                    items[:lo_i] + r + items[hi_i:]))
+                if len(out) > MAX_CROSS_PRODUCT:
+                    raise Unsupported("too many alternation branches")
+            return out
+    return [items]
 
 
 # -- internal IR before linearization ---------------------------------------
@@ -182,15 +361,23 @@ def _to_linear(items: list[_Item]) -> LinearPattern:
     flat = _flatten(items)
     pending_mid = False
     for idx, item in enumerate(flat):
-        if item.anchor == "^":
+        if item.anchor in ("^", "A"):
             if idx != 0:
                 raise Unsupported("^ not at pattern start")
             lp.anchor_start = True
             continue
         if item.anchor == "$":
+            # Mid-pattern $ is lowered by _lower_mid_dollar before this
+            # pass; reaching here mid-pattern means an unhandled suffix
+            # shape (e.g. \b after $) -> host fallback.
             if idx != len(flat) - 1:
                 raise Unsupported("$ not at pattern end")
             lp.anchor_end = True
+            continue
+        if item.anchor == "Z":
+            if idx != len(flat) - 1:
+                raise Unsupported("\\z not at pattern end")
+            lp.anchor_end_abs = True
             continue
         if item.anchor == "b":
             # \b is "leading" before any position (e.g. ^\bfoo) and
@@ -374,6 +561,14 @@ class _Parser:
         if self.data[self.i : self.i + 2] == rb"\b":
             self.i += 2
             return _Item(anchor="b")
+        if self.data[self.i : self.i + 2] == rb"\A":
+            self.i += 2
+            return _Item(anchor="A")
+        if self.data[self.i : self.i + 2] == rb"\Z":
+            # Python-re \Z: absolute end of input (no trailing-\n grace).
+            # \z stays Unsupported — it is a re.error in the oracle.
+            self.i += 2
+            return _Item(anchor="Z")
         if c == ord("("):
             return self._parse_group()
         atom = self._parse_atom()
@@ -409,24 +604,30 @@ class _Parser:
         lo, hi, lazy = quant
         if lazy:
             raise Unsupported("lazy quantifier")
-        # A quantified group that is a single position quantifies that
-        # position directly: (x){2,4}.
-        if item.seq is not None and len(item.seq) == 1 and item.seq[0].pos is not None \
+        # A group that merged to one byte class ((a|b)+) or holds a single
+        # position ((x){2,4}) quantifies that position directly.
+        single = item.pos if item.pos is not None else None
+        if single is None and item.seq is not None and len(item.seq) == 1 \
+                and item.seq[0].pos is not None \
                 and item.seq[0].pos.quant == Quant.ONE \
                 and (item.seq[0].min_rep, item.seq[0].max_rep) == (1, 1):
-            return _Item(pos=item.seq[0].pos, min_rep=lo, max_rep=hi)
+            single = item.seq[0].pos
+        if single is not None and single.quant == Quant.ONE:
+            if (lo, hi) == (0, 1):
+                return _Item(pos=Pos(bytes=single.bytes, quant=Quant.OPT))
+            if (lo, hi) == (0, -1):
+                return _Item(pos=Pos(bytes=single.bytes, quant=Quant.STAR))
+            if (lo, hi) == (1, -1):
+                return _Item(pos=Pos(bytes=single.bytes, quant=Quant.PLUS))
+            return _Item(pos=single, min_rep=lo, max_rep=hi)
         # Multi-position group X{lo,hi}: per-position quantifiers cannot
         # express "skip the whole group" ((abc)? as a?b?c? would wrongly
-        # match "ac"), so rewrite to an alternation of exact repetitions:
-        # X{0,2} -> ( | X | XX ). Unbounded -> Unsupported.
-        if hi == -1:
-            raise Unsupported("unbounded repeat of multi-char group")
-        if hi - lo + 1 > MAX_CROSS_PRODUCT or hi > MAX_REPEAT_EXPANSION:
-            raise Unsupported("repeat expansion too large")
-        branches: list[list[_Item]] = []
-        for k in range(lo, hi + 1):
-            branches.append([item] * k)  # items are read-only downstream
-        return _Item(alts=branches)
+        # match "ac"). Keep it as a quantified sequence; _expand_alts
+        # rewrites it to an alternation of exact repetition counts
+        # (X{0,2} -> ( | X | XX )) with positional context — a repeat
+        # with nothing before it truncates to {lo} by search equivalence.
+        body = item.seq if item.seq is not None else [_Item(alts=item.alts)]
+        return _Item(seq=body, min_rep=lo, max_rep=hi)
 
     def _parse_quant(self, pos: Pos) -> _Item:
         quant = self._peek_quant()

@@ -229,7 +229,13 @@ class AcmeClient:
                     os.makedirs(alpn_dir, exist_ok=True)
                     cert_path = os.path.join(alpn_dir, domain + ".pem")
                     key_path = os.path.join(alpn_dir, domain + ".key")
-                    with open(key_path, "wb") as f:
+                    fd = os.open(key_path,
+                                 os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                                 0o600)
+                    # O_CREAT's mode only applies to NEW files; clamp a
+                    # pre-existing key file's mode too (cert renewals).
+                    os.fchmod(fd, 0o600)
+                    with os.fdopen(fd, "wb") as f:
                         f.write(key_pem)
                     with open(cert_path, "wb") as f:
                         f.write(cert_pem)

@@ -44,6 +44,15 @@ NGHTTP2_FLAG_END_STREAM = 0x1
 NGHTTP2_FRAME_DATA = 0
 NGHTTP2_FRAME_HEADERS = 1
 NGHTTP2_DATA_FLAG_EOF = 0x1
+NGHTTP2_SETTINGS_MAX_CONCURRENT_STREAMS = 3
+# One connection may not park unbounded streams (each buffers up to the body
+# cap): advertise the same 128-stream ceiling the native plane enforces.
+MAX_CONCURRENT_STREAMS = 128
+
+
+class SettingsEntry(Structure):
+    # nghttp2_settings_entry
+    _fields_ = [("settings_id", c_int32), ("value", c_uint32)]
 
 
 class NV(Structure):
@@ -208,7 +217,13 @@ class _Session:
         lib.nghttp2_session_callbacks_del(callbacks)
         if rv != 0:
             raise RuntimeError(f"nghttp2 session init: {rv}")
-        lib.nghttp2_submit_settings(self._session, 0, None, 0)
+        if server:
+            entry = SettingsEntry(NGHTTP2_SETTINGS_MAX_CONCURRENT_STREAMS,
+                                  MAX_CONCURRENT_STREAMS)
+            lib.nghttp2_submit_settings(
+                self._session, 0, ctypes.byref(entry), 1)
+        else:
+            lib.nghttp2_submit_settings(self._session, 0, None, 0)
 
     def close(self) -> None:
         if self._session:

@@ -62,6 +62,12 @@ class NfaTables:
     has_carry: bool = False
     extra_passes: int = 0  # opt-propagation passes beyond the first
     identity_accept: bool = True  # J == P with pair j belonging to slot j
+    # Static word count and atom partition for the packed multi-bank
+    # scan (pack_scan_groups): atoms are maximal carry-chained word runs
+    # [lo, hi) that must stay contiguous inside one lane group (the
+    # cross-word carry shifts between adjacent lanes).
+    num_words: int = 1
+    atoms: tuple[tuple[int, int], ...] = ((0, 1),)
     # Bounded-memory property: every self-loop is a sticky accept
     # accumulator, so the non-accept state at position t depends only on
     # the last `max_footprint` bytes — the precondition for the
@@ -76,7 +82,7 @@ jax.tree_util.register_dataclass(
                  "rep", "carry_mask", "sticky", "accept_word", "accept_mask",
                  "accept_member", "slot_always", "slot_empty_ok"],
     meta_fields=["has_carry", "extra_passes", "identity_accept", "halo_ok",
-                 "max_footprint"],
+                 "max_footprint", "num_words", "atoms"],
 )
 
 
@@ -119,6 +125,15 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
 
     halo_ok = bool(np.all((bank.rep & ~bank.sticky_mask) == 0)) \
         if bank.num_words else True
+    # Atom partition: word w with carry 0 starts a new atom; carry-1
+    # words extend the previous word's span.
+    atoms: list[tuple[int, int]] = []
+    carry_flags = pad(bank.carry_mask)
+    for w in range(W):
+        if carry_flags[w] == 0 or not atoms:
+            atoms.append((w, w + 1))
+        else:
+            atoms[-1] = (atoms[-1][0], w + 1)
     return NfaTables(
         byte_table=jnp.asarray(byte_table),
         init_anchored=jnp.asarray(pad(bank.init_anchored)),
@@ -139,6 +154,8 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
         identity_accept=identity,
         halo_ok=halo_ok,
         max_footprint=int(bank.max_footprint),
+        num_words=W,
+        atoms=tuple(atoms),
     )
 
 
@@ -152,7 +169,9 @@ def scan_chunk(
     """Advance the NFA over one [B, Lc] byte chunk whose first column sits
     at global position `t_offset`; returns the new [B, W] state. Chunks
     compose — the sp ring (parallel/ring.py) passes the state between
-    devices via ppermute.
+    devices via ppermute. `t_offset` may also be a PER-ROW [B] array
+    (the within-device halo split stacks chunks as extra rows, each with
+    its own global offset).
     """
     Lc = data.shape[1]
     one = jnp.uint32(1)
@@ -162,7 +181,9 @@ def scan_chunk(
     lengths = lengths.astype(jnp.int32)
     has_carry = tables.has_carry
     passes = 1 + tables.extra_passes
-    # Only the halo scan passes a (traced, possibly negative) t_offset;
+    per_row = not isinstance(t_offset, int) and getattr(
+        t_offset, "ndim", 0) == 1
+    # Only the halo scans pass (traced, possibly negative) t_offsets;
     # the plain/ring paths pass a non-negative Python int, so the t >= 0
     # warm-up gate stays OUT of their traced hot step.
     t_can_be_negative = not (isinstance(t_offset, int) and t_offset >= 0)
@@ -173,11 +194,18 @@ def scan_chunk(
 
     def step(S, xs):
         c, t_local = xs  # c: [B] uint8
-        t = t_local + t_offset  # global byte position
+        t = t_local + t_offset  # global byte position ([B] when per_row)
         bc = jnp.take(tables.byte_table, c.astype(jnp.int32), axis=0)  # [B, W]
-        inj = jnp.where(t == 0, tables.init_unanchored | tables.init_anchored,
-                        tables.init_unanchored)
-        adv = (S << one) | inj[None, :]
+        if per_row:
+            inj = tables.init_unanchored[None, :] | jnp.where(
+                (t == 0)[:, None], tables.init_anchored[None, :],
+                jnp.uint32(0))
+            adv = (S << one) | inj
+        else:
+            inj = jnp.where(
+                t == 0, tables.init_unanchored | tables.init_anchored,
+                tables.init_unanchored)
+            adv = (S << one) | inj[None, :]
         if has_carry:
             # bit31 of span word w-1 advances into bit0 of word w.
             adv = adv | (shift_words((S >> jnp.uint32(31)) & one) & carry_mask)
@@ -241,3 +269,302 @@ def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array) -> jax.Arra
     state = scan_chunk(
         tables, data, lengths, init_scan_state(B, tables.opt.shape[0]), 0)
     return extract_slots(tables, state, lengths)
+
+
+# -- within-device halo split -------------------------------------------------
+
+
+def halo_split_k(tables: NfaTables, L: int, max_k: int = 8) -> int:
+    """Largest power-of-2 split factor that shortens the scan: k chunks
+    of L/k (+H halo) steps each, valid while the halo fits in a chunk.
+    Returns 1 when splitting is ineligible or not profitable."""
+    if not tables.halo_ok:
+        return 1
+    H = int(tables.max_footprint)
+    best = 1
+    k = 2
+    while k <= max_k and L % k == 0 and H <= L // k:
+        best = k
+        k *= 2
+    # profitable only if strictly fewer steps than the plain scan
+    return best if best > 1 and (L // best + H) < L else 1
+
+
+def halo_split_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array,
+                    k: int) -> jax.Array:
+    """Sequence-split scan WITHIN one device: the length axis is cut into
+    k chunks that become extra BATCH rows, each prefixed by an H-byte
+    halo of its predecessor — the same construction as the sp halo scan
+    (parallel/ring.py halo_nfa_scan) with rows instead of devices. The
+    scan loop shrinks from L to L/k + H serial steps; the accept split
+    is identical: sticky accumulator bits OR across chunks, positional
+    accepts read from the chunk owning each request's final byte.
+    Exact under the same conditions (halo_ok, H <= L/k)."""
+    B, L = data.shape
+    assert L % k == 0
+    Lc = L // k
+    H = int(tables.max_footprint)
+    assert tables.halo_ok and H <= Lc
+    lengths32 = lengths.astype(jnp.int32)
+    padded = jnp.pad(data, ((0, 0), (H, 0)))  # zeros before position 0
+    chunks = jnp.stack(
+        [padded[:, i * Lc:i * Lc + H + Lc] for i in range(k)],
+        axis=1)  # [B, k, H + Lc]
+    rows = chunks.reshape(B * k, H + Lc)
+    row_lens = jnp.broadcast_to(lengths32[:, None], (B, k)).reshape(-1)
+    # Chunk i's first column sits at global byte i*Lc - H (negative
+    # warm-up bytes are live-gated off in scan_chunk, so chunk 0's
+    # zero-prefix is a no-op and t == 0 injection happens exactly once).
+    offs = jnp.broadcast_to(
+        (jnp.arange(k, dtype=jnp.int32) * Lc - H)[None, :], (B, k)
+    ).reshape(-1)
+    state = scan_chunk(tables, rows, row_lens,
+                       init_scan_state(B * k, tables.opt.shape[0]), offs)
+    lanes = jnp.take(state, tables.accept_word, axis=1)  # [B*k, J]
+    lanes = lanes.reshape(B, k, -1)
+    masks = tables.accept_mask[None, None, :]
+    sticky_j = jnp.take(tables.sticky, tables.accept_word)[None, None, :]
+    sticky_hit = ((lanes & masks & sticky_j) != 0).any(axis=1)  # [B, J]
+    owner = jnp.clip((lengths32 - 1) // Lc, 0, k - 1)  # [B]
+    end_lanes = jnp.take_along_axis(
+        lanes, owner[:, None, None], axis=1)[:, 0]  # [B, J]
+    end_hit = (end_lanes & masks[:, 0] & ~sticky_j[:, 0]) != 0
+    return extract_slots(tables, state, lengths32,
+                         pair_hit=sticky_hit | end_hit)
+
+
+# -- packed multi-bank scan ---------------------------------------------------
+#
+# The VPU lane dimension tiles at 128: a bank with W < 128 words pays for
+# 128 lanes anyway, and per-step cost is dominated by the scan loop, not
+# lane width. Packing several fields' words into shared <=128-lane groups
+# (grouped by the fields' trace-time bucketed lengths) turns that padding
+# into useful work: one scan step advances url AND path words instead of
+# two scans advancing each behind a wall of dead lanes. VERDICT r2 item 3.
+
+LANE_GROUP = 128
+
+
+@dataclass(frozen=True)
+class GroupMember:
+    """A contiguous word slice [w_lo, w_hi) of one bank inside a group.
+    Slices are unions of whole atoms, so cross-word carry never crosses
+    a member boundary (the concatenated carry mask is 0 at w_lo)."""
+
+    key: str
+    w_lo: int
+    w_hi: int
+
+
+def pack_scan_groups(
+    sizes: list[tuple[str, int, tuple[tuple[int, int], ...]]],
+    mode: str = "length",
+) -> list[tuple[int, list[GroupMember]]]:
+    """Assign bank words to lane groups. `sizes` is a list of
+    (key, L_bucket, atoms) in a deterministic order; returns
+    [(L_group, members)]. Modes:
+
+      field  — one group per bank (the pre-packing behavior)
+      length — pack banks whose bucketed L is equal into shared groups
+      fill   — sort by L desc, stream-fill groups to 128 lanes (shorter
+               fields ride longer groups' free lanes; their rows are
+               length-masked after their own L)
+      single — everything in one group at max L (no lane cap)
+    """
+    if mode == "field":
+        return [(L, [GroupMember(key, 0, atoms[-1][1] if atoms else 1)])
+                for key, L, atoms in sizes]
+    if mode == "single":
+        Lg = max((L for _, L, _ in sizes), default=0)
+        return [(Lg, [GroupMember(key, 0, atoms[-1][1] if atoms else 1)
+                      for key, _, atoms in sizes])]
+
+    def stream(entries):
+        """First-fit streaming of atoms into <=128-word groups."""
+        groups: list[tuple[int, list[GroupMember]]] = []
+        cur: list[GroupMember] = []
+        cur_w = 0
+        cur_l = 0
+        for key, L, atoms in entries:
+            for lo, hi in atoms:
+                n = hi - lo
+                if cur_w + n > LANE_GROUP and cur:
+                    groups.append((cur_l, cur))
+                    cur, cur_w, cur_l = [], 0, 0
+                if cur and cur[-1].key == key and cur[-1].w_hi == lo:
+                    cur[-1] = GroupMember(key, cur[-1].w_lo, hi)
+                else:
+                    cur.append(GroupMember(key, lo, hi))
+                cur_w += n
+                cur_l = max(cur_l, L)
+        if cur:
+            groups.append((cur_l, cur))
+        return groups
+
+    if mode == "fill":
+        order = sorted(sizes, key=lambda s: (-s[1], s[0]))
+        return stream(order)
+    if mode == "length":
+        out: list[tuple[int, list[GroupMember]]] = []
+        by_len: dict[int, list] = {}
+        for entry in sizes:
+            by_len.setdefault(entry[1], []).append(entry)
+        for L in sorted(by_len, reverse=True):
+            out.extend(stream(by_len[L]))
+        return out
+    raise ValueError(f"unknown pack mode {mode!r}")
+
+
+def _run_group(banks: dict[str, NfaTables], data, lengths, B: int,
+               Lg: int, members: list[GroupMember]) -> jax.Array:
+    """Scan one packed lane group; returns its [B, Wg] final state."""
+    fields: list[str] = []
+    for m in members:
+        if m.key not in fields:
+            fields.append(m.key)
+    fidx = {k: i for i, k in enumerate(fields)}
+
+    def cat(attr):
+        return jnp.concatenate(
+            [getattr(banks[m.key], attr)[m.w_lo:m.w_hi] for m in members])
+
+    init_a, init_u, opt, rep, carry = (
+        cat(a) for a in ("init_anchored", "init_unanchored", "opt", "rep",
+                         "carry_mask"))
+    bts = [banks[m.key].byte_table[:, m.w_lo:m.w_hi] for m in members]
+    Wg = sum(m.w_hi - m.w_lo for m in members)
+    sel = np.concatenate([
+        np.full(m.w_hi - m.w_lo, fidx[m.key], dtype=np.int32)
+        for m in members])
+    has_carry = any(banks[m.key].has_carry for m in members)
+    passes = 1 + max(banks[m.key].extra_passes for m in members)
+
+    feeds = []
+    for k in fields:
+        d = data[k]
+        if d.shape[1] < Lg:
+            d = jnp.pad(d, ((0, 0), (0, Lg - d.shape[1])))
+        feeds.append(d)
+    feed = jnp.stack(feeds, axis=0)  # [F, B, Lg]
+    len_stack = jnp.stack(
+        [lengths[k].astype(jnp.int32) for k in fields], axis=1)  # [B, F]
+    sel_j = jnp.asarray(sel)
+    one = jnp.uint32(1)
+
+    def shift_words(x):
+        return jnp.pad(x[:, :-1], ((0, 0), (1, 0)))
+
+    def step(S, xs):
+        c, t = xs  # c: [F, B] uint8
+        bc = jnp.concatenate(
+            [jnp.take(bts[i], c[fidx[members[i].key]].astype(jnp.int32),
+                      axis=0)
+             for i in range(len(members))], axis=1)  # [B, Wg]
+        inj = jnp.where(t == 0, init_u | init_a, init_u)
+        adv = (S << one) | inj[None, :]
+        if has_carry:
+            adv = adv | (shift_words((S >> jnp.uint32(31)) & one) & carry)
+        for p in range(passes):
+            x = (adv & opt) + opt
+            adv = adv | (x ^ opt)
+            if has_carry and p + 1 < passes:
+                esc = (x < opt).astype(jnp.uint32)
+                adv = adv | (shift_words(esc) & carry)
+        S_new = (adv | (S & rep)) & bc
+        live = jnp.take(t < len_stack, sel_j, axis=1)  # [B, Wg]
+        return jnp.where(live, S_new, S), None
+
+    xs = (jnp.moveaxis(feed, 2, 0), jnp.arange(Lg, dtype=jnp.int32))
+    state, _ = jax.lax.scan(
+        step, jnp.zeros((B, Wg), dtype=jnp.uint32), xs,
+        unroll=8 if Lg >= 8 else 1)
+    return state
+
+
+def _batch_stacked_states(
+    banks: dict[str, NfaTables],
+    data: dict[str, jax.Array],
+    lengths: dict[str, jax.Array],
+) -> dict[str, jax.Array]:
+    """Row-stacking fusion: banks whose bucketed L is equal share ONE
+    scan over the UNION of their words, with their byte batches
+    concatenated along the batch axis. One gather per step (vs one per
+    member field in lane-packing) and half the serial steps for two
+    same-L fields — the trade is lane waste (each row advances every
+    bank's words) against scan-loop latency."""
+    from dataclasses import replace
+
+    B = next(iter(data.values())).shape[0]
+    by_len: dict[int, list[str]] = {}
+    for k in sorted(banks):
+        by_len.setdefault(int(data[k].shape[1]), []).append(k)
+    out: dict[str, jax.Array] = {}
+    for L, keys in by_len.items():
+        if len(keys) == 1:
+            k = keys[0]
+            out[k] = scan_chunk(banks[k], data[k], lengths[k],
+                                init_scan_state(B, banks[k].opt.shape[0]), 0)
+            continue
+        offs = [0]
+        for k in keys:
+            offs.append(offs[-1] + banks[k].opt.shape[0])
+
+        def cat(attr):
+            return jnp.concatenate([getattr(banks[k], attr) for k in keys])
+
+        union = replace(
+            banks[keys[0]],
+            byte_table=jnp.concatenate(
+                [banks[k].byte_table for k in keys], axis=1),
+            init_anchored=cat("init_anchored"),
+            init_unanchored=cat("init_unanchored"),
+            opt=cat("opt"), rep=cat("rep"), carry_mask=cat("carry_mask"),
+            sticky=cat("sticky"),
+            has_carry=any(banks[k].has_carry for k in keys),
+            extra_passes=max(banks[k].extra_passes for k in keys),
+            num_words=offs[-1],
+        )
+        rows = jnp.concatenate([data[k] for k in keys], axis=0)  # [F*B, L]
+        lens = jnp.concatenate(
+            [lengths[k].astype(jnp.int32) for k in keys])
+        state = scan_chunk(union, rows, lens,
+                           init_scan_state(rows.shape[0], offs[-1]), 0)
+        for i, k in enumerate(keys):
+            out[k] = state[i * B:(i + 1) * B, offs[i]:offs[i + 1]]
+    return out
+
+
+def packed_scan_states(
+    banks: dict[str, NfaTables],
+    data: dict[str, jax.Array],
+    lengths: dict[str, jax.Array],
+    mode: str = "length",
+) -> dict[str, jax.Array]:
+    """Run every bank's scan through packed lane groups; returns each
+    bank's final [B, W] state (feed to extract_slots as usual)."""
+    if mode == "field" or len(banks) <= 1:
+        return {
+            k: scan_chunk(t, data[k], lengths[k],
+                          init_scan_state(data[k].shape[0], t.opt.shape[0]), 0)
+            for k, t in banks.items()
+        }
+    if mode == "batch":
+        return _batch_stacked_states(banks, data, lengths)
+    sizes = [(k, int(data[k].shape[1]), banks[k].atoms)
+             for k in sorted(banks)]
+    groups = pack_scan_groups(sizes, mode)
+    B = next(iter(data.values())).shape[0]
+    slices: dict[str, dict[int, jax.Array]] = {k: {} for k in banks}
+    for Lg, members in groups:
+        state = _run_group(banks, data, lengths, B, Lg, members)
+        off = 0
+        for m in members:
+            w = m.w_hi - m.w_lo
+            slices[m.key][m.w_lo] = state[:, off:off + w]
+            off += w
+    out = {}
+    for k in banks:
+        pieces = [slices[k][lo] for lo in sorted(slices[k])]
+        out[k] = pieces[0] if len(pieces) == 1 else jnp.concatenate(
+            pieces, axis=1)
+    return out

@@ -87,19 +87,45 @@ SUPPORTED_PATTERNS = [
     r"abc$",  # trailing-newline $ semantics
     r"^abc$",
     r"ab\nc",
+    # round-3 compiler extensions (VERDICT r2 item 4)
+    r"(abc)+",  # leading repeat truncates by search equivalence
+    r"(abc)*x",
+    r"(abc|def){1,9}",  # leading bounded repeat truncates to {1}
+    r"(\.\./){3,12}etc/(passwd|shadow|group)",  # the CRS LFI staple
+    r"x(ab){2,4}y",  # mid-pattern bounded repeat still enumerates
+    r"(a|b)+c",  # merged class + unbounded quant
+    r"(a|b)*c",
+    r"\ba?bc",  # \b next to optional: case-split on presence
+    r"\bx?yz",
+    r"ab?\bz",
+    r"(?i)\bunion\s+select\b\s*\(",  # mid-\b before \s*
+    r"(?i)\bexec\b\s*=",
+    r"a$\n",  # mid-pattern $: consumes the trailing newline
+    r"a$\s*",  # mid-pattern $ with nullable suffix
+    r"a$b",  # mid-pattern $: statically never matches
+    r"\|\s*id\s*$\s*\(",  # the CRS corpus shape (never matches)
+    r"\|\s*id\s*$\d",
+    r"foo\Z",  # absolute end anchor
+    r"^foo\Z",
+    r"\Afoo",
+    r"a\Z",
+    r"foo\b\Z",  # trailing boundary at absolute end (word last class)
+    r"x=\b\Z",  # non-word last class: statically never matches
+    r"x\.\b$",
 ]
 
 UNSUPPORTED_PATTERNS = [
-    r"(abc)+",  # unbounded multi-char group repeat
+    r"x(abc)+",  # unbounded multi-char group repeat with a prefix
     r"a(?=b)",  # lookahead
     r"(a)\1",  # backreference
     r"a{1,90}" * 2,  # expansion too large even for the multi-word cap
     r"\b(a|\s)x",  # boundary before mixed word/non-word class
-    r"\ba?bc",  # boundary before optional position
     r"a*?",  # lazy
     r"(?s)a.c",  # dotall
     r"(?P<x>ab)",  # named group
-    r"(abc|def){1,9}",  # cross-product expansion too large
+    r"x(abc|def){1,20}y",  # cross-product expansion too large mid-pattern
+    r"foo\z",  # re.error in the oracle — must not compile on device
+    r"a\Bb",  # non-boundary assertion
 ]
 
 
@@ -434,3 +460,181 @@ def test_span_tail_sharing_fuzz():
     # Shuffled order means sharing only occurs when a span precedes
     # the small patterns and no earlier shared word fits them first.
     assert tested_shared >= 10
+
+
+class TestPackedScan:
+    """The packed multi-bank scan (ops/nfa_scan.packed_scan_states) must
+    be bit-identical to the per-field scan in every packing mode — it is
+    the serving hot path behind engine/verdict (VERDICT r2 item 3)."""
+
+    # url/path share L=64 so the length/batch fusion paths actually
+    # fuse; user_agent's L=128 exercises the mixed-length handling.
+    BANKS = {
+        "nfa_url": ([r"(?i)union\s+select", r"\.\./", r"a{40,60}b",
+                     r"etc/passwd", r"(?i)<script", r"x{30}y{30}z{30}"], 64),
+        "nfa_path": ([r"^/(admin|wp-admin)", r"\babc\b", r"eval\(",
+                      r"%3[Cc]", r"k{50,90}"], 64),
+        "nfa_user_agent": ([r"(?i)sqlmap", r"curl/\d"], 128),
+    }
+
+    def _build(self, rng):
+        import jax
+
+        banks = {}
+        datas = {}
+        lens = {}
+        spans = {}
+        from pingoo_tpu.ops.nfa_scan import bank_to_tables
+
+        B = 17
+        alphabet = b"abckwxyz/.<%3CeUNIONunion select admivp-qsqlmap0(d"
+        for key, (sources, L) in self.BANKS.items():
+            patterns = []
+            spans[key] = []
+            for src in sources:
+                alts = compile_regex(src)
+                spans[key].append((src, len(patterns), len(patterns) + len(alts)))
+                patterns.extend(alts)
+            bank = build_bank(patterns)
+            banks[key] = bank_to_tables(bank)
+            data = np.zeros((B, L), dtype=np.uint8)
+            ln = np.zeros(B, dtype=np.int32)
+            specials = [b"", b"union  select", b"../..", b"a" * 45 + b"b",
+                        b"/admin/x", b"xabc ", b"eval(", b"sqlmap",
+                        b"curl/8", b"k" * 60, b"etc/passwd"]
+            for i in range(B):
+                if i < len(specials):
+                    raw = specials[i][:L]
+                else:
+                    raw = bytes(rng.choice(alphabet)
+                                for _ in range(rng.randint(0, L)))
+                data[i, :len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+                ln[i] = len(raw)
+            datas[key] = data
+            lens[key] = ln
+        return banks, datas, lens, spans
+
+    @pytest.mark.parametrize("mode",
+                             ["field", "length", "fill", "single", "batch"])
+    def test_modes_match_per_field_scan(self, mode):
+        import jax
+
+        from pingoo_tpu.ops.nfa_scan import (extract_slots, nfa_scan,
+                                             packed_scan_states)
+
+        rng = random.Random(99)
+        banks, datas, lens, spans = self._build(rng)
+        states = jax.jit(
+            lambda b, d, n: packed_scan_states(b, d, n, mode=mode)
+        )(banks, datas, lens)
+        for key in banks:
+            want = np.asarray(nfa_scan(banks[key], datas[key], lens[key]))
+            got = np.asarray(
+                extract_slots(banks[key], states[key], lens[key]))
+            np.testing.assert_array_equal(want, got, err_msg=f"{mode}:{key}")
+            # and against the re oracle end to end
+            for src, lo, hi in spans[key]:
+                gold = re.compile(src.encode())
+                for i in range(datas[key].shape[0]):
+                    d = bytes(datas[key][i, :lens[key][i]])
+                    assert bool(got[i, lo:hi].any()) == (
+                        gold.search(d) is not None), (mode, key, src, d)
+
+    def test_pack_groups_respect_lane_cap_and_atoms(self):
+        from pingoo_tpu.ops.nfa_scan import LANE_GROUP, pack_scan_groups
+
+        rng = random.Random(5)
+        banks, datas, lens, _ = self._build(rng)
+        sizes = [(k, datas[k].shape[1], banks[k].atoms) for k in sorted(banks)]
+        for mode in ("length", "fill"):
+            groups = pack_scan_groups(sizes, mode)
+            covered = {k: [] for k in banks}
+            for Lg, members in groups:
+                w = sum(m.w_hi - m.w_lo for m in members)
+                assert w <= LANE_GROUP
+                for m in members:
+                    covered[m.key].append((m.w_lo, m.w_hi))
+                    assert Lg >= datas[m.key].shape[1]
+                    # member boundaries sit on atom starts: the first
+                    # word of a member never carries from its neighbor
+                    starts = {lo for lo, _ in banks[m.key].atoms}
+                    assert m.w_lo in starts
+            for k, pieces in covered.items():
+                pieces.sort()
+                assert pieces[0][0] == 0
+                assert pieces[-1][1] == banks[k].num_words
+                for (_, hi), (lo2, _) in zip(pieces, pieces[1:]):
+                    assert hi == lo2
+
+
+class TestHaloSplitScan:
+    """Within-device sequence split (ops/nfa_scan.halo_split_scan) must
+    be bit-identical to the plain scan for bounded-memory banks."""
+
+    def _bank(self, sources):
+        from pingoo_tpu.ops.nfa_scan import bank_to_tables
+
+        patterns = []
+        spans = []
+        for src in sources:
+            alts = compile_regex(src)
+            spans.append((src, len(patterns), len(patterns) + len(alts)))
+            patterns.extend(alts)
+        return bank_to_tables(build_bank(patterns)), spans
+
+    @pytest.mark.parametrize("k", [2, 4, 8])
+    def test_matches_plain_scan(self, k):
+        import jax
+
+        from pingoo_tpu.ops.nfa_scan import halo_split_scan, nfa_scan
+
+        # Bounded-memory shapes only (no bare x+/x* self-loops): every
+        # rep bit must be a sticky accumulator for halo_ok.
+        tables, spans = self._bank([
+            r"(?i)sqlmap", r"curl/\d", r"^Mozilla", r"bot$", r"\bzgrab\b",
+            r"python-requests", r"(?i)nikto", r"a{6}b",
+        ])
+        assert tables.halo_ok
+        L = 128
+        if tables.max_footprint > L // k:
+            pytest.skip("halo exceeds chunk at this k (guarded by "
+                        "halo_split_k in the dispatcher)")
+        rng = random.Random(31)
+        B = 23
+        data = np.zeros((B, L), dtype=np.uint8)
+        lens = np.zeros(B, dtype=np.int32)
+        specials = [b"", b"sqlmap", b"x" * 100 + b"sqlmap", b"curl/8",
+                    b"Mozilla/5.0", b"xMozilla", b"somebot", b"bot x",
+                    b"zgrab scan", b"aaaaaab", b"x" * 120 + b"aaaaaab",
+                    b"python-requests/2", b"NIKTO" + b"y" * 90 + b"bot"]
+        alphabet = b"abcxyz/.Mozilsqmpurt -50bgN"
+        for i in range(B):
+            raw = specials[i] if i < len(specials) else bytes(
+                rng.choice(alphabet) for _ in range(rng.randint(0, L)))
+            raw = raw[:L]
+            data[i, :len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+            lens[i] = len(raw)
+        want = np.asarray(nfa_scan(tables, data, lens))
+        got = np.asarray(jax.jit(
+            lambda t, d, n: halo_split_scan(t, d, n, k))(tables, data, lens))
+        np.testing.assert_array_equal(want, got)
+        # and vs the re oracle
+        for src, lo, hi in spans:
+            gold = re.compile(src.encode())
+            for i in range(B):
+                d = bytes(data[i, :lens[i]])
+                assert bool(got[i, lo:hi].any()) == (
+                    gold.search(d) is not None), (k, src, d)
+
+    def test_split_k_selection(self):
+        from pingoo_tpu.ops.nfa_scan import halo_split_k
+
+        tables, _ = self._bank([r"(?i)sqlmap", r"curl/\d"])
+        assert tables.halo_ok
+        H = tables.max_footprint
+        k = halo_split_k(tables, 128)
+        assert k > 1 and H <= 128 // k and 128 // k + H < 128
+        # unbounded-memory bank never splits
+        nt, _ = self._bank([r"a+b"])
+        assert not nt.halo_ok
+        assert halo_split_k(nt, 128) == 1
