@@ -47,9 +47,33 @@ from ..config.schema import Action
 from ..expr import execute_as_bool
 from ..ops.cidr import cidr_contains, int_set_contains, v4_buckets_contains
 from ..ops.match_ops import eq_match, prefix_match, reverse_bytes, suffix_match
-from ..ops.nfa_scan import nfa_scan
+from ..ops.nfa_scan import (extract_slots, halo_split_k, halo_split_scan,
+                            nfa_scan, packed_scan_states)
 
 I64_MIN = -(2**63)
+
+# Scan layout knobs (measured on the v5e chip, round 3 — see bench.py):
+#
+# PINGOO_SCAN_PACK: lane/row grouping strategy for the NFA scans
+# (ops/nfa_scan.pack_scan_groups / _batch_stacked_states). "field" (one
+# scan per field) measured FASTEST: 1.73M req/s vs "fill" 0.74M and
+# "single" 0.60M — per-step cost is dominated by the per-field byte-
+# class gather, so lane-sharing multiplies gather-steps instead of
+# saving padding; "length"/"batch" are no-ops on the CRS traffic whose
+# fields bucket to distinct lengths. Kept selectable for re-measurement
+# on other topologies.
+#
+# PINGOO_HALO_SPLIT: within-device sequence split for bounded-memory
+# banks (ops/nfa_scan.halo_split_scan) — trades serial steps for batch
+# rows (user_agent: 128 steps -> 52 at 4x rows). Measured a WASH on the
+# v5e (1.316 vs 1.308 ms/batch): per-step cost scales with rows, so the
+# step reduction is spent on row growth. Default off; kept selectable
+# because the trade flips wherever the scan is latency- rather than
+# throughput-bound (e.g. small batches).
+import os as _os
+
+SCAN_PACK_MODE = _os.environ.get("PINGOO_SCAN_PACK", "field")
+HALO_SPLIT = _os.environ.get("PINGOO_HALO_SPLIT", "0") != "0"
 
 
 # -- numeric IR evaluation ---------------------------------------------------
@@ -152,6 +176,29 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
                 tables[key], arrays[f"{field}_bytes"], arrays[f"{field}_len"])
         return nfa_cache[key]
 
+    def run_packed_scans(groups: dict[str, tuple[str, list]]) -> None:
+        """Run every bank's scan through the measured-fastest layout
+        (VERDICT r2 item 3; see the module-level knob notes): per-field
+        scans by default, with bounded-memory banks sequence-split
+        within the device so their serial step count drops from L to
+        L/k + footprint."""
+        banks = {key: tables[key] for key in groups}
+        datas = {key: arrays[f"{groups[key][0]}_bytes"] for key in groups}
+        lens = {key: arrays[f"{groups[key][0]}_len"] for key in groups}
+        if HALO_SPLIT:
+            for key in list(banks):
+                k = halo_split_k(banks[key], int(datas[key].shape[1]))
+                if k > 1:
+                    nfa_cache[key] = halo_split_scan(
+                        banks[key], datas[key], lens[key], k)
+                    del banks[key]
+        if banks:
+            states = packed_scan_states(banks, datas, lens,
+                                        mode=SCAN_PACK_MODE)
+            for key in banks:
+                nfa_cache[key] = extract_slots(
+                    banks[key], states[key], lens[key])
+
     # Per-leaf NFA extraction: leaves own contiguous slot spans; doing a
     # per-leaf slice+any would issue hundreds of tiny ops, so instead one
     # [B, P] x [P, n_leaves] matmul reduces every span at once (MXU does
@@ -178,6 +225,8 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
         if binding.kind == "nfa":
             entry = nfa_groups.setdefault(binding.table_key, (binding.field, []))
             entry[1].append((leaf_id, binding.span))
+    if nfa_groups:
+        run_packed_scans(nfa_groups)
     nfa_leaf_col = {
         leaf_id: (key, j)
         for key, (field, members) in nfa_groups.items()
@@ -324,17 +373,23 @@ def make_verdict_fn(plan: RulesetPlan):
 LANE_NONE = np.int32(2**30)  # "no rule": sorts after every real index
 
 
-def make_lane_fn(plan: RulesetPlan):
+def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None):
     """Jitted device ACTION-LANE reduction: (tables, arrays) ->
-    (first_act_idx [B] i32, first_act_kind [B] i32, first_block_idx [B]
-    i32), all in ORIGINAL rule-index space.
+    [4, B] i32 rows (first_act_idx, first_act_kind, first_block_idx,
+    route), indices in ORIGINAL rule-index space.
 
     This is the transfer-thin form of the verdict for the ring sidecar:
     instead of shipping the [B, R_dev] match matrix off the device
     (half a megabyte per 1k batch — which dominates when the chip sits
     behind a network tunnel), the first-match reduction the action
-    semantics need runs on device and only three [B] lanes return.
-    Host-interpreted rules merge by index afterwards (merge_lanes)."""
+    semantics need runs on device and only four [B] lanes return.
+    Host-interpreted rules merge by index afterwards (merge_lanes).
+
+    `services` (listener service names, in order) adds the ROUTE lane:
+    the first service order whose route pseudo-column matched (the
+    reference's service-selection loop, http_listener.rs:266-270), or
+    LANE_NONE. Services whose route predicate fell back to host
+    interpretation are merged by the sidecar afterwards."""
     device_rules = [r for r in plan.rules if not r.host]
     orig_idx = np.array([r.index for r in device_rules], dtype=np.int32)
     first_kind = np.array(
@@ -343,14 +398,21 @@ def make_lane_fn(plan: RulesetPlan):
     has_act = first_kind != 0
     has_block = np.array([Action.BLOCK in r.actions for r in device_rules],
                          dtype=bool)
+    dev_route: list[tuple[int, int]] = []  # (service order, matched column)
+    if services:
+        col_of_rule = {r.index: j for j, r in enumerate(device_rules)}
+        for order, name in enumerate(services):
+            ridx = plan.route_index.get(name)
+            if ridx is not None and ridx in col_of_rule:
+                dev_route.append((order, col_of_rule[ridx]))
 
     @jax.jit
     def lanes(tables, arrays):
         matched = _matched_cols(plan, tables, arrays)  # [B, C]
-        B = matched.shape[0]
+        B = arrays["asn"].shape[0]
+        none = jnp.full((B,), LANE_NONE, dtype=jnp.int32)
         if matched.shape[1] == 0:
-            none = jnp.full((B,), LANE_NONE, dtype=jnp.int32)
-            return jnp.stack([none, jnp.zeros((B,), jnp.int32), none])
+            return jnp.stack([none, jnp.zeros((B,), jnp.int32), none, none])
         idx = jnp.asarray(orig_idx)[None, :]
         act_idx = jnp.where(matched & jnp.asarray(has_act)[None, :], idx,
                             LANE_NONE)
@@ -361,8 +423,16 @@ def make_lane_fn(plan: RulesetPlan):
         blk_idx = jnp.where(matched & jnp.asarray(has_block)[None, :], idx,
                             LANE_NONE)
         first_block_idx = jnp.min(blk_idx, axis=1)
-        # One stacked [3, B] array = ONE device->host transfer.
-        return jnp.stack([first_act_idx, kind, first_block_idx])
+        if dev_route:
+            cols = jnp.asarray([c for _, c in dev_route], dtype=jnp.int32)
+            orders = jnp.asarray([o for o, _ in dev_route], dtype=jnp.int32)
+            rm = jnp.take(matched, cols, axis=1)  # [B, S_dev]
+            route = jnp.min(jnp.where(rm, orders[None, :], LANE_NONE),
+                            axis=1).astype(jnp.int32)
+        else:
+            route = none
+        # One stacked [4, B] array = ONE device->host transfer.
+        return jnp.stack([first_act_idx, kind, first_block_idx, route])
 
     return lanes
 

@@ -504,14 +504,25 @@ struct BodyFramer {
             // Chunk size must be plain hex (extensions after ';' are
             // tolerated); a leading '-' or garbage would make
             // `remaining` negative and the cast in kData wrap to ~2^64.
-            char first = linebuf.empty() ? 0 : linebuf[0];
-            bool hex_start = (first >= '0' && first <= '9') ||
-                             (first >= 'a' && first <= 'f') ||
-                             (first >= 'A' && first <= 'F');
-            long long sz = hex_start ? strtoll(linebuf.c_str(), nullptr, 16)
-                                     : -1;
+            // Every byte of the size field before ';' (extension) or CRLF
+            // must be hex — strtoll would silently stop at garbage like
+            // "1x3" and desync framing against a strict upstream.
+            size_t hex_len = 0;
+            while (hex_len + 2 < linebuf.size()) {
+              char hc = linebuf[hex_len];
+              bool is_hex = (hc >= '0' && hc <= '9') ||
+                            (hc >= 'a' && hc <= 'f') ||
+                            (hc >= 'A' && hc <= 'F');
+              if (!is_hex) break;
+              ++hex_len;
+            }
+            bool valid_size =
+                hex_len > 0 &&
+                (hex_len + 2 == linebuf.size() || linebuf[hex_len] == ';');
+            long long sz = valid_size ? strtoll(linebuf.c_str(), nullptr, 16)
+                                      : -1;
             linebuf.clear();
-            if (!hex_start || sz < 0 || sz > (1LL << 40)) {
+            if (!valid_size || sz < 0 || sz > (1LL << 40)) {
               bad = true;
               done = true;
               return used;
@@ -538,6 +549,12 @@ struct BodyFramer {
           linebuf.push_back(c);
           ++used;
           if (linebuf.size() == 2) {
+            if (linebuf != "\r\n") {  // chunk data must end with exact CRLF
+              bad = true;
+              done = true;
+              linebuf.clear();
+              return used;
+            }
             linebuf.clear();
             cstate = kSize;
           }
@@ -770,6 +787,15 @@ struct RespHead {
   bool ok = false;
 };
 
+// Response headers this proxy never forwards downstream: hop-by-hop
+// headers plus upstream identity/behavior headers (reference
+// http_proxy_service.rs:37-43,197-201). One predicate shared by final
+// and interim (1xx) head rewriting so the strip policy cannot diverge.
+bool strip_response_header(const std::string& lname) {
+  return is_hop_header(lname) || lname == "server" ||
+         lname == "x-accel-buffering" || lname == "alt-svc";
+}
+
 // Rewrite the upstream response head for the client: strip hop-by-hop
 // headers and upstream server identity, set server: pingoo (reference
 // http_proxy_service.rs:37-43,197-201), and pin the connection header
@@ -804,8 +830,7 @@ RespHead rewrite_response_head(const std::string& head, bool client_keep) {
     } else if (lname == "content-length") {
       r.content_length = strtoll(value.c_str(), nullptr, 10);
       out.append(head, pos, eol + 2 - pos);
-    } else if (is_hop_header(lname) || lname == "server" ||
-               lname == "x-accel-buffering" || lname == "alt-svc") {
+    } else if (strip_response_header(lname)) {
       // dropped
     } else {
       out.append(head, pos, eol + 2 - pos);
@@ -821,6 +846,30 @@ RespHead rewrite_response_head(const std::string& head, bool client_keep) {
   r.rewritten = out;
   r.ok = true;
   return r;
+}
+
+// Rewrite a 1xx interim head with the same hop-header/server-identity
+// stripping as final responses (keeping the status line; interim heads
+// carry no body framing or connection semantics of their own).
+std::string rewrite_interim_head(const std::string& head) {
+  size_t line_end = head.find("\r\n");
+  if (line_end == std::string::npos) return head;
+  std::string out = head.substr(0, line_end) + "\r\n";
+  size_t pos = line_end + 2;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos || eol == pos) break;
+    size_t colon = head.find(':', pos);
+    std::string lname = colon != std::string::npos && colon < eol
+                            ? lower(head.substr(pos, colon - pos))
+                            : "";
+    if (!strip_response_header(lname)) {
+      out.append(head, pos, eol + 2 - pos);
+    }
+    pos = eol + 2;
+  }
+  out += "\r\n";
+  return out;
 }
 
 // ---------------------------------------------------------------------------
@@ -896,6 +945,7 @@ struct Conn {
 
 class Server;
 Server* g_server = nullptr;
+volatile sig_atomic_t g_sigterm = 0;
 
 const char k403[] =
     "HTTP/1.1 403 Forbidden\r\nserver: pingoo\r\n"
@@ -912,20 +962,191 @@ const char k502[] =
 const char k400[] =
     "HTTP/1.1 400 Bad Request\r\nserver: pingoo\r\n"
     "content-length: 0\r\nconnection: close\r\n\r\n";
+const char k404[] =
+    "HTTP/1.1 404 Not Found\r\nserver: pingoo\r\n"
+    "content-type: text/plain\r\ncontent-length: 9\r\n"
+    "connection: close\r\n\r\nNot Found";
+
+// -- service routing table ---------------------------------------------------
+//
+// The reference selects the FIRST service whose route predicate matches
+// the request and load-balances across that service's discovered
+// upstreams (http_listener.rs:266-270, http_proxy_service.rs:101,118,
+// service_registry.rs:54-103). Here the route decision is computed by
+// the verdict sidecar ON DEVICE (the route predicates ride the same
+// batched verdict as the WAF rules) and arrives in the verdict byte's
+// bits 3-7: the winning service's order index, 31 = no service matched.
+// This plane owns only the dispatch: service order -> upstream set ->
+// random member.
+//
+// The table is a text file written by the control plane (registry
+// snapshots, native_ring.write_services_file) and hot-reloaded on
+// mtime change, the same freshness discipline as the JWKS gate:
+//
+//   pingoo-services v1
+//   service 0 web
+//   upstream 127.0.0.1 8081
+//   upstream 127.0.0.1 8082
+//   service 1 api
+//   upstream 127.0.0.1 9001
+struct ServiceTable {
+  std::string path;
+  std::vector<std::string> names;
+  std::vector<std::vector<sockaddr_in>> upstreams;  // by service order
+  bool loaded = false;
+  time_t last_check_ = 0;
+  time_t mtime_s_ = 0;
+  long mtime_ns_ = 0;
+
+  bool reload() {
+    struct stat st;
+    if (path.empty() || stat(path.c_str(), &st) != 0) return loaded;
+    if (loaded && st.st_mtime == mtime_s_ &&
+        st.st_mtim.tv_nsec == mtime_ns_)
+      return true;
+    FILE* f = fopen(path.c_str(), "r");
+    if (f == nullptr) return loaded;
+    std::vector<std::string> new_names;
+    std::vector<std::vector<sockaddr_in>> new_ups;
+    char line[512];
+    bool ok = true;
+    while (fgets(line, sizeof(line), f) != nullptr) {
+      char a[256], b[256];
+      int port = 0, order = 0;
+      if (sscanf(line, "service %d %255s", &order, a) == 2) {
+        if (order != static_cast<int>(new_names.size()) || order > 30) {
+          // Orders must be dense and in file order, and fit the 5-bit
+          // route field (0-30; 31 is the no-match sentinel).
+          ok = false;
+          break;
+        }
+        new_names.emplace_back(a);
+        new_ups.emplace_back();
+      } else if (sscanf(line, "upstream %255s %d", b, &port) == 2) {
+        if (new_ups.empty() || port <= 0 || port > 65535) {
+          ok = false;
+          break;
+        }
+        sockaddr_in sa{};
+        sa.sin_family = AF_INET;
+        sa.sin_port = htons(static_cast<uint16_t>(port));
+        if (inet_pton(AF_INET, b, &sa.sin_addr) != 1) {
+          ok = false;
+          break;
+        }
+        new_ups.back().push_back(sa);
+      }
+      // other lines (header, comments, blank) are ignored
+    }
+    fclose(f);
+    if (!ok || new_names.empty()) return loaded;  // keep last good table
+    names = std::move(new_names);
+    upstreams = std::move(new_ups);
+    loaded = true;
+    mtime_s_ = st.st_mtime;
+    mtime_ns_ = st.st_mtim.tv_nsec;
+    return true;
+  }
+
+  void maybe_reload(time_t now) {
+    if (path.empty() || now == last_check_) return;
+    last_check_ = now;
+    reload();
+  }
+};
 
 class Server {
  public:
   Server(int ep, void* ring, const sockaddr_in& upstream,
          const sockaddr_in* captcha_upstream, CaptchaGate* gate,
-         TlsStore* tls)
+         TlsStore* tls, ServiceTable* services = nullptr)
       : ep_(ep),
         ring_(ring),
         upstream_(upstream),
         gate_(gate),
-        tls_(tls) {
+        tls_(tls),
+        services_(services) {
     if (captcha_upstream) {
       captcha_upstream_ = *captcha_upstream;
       has_captcha_upstream_ = true;
+    }
+  }
+
+  // -- service routing -------------------------------------------------------
+
+  enum class Route { kOk, kNoService, kNoUpstream };
+
+  // Resolve the verdict byte's route bits (bits 3-7: service order,
+  // 31 = none matched) to a concrete upstream address. Without a
+  // services table every request goes to the single argv upstream
+  // (the pre-routing deployment shape).
+  Route pick_route_target(uint8_t route, sockaddr_in* out) {
+    if (services_ == nullptr || !services_->loaded) {
+      *out = upstream_;
+      return Route::kOk;
+    }
+    if (route >= services_->upstreams.size()) return Route::kNoService;
+    const auto& set = services_->upstreams[route];
+    if (set.empty()) return Route::kNoUpstream;
+    // xorshift32: cheap per-request random member selection, matching
+    // the reference's random upstream pick (http_proxy_service.rs:101).
+    rng_ ^= rng_ << 13;
+    rng_ ^= rng_ >> 17;
+    rng_ ^= rng_ << 5;
+    *out = set[rng_ % set.size()];
+    return Route::kOk;
+  }
+
+  // Fail-open target (ring full / verdict timeout): no route decision
+  // exists, so fall back to the FIRST service — the same default the
+  // argv upstream provides without a table.
+  bool default_target(sockaddr_in* out) {
+    if (services_ == nullptr || !services_->loaded) {
+      *out = upstream_;
+      return true;
+    }
+    if (!services_->upstreams.empty() && !services_->upstreams[0].empty()) {
+      return pick_route_target(0, out) == Route::kOk;
+    }
+    return false;
+  }
+
+  void dispatch_route(Conn* c, uint8_t route) {
+    bool h2 = c->state == ConnState::kH2;
+    sockaddr_in target{};
+    switch (pick_route_target(route, &target)) {
+      case Route::kOk:
+        start_proxy(c, target);
+        return;
+      case Route::kNoService:
+        // Reference: no service matched -> 404 (http_listener.rs:270).
+        if (h2) {
+          h2_respond_simple(c, c->h2_active, 404, "Not Found");
+          h2_flush(c);
+        } else {
+          respond_close(c, k404);
+        }
+        return;
+      case Route::kNoUpstream:
+        if (h2) {
+          h2_respond_simple(c, c->h2_active, 502, "Bad Gateway");
+          h2_flush(c);
+        } else {
+          respond_502(c);
+        }
+        return;
+    }
+  }
+
+  void fail_open_proxy(Conn* c) {
+    sockaddr_in target{};
+    if (default_target(&target)) {
+      start_proxy(c, target);
+    } else if (c->state == ConnState::kH2) {
+      h2_respond_simple(c, c->h2_active, 502, "Bad Gateway");
+      h2_flush(c);
+    } else {
+      respond_502(c);
     }
   }
 
@@ -995,6 +1216,32 @@ class Server {
 
   void set_now(time_t t) { now_ = t; }
 
+  // -- graceful drain --------------------------------------------------------
+  // SIGTERM stops accepting and drains in-flight requests with a hard
+  // cap (reference drains with a 20 s limit, listeners/mod.rs:28 +
+  // http_listener.rs:111-116). main() owns the drain state and calls
+  // this every loop iteration once the listener is closed.
+
+  // Close connections with no request in flight; returns live count.
+  // Busy connections finish their response, return to kReadingHead,
+  // and are collected on the next tick.
+  size_t drain_tick() {
+    for (Conn* c : conns_) {
+      if (c->dead) continue;
+      if (c->state == ConnState::kReadingHead && c->inbuf.empty() &&
+          c->outbuf.empty())
+        mark_close(c);
+      else if (c->state == ConnState::kH2 && c->h2_active == 0 &&
+               c->h2_ready.empty() && c->outbuf.empty())
+        // Idle h2 connection: no stream being serviced or queued. An
+        // abrupt close (no GOAWAY) is within spec for shutdown; clients
+        // retry idempotent requests on a fresh connection.
+        mark_close(c);
+    }
+    flush_doomed();
+    return conns_.size();
+  }
+
   void sweep_idle() {
     for (Conn* c : conns_) {
       if (c->dead) continue;
@@ -1010,7 +1257,7 @@ class Server {
           // OPEN like the ring-full path (pingoo/rules.rs:41-44).
           if (idle > kVerdictTimeoutS) {
             drop_ticket(c);
-            start_proxy(c, upstream_);
+            fail_open_proxy(c);
           }
           break;
         case ConnState::kProxying:
@@ -1023,7 +1270,7 @@ class Server {
           if (c->ticket != UINT64_MAX &&
               now_ - c->verdict_at > kVerdictTimeoutS) {
             drop_ticket(c);
-            start_proxy(c, upstream_);
+            fail_open_proxy(c);
           }
           if (idle > kProxyIdleTimeoutS) mark_close(c);
           break;
@@ -1287,7 +1534,7 @@ class Server {
         respond_close(c, kCaptcha);
       }
     } else {
-      start_proxy(c, upstream_);
+      dispatch_route(c, (action >> 3) & 0x1f);
     }
   }
 
@@ -2010,7 +2257,9 @@ class Server {
           return;
         }
         if (rh.status >= 100 && rh.status < 200) {
-          c->outbuf += head;  // interim: forward as-is, keep parsing
+          // interim: strip hop/identity headers like final heads, keep
+          // the 1xx status line, keep parsing for the final head
+          c->outbuf += rewrite_interim_head(head);
           c->resp_head_buf.erase(0, he + 4);
           continue;
         }
@@ -2182,6 +2431,8 @@ class Server {
   bool has_captcha_upstream_ = false;
   CaptchaGate* gate_;
   TlsStore* tls_;
+  ServiceTable* services_ = nullptr;
+  uint32_t rng_ = 0x9e3779b9;  // xorshift32 state for upstream choice
   std::unordered_set<Conn*> conns_;
   std::unordered_map<uint64_t, Conn*> awaiting_;
   std::unordered_map<SSL*, Conn*> ssl_conn_;
@@ -2290,7 +2541,8 @@ int main(int argc, char** argv) {
     std::fprintf(stderr,
                  "usage: %s <listen-port> <ring-file> <upstream-host> "
                  "<upstream-port> [--captcha-upstream host:port] "
-                 "[--jwks path] [--tls-dir dir] [--alpn-dir dir]\n",
+                 "[--jwks path] [--tls-dir dir] [--alpn-dir dir] "
+                 "[--services path] [--bind addr]\n",
                  argv[0]);
     return 2;
   }
@@ -2303,6 +2555,8 @@ int main(int argc, char** argv) {
   const char* jwks_path = nullptr;
   const char* tls_dir = nullptr;
   const char* alpn_dir = nullptr;
+  const char* services_path = nullptr;
+  const char* bind_addr = nullptr;
   sockaddr_in captcha_upstream{};
   bool has_captcha = false;
   for (int i = 5; i + 1 < argc; i += 2) {
@@ -2318,6 +2572,10 @@ int main(int argc, char** argv) {
       tls_dir = argv[i + 1];
     } else if (strcmp(argv[i], "--alpn-dir") == 0) {
       alpn_dir = argv[i + 1];
+    } else if (strcmp(argv[i], "--services") == 0) {
+      services_path = argv[i + 1];
+    } else if (strcmp(argv[i], "--bind") == 0) {
+      bind_addr = argv[i + 1];
     }
   }
 
@@ -2379,13 +2637,26 @@ int main(int argc, char** argv) {
     for (auto& kv : tls_store.wildcard) install(kv.second);
   }
 
+  ServiceTable services;
+  if (services_path != nullptr) {
+    services.path = services_path;
+    services.reload();  // absent file is fine: table loads when written
+  }
+
   int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   int one = 1;
   setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   setsockopt(lfd, SOL_SOCKET, SO_REUSEPORT, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
+  // Default bind stays loopback (the co-located control-plane shape);
+  // --bind makes the native plane the public front door.
   addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (bind_addr != nullptr &&
+      inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1) {
+    std::fprintf(stderr, "bad --bind address %s\n", bind_addr);
+    return 2;
+  }
   addr.sin_port = htons(static_cast<uint16_t>(listen_port));
   if (bind(lfd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
       listen(lfd, 2048) != 0) {
@@ -2400,12 +2671,23 @@ int main(int argc, char** argv) {
   epoll_ctl(ep, EPOLL_CTL_ADD, lfd, &ev);
 
   Server server(ep, ring, upstream, has_captcha ? &captcha_upstream : nullptr,
-                &gate, tls_dir ? &tls_store : nullptr);
+                &gate, tls_dir ? &tls_store : nullptr,
+                services_path ? &services : nullptr);
   g_server = &server;
-  std::printf("{\"listening\": %d, \"tls\": %s}\n", listen_port,
-              tls_dir ? "true" : "false");
+  // SIGTERM starts a graceful drain: stop accepting, finish in-flight
+  // requests, exit when idle or after the 20 s cap (the reference's
+  // drain bound, listeners/mod.rs:28 + http_listener.rs:111-116).
+  struct sigaction sa {};
+  sa.sa_handler = [](int) { g_sigterm = 1; };
+  sigaction(SIGTERM, &sa, nullptr);
+  std::printf("{\"listening\": %d, \"tls\": %s, \"services\": %s}\n",
+              listen_port, tls_dir ? "true" : "false",
+              services_path ? "true" : "false");
   std::fflush(stdout);
 
+  constexpr time_t kDrainCapS = 20;
+  bool draining = false;
+  time_t drain_start = 0;
   time_t last_sweep = time(nullptr);
   while (true) {
     epoll_event events[256];
@@ -2415,8 +2697,19 @@ int main(int argc, char** argv) {
     server.set_now(now);
     server.drain_verdicts();
 
+    if (g_sigterm && !draining) {
+      draining = true;
+      drain_start = now;
+      epoll_ctl(ep, EPOLL_CTL_DEL, lfd, nullptr);
+      close(lfd);
+      lfd = -1;
+      std::printf("{\"draining\": true}\n");
+      std::fflush(stdout);
+    }
+
     for (int i = 0; i < n; ++i) {
       if (events[i].data.ptr == nullptr) {
+        if (lfd < 0) continue;  // stale accept event during drain
         while (true) {
           sockaddr_in peer{};
           socklen_t plen = sizeof(peer);
@@ -2433,9 +2726,18 @@ int main(int argc, char** argv) {
       server.handle(ref->conn, ref->is_upstream, events[i].events);
     }
     server.flush_doomed();
+    if (draining) {
+      size_t live = server.drain_tick();
+      if (live == 0 || now - drain_start >= kDrainCapS) {
+        std::printf("{\"drained\": true, \"remaining\": %zu}\n", live);
+        std::fflush(stdout);
+        return 0;
+      }
+    }
     if (now != last_sweep) {
       server.sweep_idle();
       server.flush_doomed();
+      services.maybe_reload(now);
       last_sweep = now;
     }
   }

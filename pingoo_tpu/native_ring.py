@@ -185,11 +185,33 @@ def slots_to_arrays(slots: np.ndarray) -> dict:
     return arrays
 
 
+def write_services_file(path: str, services: list) -> None:
+    """Publish the native plane's routing table: `services` is the
+    listener's ordered [(name, [(ip, port), ...])] — typically registry
+    snapshots (host/discovery.ServiceRegistry.get_upstreams). Written
+    atomically (tmp + rename) so the C++ reader (httpd.cc ServiceTable)
+    never observes a partial table; it hot-reloads on mtime change."""
+    if len(services) > 31:
+        raise ValueError(
+            f"native routing supports at most 31 services (5-bit route "
+            f"field, 31 = no match), got {len(services)}")
+    lines = ["pingoo-services v1"]
+    for order, (name, ups) in enumerate(services):
+        lines.append(f"service {order} {name}")
+        for ip, port in ups:
+            lines.append(f"upstream {ip} {port}")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    os.replace(tmp, path)
+
+
 class RingSidecar:
     """Drain loop: ring batches -> jitted verdict -> verdict ring."""
 
     def __init__(self, ring: Ring, plan, lists, max_batch: int = 1024,
-                 idle_sleep_s: float = 0.0002, pipeline_depth: int = 3):
+                 idle_sleep_s: float = 0.0002, pipeline_depth: int = 3,
+                 services: Optional[list] = None):
         from .engine.verdict import make_lane_fn
 
         self.ring = ring
@@ -203,10 +225,31 @@ class RingSidecar:
         # behind a network tunnel) behind the next batch's host work.
         self.pipeline_depth = max(1, pipeline_depth)
         # The sidecar uses the transfer-thin lane reduction — the
-        # first-match action decision computes ON DEVICE and only three
+        # first-match action decision computes ON DEVICE and only four
         # int32 lanes come back, not the [B, R] match matrix (which
         # dominated per-batch time through a network tunnel).
-        self._lane_fn = make_lane_fn(plan)
+        # `services` (the native listener's service names, in order)
+        # adds the ROUTE lane so the C++ plane can dispatch each request
+        # to the right service's upstream set (verdict byte bits 3-7).
+        self.services = list(services) if services else None
+        if self.services and len(self.services) > 31:
+            # The verdict byte's route field is 5 bits: orders 0-30 plus
+            # the no-match sentinel 31. More services would alias the
+            # sentinel onto a real service and invert no-match into
+            # proxy-to-last-service.
+            raise ValueError(
+                f"native routing supports at most 31 services, "
+                f"got {len(self.services)}")
+        self._lane_fn = make_lane_fn(plan, services=self.services)
+        # Services whose route predicate fell back to host interpretation
+        # are merged into the device route lane per batch.
+        self._host_routes: list[tuple[int, object]] = []
+        if self.services:
+            by_index = {r.index: r for r in plan.rules}
+            for order, name in enumerate(self.services):
+                ridx = plan.route_index.get(name)
+                if ridx is not None and by_index[ridx].host:
+                    self._host_routes.append((order, by_index[ridx].program))
         self._tables = plan.device_tables()
         self.processed = 0
         self.truncated_rows = 0
@@ -276,8 +319,34 @@ class RingSidecar:
         # Verdict byte carries BOTH client-state lanes (the reference
         # action loop diverges for captcha-verified clients,
         # http_listener.rs:251-264): bits 0-1 = unverified action
-        # (0 none / 1 block / 2 captcha), bit 2 = verified-block.
+        # (0 none / 1 block / 2 captcha), bit 2 = verified-block, and —
+        # when this sidecar routes for a native listener — bits 3-7 =
+        # the first matching service's order (31 = no service matched,
+        # reference service-selection loop http_listener.rs:266-270).
         actions = unverified | (verified_block.astype(np.int32) << 2)
+        if self.services is not None:
+            route = np.asarray(dev_lanes[3], dtype=np.int64).copy()
+            if self._host_routes:
+                from .engine.batch import batch_to_contexts
+                from .expr import execute_as_bool
+
+                contexts = None
+                for order, prog in self._host_routes:
+                    better = route > order
+                    if not better.any():
+                        continue
+                    if contexts is None:
+                        contexts = batch_to_contexts(raw_batch, self.lists)
+                    for i in np.nonzero(better)[0]:
+                        try:
+                            hit = prog is None or execute_as_bool(
+                                prog, contexts[i])
+                        except Exception:
+                            hit = False  # route errors fail to no-match
+                        if hit:
+                            route[i] = order
+            route_bits = np.minimum(route, 31).astype(np.int32)
+            actions = actions | (route_bits << 3)
         tickets = slots["ticket"]
         for i in range(n):
             while not self.ring.post_verdict(int(tickets[i]), int(actions[i])):

@@ -64,16 +64,18 @@ class NativeStack:
     """native httpd + ring sidecar + plain upstream (+ optional extras)."""
 
     def __init__(self, tmp, rules, lists=None, jwks=None, captcha_port=None,
-                 tls_dir=None, alpn_dir=None):
+                 tls_dir=None, alpn_dir=None, routes=None, services=None):
         from pingoo_tpu.compiler import compile_ruleset
 
         self.upstream = http.server.HTTPServer(("127.0.0.1", 0), _Upstream)
         threading.Thread(target=self.upstream.serve_forever,
                          daemon=True).start()
-        plan = compile_ruleset(rules, lists or {})
+        plan = compile_ruleset(rules, lists or {}, routes=routes)
         self.ring_path = str(tmp / "ring")
         self.ring = Ring(self.ring_path, capacity=1024, create=True)
-        self.sidecar = RingSidecar(self.ring, plan, lists or {}, max_batch=64)
+        self.sidecar = RingSidecar(
+            self.ring, plan, lists or {}, max_batch=64,
+            services=[name for name, _ in routes] if routes else None)
         threading.Thread(target=self.sidecar.run, daemon=True).start()
         self.port = _free_port()
         argv = [HTTPD, str(self.port), self.ring_path, "127.0.0.1",
@@ -86,6 +88,11 @@ class NativeStack:
             argv += ["--tls-dir", tls_dir]
         if alpn_dir:
             argv += ["--alpn-dir", alpn_dir]
+        self.services_path = None
+        if services is not None:
+            self.services_path = str(tmp / "services.tbl")
+            native_ring.write_services_file(self.services_path, services)
+            argv += ["--services", self.services_path]
         self.proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
                                      stderr=subprocess.PIPE)
         line = self.proc.stdout.readline()
@@ -909,3 +916,175 @@ class TestNativeH2TruncatedUpstream:
             lsock.close()
             sidecar.stop()
             ring.close()
+
+
+class _TaggedUpstream(http.server.BaseHTTPRequestHandler):
+    """Echoes its server's tag so routing tests can see which upstream
+    serviced the request."""
+
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):
+        delay = getattr(self.server, "delay_s", 0)
+        if delay:
+            time.sleep(delay)
+        body = f"{self.server.tag}:{self.path}".encode()
+        self.send_response(200)
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+def _tagged_upstream(tag, delay_s=0):
+    srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _TaggedUpstream)
+    srv.tag = tag
+    srv.delay_s = delay_s
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv
+
+
+class TestNativeRouting:
+    """VERDICT r2 item 1: the native plane as the front door — per-request
+    service routing from the verdict byte's route bits, registry-fed
+    multi-upstream with hot reload, and SIGTERM drain. Reference:
+    http_listener.rs:266-270 (first matching service),
+    http_proxy_service.rs:101,118 (random upstream), listeners/mod.rs:28
+    (drain cap)."""
+
+    def _routes(self):
+        from pingoo_tpu.expr import compile_expression
+
+        return [("api", compile_expression(
+                    'http_request.path.starts_with("/api")')),
+                ("web", None)]  # no route -> match-all fallback
+
+    def _get(self, port, path, timeout=8.0):
+        payload = (f"GET {path} HTTP/1.1\r\nhost: t.test\r\n"
+                   "user-agent: routed/1.0\r\nconnection: close\r\n\r\n")
+        return raw_request(port, payload.encode())
+
+    def _get_until(self, port, path, want: bytes, tries=25):
+        """Retry until routing reflects `want` (first requests may fail
+        open to service 0 while the sidecar's first batch compiles)."""
+        out = b""
+        for _ in range(tries):
+            out = self._get(port, path)
+            if want in out:
+                return out
+            time.sleep(0.4)
+        return out
+
+    def test_two_services_routed_and_hot_swapped(self, tmp_path):
+        a = _tagged_upstream("svc-a")
+        b = _tagged_upstream("svc-b")
+        c = _tagged_upstream("svc-c")
+        services = [("api", [("127.0.0.1", a.server_address[1])]),
+                    ("web", [("127.0.0.1", b.server_address[1])])]
+        stack = NativeStack(tmp_path, rules=[], routes=self._routes(),
+                            services=services)
+        try:
+            out = self._get_until(stack.port, "/api/v1", b"svc-a")
+            assert b"svc-a:/api/v1" in out, out[:200]
+            out = self._get(stack.port, "/index.html")
+            assert b"svc-b:/index.html" in out, out[:200]
+            # hot swap: the registry repoints api at svc-c; the C++ plane
+            # reloads the table on mtime change without restarting
+            native_ring.write_services_file(
+                stack.services_path,
+                [("api", [("127.0.0.1", c.server_address[1])]),
+                 ("web", [("127.0.0.1", b.server_address[1])])])
+            out = self._get_until(stack.port, "/api/v2", b"svc-c")
+            assert b"svc-c:/api/v2" in out, out[:200]
+            # web unaffected by the swap
+            out = self._get(stack.port, "/w")
+            assert b"svc-b:/w" in out, out[:200]
+        finally:
+            stack.stop()
+            for srv in (a, b, c):
+                srv.shutdown()
+
+    def test_random_upstream_choice_spreads(self, tmp_path):
+        a1 = _tagged_upstream("m1")
+        a2 = _tagged_upstream("m2")
+        services = [("api", [("127.0.0.1", a1.server_address[1]),
+                             ("127.0.0.1", a2.server_address[1])]),
+                    ("web", [("127.0.0.1", a1.server_address[1])])]
+        stack = NativeStack(tmp_path, rules=[], routes=self._routes(),
+                            services=services)
+        try:
+            self._get_until(stack.port, "/api/x", b"m")
+            seen = set()
+            for _ in range(40):
+                out = self._get(stack.port, "/api/x")
+                if b"m1:" in out:
+                    seen.add("m1")
+                if b"m2:" in out:
+                    seen.add("m2")
+                if len(seen) == 2:
+                    break
+            assert seen == {"m1", "m2"}, seen
+        finally:
+            stack.stop()
+            a1.shutdown()
+            a2.shutdown()
+
+    def test_no_matching_service_404(self, tmp_path):
+        from pingoo_tpu.expr import compile_expression
+
+        a = _tagged_upstream("only")
+        routes = [("api", compile_expression(
+            'http_request.path.starts_with("/api")'))]
+        services = [("api", [("127.0.0.1", a.server_address[1])])]
+        stack = NativeStack(tmp_path, rules=[], routes=routes,
+                            services=services)
+        try:
+            out = self._get_until(stack.port, "/api/ok", b"only")
+            assert b"only:/api/ok" in out
+            out = self._get(stack.port, "/nope")
+            assert out.split(b"\r\n")[0].endswith(b"404 Not Found"), out[:80]
+        finally:
+            stack.stop()
+            a.shutdown()
+
+    def test_sigterm_drains_in_flight_request(self, tmp_path):
+        import signal
+
+        slow = _tagged_upstream("slow", delay_s=1.0)
+        services = [("api", [("127.0.0.1", slow.server_address[1])]),
+                    ("web", [("127.0.0.1", slow.server_address[1])])]
+        stack = NativeStack(tmp_path, rules=[], routes=self._routes(),
+                            services=services)
+        try:
+            # warm the verdict path so the in-flight request is verdicted
+            self._get_until(stack.port, "/warm", b"slow")
+            conn = socket.create_connection(("127.0.0.1", stack.port),
+                                            timeout=10)
+            conn.sendall(b"GET /slow HTTP/1.1\r\nhost: t\r\n"
+                         b"user-agent: u\r\n\r\n")
+            time.sleep(0.3)  # request reaches the upstream
+            stack.proc.send_signal(signal.SIGTERM)
+            data = b""
+            conn.settimeout(10)
+            try:
+                while b"slow:/slow" not in data:
+                    ch = conn.recv(4096)
+                    if not ch:
+                        break
+                    data += ch
+            except socket.timeout:
+                pass
+            assert b"slow:/slow" in data, data[:200]  # drained, not dropped
+            rc = stack.proc.wait(timeout=10)
+            assert rc == 0
+            conn.close()
+        finally:
+            if stack.proc.poll() is None:
+                stack.stop()
+            else:
+                stack.upstream.shutdown()
+                stack.sidecar.stop()
+                stack.ring.close()
+            slow.shutdown()
