@@ -19,6 +19,10 @@ Besides the headline on-chip kernel number, the same line carries:
     In this environment the chip sits behind a network tunnel, so the
     e2e figures are dominated by per-batch tunnel transfer/latency
     (see e2e_note); the kernel number is the chip-side capability.
+  * dataplane_*: the same serving path with the DEVICE OUT of the loop
+    (canned verdicts) — the data plane + ring transport capacity of
+    this host, independent of chip or tunnel (see dataplane_note for
+    the 1-cpu-host limit analysis).
 
 Method: UNFILTERED 500-rule CRS-style ruleset (pingoo_tpu/utils/crs.py;
 includes \\b and >31-position multi-word patterns — whatever the
@@ -176,6 +180,138 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
     }
 
 
+def bench_dataplane(n_requests: int = 200_000) -> dict:
+    """Data-plane capacity with the DEVICE OUT OF THE LOOP: loadgen_http
+    -> native httpd -> shared-memory ring -> canned-verdict drain (numpy
+    content check + batched verdict post; no accelerator, no tunnel) ->
+    403/proxy -> pong. This isolates the non-chip half of the serving
+    path, which the tunnel-bound e2e number cannot see: it answers
+    whether the C++ plane + ring + sidecar transport can carry the
+    request rates the chip can verdict (VERDICT r2 item 2)."""
+    import tempfile
+
+    from pingoo_tpu import native_ring
+    from pingoo_tpu.native_ring import Ring
+
+    if not native_ring.ensure_built():
+        return {"dataplane_note": "native toolchain unavailable"}
+    ndir = native_ring.NATIVE_DIR
+    subprocess.run(["make", "-C", ndir, "httpd", "pong", "loadgen_http"],
+                   check=True, capture_output=True)
+
+    # Defaults tuned for THIS 1-CPU host (nproc == 1): one worker and
+    # c=128 measured fastest (14.1k req/s, p99 16 ms); more workers just
+    # time-share the core. On a multi-core host raise BENCH_DP_WORKERS /
+    # BENCH_DP_LOADGENS to exercise the SO_REUSEPORT + ring-per-worker
+    # sharding this bench is built on.
+    workers = int(os.environ.get("BENCH_DP_WORKERS", "1"))
+    loadgens = int(os.environ.get("BENCH_DP_LOADGENS", "1"))
+    tmp = tempfile.mkdtemp(prefix="pingoo-dpbench-")
+    rings = [Ring(os.path.join(tmp, f"ring{i}"), capacity=16384, create=True)
+             for i in range(workers)]
+    stop = threading.Event()
+
+    def canned_drain():
+        # The same dequeue/decode/post transport as the multi-ring
+        # RingSidecar, with the device verdict replaced by a content
+        # check over the url bytes (matching loadgen_http's attack
+        # paths). ONE thread: Ring.dequeue_batch decodes into a per-Ring
+        # scratch buffer, so concurrent drains would race on it.
+        while not stop.is_set():
+            total = 0
+            for ring in rings:
+                slots = ring.dequeue_batch(2048)
+                n = len(slots)
+                if n == 0:
+                    continue
+                total += n
+                urls = slots["url"]
+                cap = urls.shape[-1]
+                buf = urls.tobytes()  # zero-padded rows: no marker spans
+                actions = np.zeros(n, dtype=np.uint8)
+                for marker in (b"<script", b"eval("):
+                    j = buf.find(marker)
+                    while j >= 0:
+                        actions[j // cap] = 1
+                        j = buf.find(marker, j + 1)
+                tickets = np.ascontiguousarray(slots["ticket"],
+                                               dtype=np.uint64)
+                done = 0
+                while done < n and not stop.is_set():
+                    done += ring.post_verdicts(tickets[done:],
+                                               actions[done:])
+            if total == 0:
+                time.sleep(0.0002)
+
+    drain = threading.Thread(target=canned_drain, daemon=True)
+    drain.start()
+    pong = subprocess.Popen([os.path.join(ndir, "pong"), "0"],
+                            stdout=subprocess.PIPE)
+    pport = json.loads(pong.stdout.readline())["listening"]
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    hport = s.getsockname()[1]
+    s.close()
+    # N workers share the port via SO_REUSEPORT (the kernel load-
+    # balances accepted connections), each with its own verdict ring —
+    # the per-core sharding a production deployment uses (verdicts must
+    # return on the worker's own ring: the verdict queue is MPMC, so
+    # co-consuming workers would steal each other's tickets).
+    httpds = []
+    for i in range(workers):
+        h = subprocess.Popen(
+            [os.path.join(ndir, "httpd"), str(hport),
+             os.path.join(tmp, f"ring{i}"), "127.0.0.1", str(pport)],
+            stdout=subprocess.PIPE)
+        h.stdout.readline()
+        httpds.append(h)
+    time.sleep(0.2)
+    try:
+        lg_bin = os.path.join(ndir, "loadgen_http")
+        subprocess.run([lg_bin, str(hport), "8192", "256", "100"],
+                       capture_output=True, timeout=120)  # warm-up
+        per_lg = n_requests // loadgens
+        conc = int(os.environ.get("BENCH_DP_CONC", "128")) // loadgens
+        procs = [subprocess.Popen(
+            [lg_bin, str(hport), str(per_lg), str(conc), "100"],
+            stdout=subprocess.PIPE, text=True) for _ in range(loadgens)]
+        results = []
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            results.append(json.loads(out.strip()))
+    finally:
+        stop.set()
+        # The drain may be mid-FFI-call into the mapped rings; closing
+        # them under it would be a use-after-munmap.
+        drain.join(timeout=10)
+        pong.kill()
+        for h in httpds:
+            h.kill()
+        for ring in rings:
+            ring.close()
+    completed = sum(r["completed"] for r in results)
+    elapsed = max(r["elapsed_s"] for r in results)
+    return {
+        "dataplane_req_per_s": round(completed / elapsed, 1),
+        "dataplane_p50_ms": round(
+            sum(r["p50_ms"] for r in results) / len(results), 3),
+        "dataplane_p99_ms": round(max(r["p99_ms"] for r in results), 3),
+        "dataplane_completed": completed,
+        "dataplane_blocked": sum(r["blocked"] for r in results),
+        "dataplane_errors": sum(r["errors"] for r in results),
+        "dataplane_workers": workers,
+        "dataplane_note": (
+            "device out of the loop (canned verdicts): loadgen -> C++ "
+            "httpd workers (SO_REUSEPORT, one verdict ring each) -> ring "
+            "-> sidecar transport -> proxy/403. LIMIT ANALYSIS: this "
+            "host has ONE cpu (nproc=1); loadgen + httpd + drain + "
+            "upstream time-share it at ~70us total cpu per request, so "
+            "~14k req/s IS the single-core harness ceiling — per-core "
+            "sharding (SO_REUSEPORT + one verdict ring per worker) is "
+            "in place and scales with cores on real hosts"),
+    }
+
+
 def main() -> None:
     # 2048 keeps the full-batch verdict inside the 2 ms latency budget on
     # a v5e-1 while giving up only ~5% throughput vs 4096.
@@ -290,6 +426,11 @@ def main() -> None:
             result.update(bench_e2e(plan, lists))
         except Exception as exc:
             result["e2e_error"] = repr(exc)[:200]
+    if os.environ.get("BENCH_SKIP_DATAPLANE") != "1":
+        try:
+            result.update(bench_dataplane())
+        except Exception as exc:
+            result["dataplane_error"] = repr(exc)[:200]
     print(json.dumps(result))
 
 

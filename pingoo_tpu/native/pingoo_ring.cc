@@ -15,6 +15,7 @@ struct Layout {
   PingooRingHeader* header;
   PingooRequestSlot* req;
   PingooVerdictSlot* ver;
+  PingooSpillSlot* spill;
 };
 
 Layout layout(void* mem, uint32_t capacity) {
@@ -24,7 +25,21 @@ Layout layout(void* mem, uint32_t capacity) {
       static_cast<char*>(mem) + sizeof(PingooRingHeader));
   l.ver = reinterpret_cast<PingooVerdictSlot*>(
       reinterpret_cast<char*>(l.req) + sizeof(PingooRequestSlot) * capacity);
+  l.spill = reinterpret_cast<PingooSpillSlot*>(
+      reinterpret_cast<char*>(l.ver) + sizeof(PingooVerdictSlot) * capacity);
   return l;
+}
+
+// Claim a free spill slot (CAS over the small fixed pool); returns
+// PINGOO_SPILL_NONE when every slot is in flight.
+uint8_t spill_claim(Layout& l) {
+  for (uint32_t i = 0; i < PINGOO_SPILL_SLOTS; ++i) {
+    auto* st = as_atomic(&l.spill[i].state);
+    uint64_t expect = 0;
+    if (st->compare_exchange_strong(expect, 1, std::memory_order_acquire))
+      return static_cast<uint8_t>(i);
+  }
+  return PINGOO_SPILL_NONE;
 }
 
 // Returns true if the source exceeded the cap (the slot then carries a
@@ -44,7 +59,8 @@ extern "C" {
 
 size_t pingoo_ring_bytes(uint32_t capacity) {
   return sizeof(PingooRingHeader) +
-         capacity * (sizeof(PingooRequestSlot) + sizeof(PingooVerdictSlot));
+         capacity * (sizeof(PingooRequestSlot) + sizeof(PingooVerdictSlot)) +
+         PINGOO_SPILL_SLOTS * sizeof(PingooSpillSlot);
 }
 
 void pingoo_ring_init(void* mem, uint32_t capacity) {
@@ -110,6 +126,23 @@ uint64_t pingoo_ring_enqueue_request(
         slot->country[0] = country[0];
         slot->country[1] = country[1];
         slot->flags = truncated ? PINGOO_SLOT_FLAG_TRUNCATED : 0;
+        slot->spill_idx = PINGOO_SPILL_NONE;
+        // Over-cap path/url: park the FULL strings in a spill slot so
+        // the consumer evaluates this row over untruncated bytes
+        // (method/host/ua overflows are normalized before enqueue by
+        // both data planes: host empties, UA 403s).
+        if ((path_len > PINGOO_PATH_CAP || url_len > PINGOO_URL_CAP) &&
+            url_len + path_len <= PINGOO_SPILL_DATA_CAP) {
+          uint8_t sidx = spill_claim(l);
+          if (sidx != PINGOO_SPILL_NONE) {
+            PingooSpillSlot* sp = &l.spill[sidx];
+            sp->url_len = url_len;
+            sp->path_len = path_len;
+            std::memcpy(sp->data, url, url_len);
+            std::memcpy(sp->data + url_len, path, path_len);
+            slot->spill_idx = sidx;
+          }
+        }
         as_atomic(&slot->seq)->store(pos + 1, std::memory_order_release);
         return pos;
       }
@@ -176,6 +209,38 @@ int pingoo_ring_post_verdict(void* mem, uint64_t ticket, uint8_t action,
       pos = head->load(std::memory_order_relaxed);
     }
   }
+}
+
+int pingoo_ring_spill_read(void* mem, uint8_t idx, const char** url,
+                           uint32_t* url_len, const char** path,
+                           uint32_t* path_len) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  Layout l = layout(mem, header->capacity);
+  if (idx >= PINGOO_SPILL_SLOTS) return -1;
+  PingooSpillSlot* sp = &l.spill[idx];
+  if (as_atomic(&sp->state)->load(std::memory_order_acquire) != 1) return -1;
+  if (sp->url_len + sp->path_len > PINGOO_SPILL_DATA_CAP) return -1;
+  *url = sp->data;
+  *url_len = sp->url_len;
+  *path = sp->data + sp->url_len;
+  *path_len = sp->path_len;
+  return 0;
+}
+
+void pingoo_ring_spill_release(void* mem, uint8_t idx) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  Layout l = layout(mem, header->capacity);
+  if (idx >= PINGOO_SPILL_SLOTS) return;
+  as_atomic(&l.spill[idx].state)->store(0, std::memory_order_release);
+}
+
+uint32_t pingoo_ring_post_verdicts(void* mem, const uint64_t* tickets,
+                                   const uint8_t* actions, uint32_t n) {
+  for (uint32_t i = 0; i < n; ++i) {
+    if (pingoo_ring_post_verdict(mem, tickets[i], actions[i], 0.0f) != 0)
+      return i;  // ring full: caller resumes from index i
+  }
+  return n;
 }
 
 int pingoo_ring_poll_verdict(void* mem, uint64_t* ticket_out,

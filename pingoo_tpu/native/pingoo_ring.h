@@ -14,10 +14,12 @@
 // v6-mapped ip words, asn/port columns) so the Python side can decode a
 // whole batch with one numpy structured view, no per-field parsing.
 // A request whose field exceeded its cap at enqueue time carries
-// PINGOO_SLOT_FLAG_TRUNCATED. The sidecar counts flagged rows
-// (RingSidecar.truncated_rows): on this plane they are matched on the
-// slot view (first 2048 bytes) — the Python listener re-evaluates such
-// requests over fully untruncated strings (engine/service.py).
+// PINGOO_SLOT_FLAG_TRUNCATED, and — for path/url — its FULL strings in
+// a claimed spill slot (v3): the sidecar re-evaluates such rows over
+// the untruncated bytes (native_ring.RingSidecar), mirroring the
+// Python listener's overflow re-evaluation (engine/service.py). Only
+// when the spill pool is exhausted does a row fall back to slot-view
+// matching (still counted via truncated_rows).
 
 #ifndef PINGOO_RING_H_
 #define PINGOO_RING_H_
@@ -38,7 +40,7 @@ extern "C" {
 #endif
 
 #define PINGOO_RING_MAGIC 0x50474f52u  // "PGOR"
-#define PINGOO_RING_VERSION 2u
+#define PINGOO_RING_VERSION 3u
 
 #define PINGOO_METHOD_CAP 16
 #define PINGOO_HOST_CAP 256
@@ -47,6 +49,25 @@ extern "C" {
 #define PINGOO_UA_CAP 256
 
 #define PINGOO_SLOT_FLAG_TRUNCATED 0x1u
+
+// Overflow spill: a request whose path/url exceeds the fixed slot caps
+// claims one spill slot and carries its FULL strings there, so the
+// consumer can evaluate flagged rows over untruncated bytes — matching
+// the reference, which matches full strings (http_listener.rs:140-141).
+// 64 KiB covers both strings at the data plane's 32 KiB head cap.
+// spill_idx == PINGOO_SPILL_NONE means no spill (not truncated, or the
+// spill area was exhausted — then the row is matched on the slot view
+// and only counted, the pre-v3 behavior).
+#define PINGOO_SPILL_SLOTS 64u
+#define PINGOO_SPILL_DATA_CAP 65536u
+#define PINGOO_SPILL_NONE 0xFFu
+
+typedef struct {
+  PINGOO_ALIGN8 uint64_t state;  // 0 free / 1 claimed (CAS by producers)
+  uint32_t url_len;
+  uint32_t path_len;
+  char data[PINGOO_SPILL_DATA_CAP];  // url bytes then path bytes
+} PingooSpillSlot;
 
 typedef struct {
   // Vyukov slot sequence: slot is writable when seq == pos, readable
@@ -58,8 +79,8 @@ typedef struct {
   uint8_t ip[16];  // big-endian, v4 addresses v6-mapped (::ffff:a.b.c.d)
   uint32_t asn;
   char country[2];
-  uint8_t flags;  // PINGOO_SLOT_FLAG_* (set by enqueue)
-  char _pad;
+  uint8_t flags;      // PINGOO_SLOT_FLAG_* (set by enqueue)
+  uint8_t spill_idx;  // PINGOO_SPILL_NONE or the claimed spill slot
   char method[PINGOO_METHOD_CAP];
   char host[PINGOO_HOST_CAP];
   char path[PINGOO_PATH_CAP];
@@ -116,9 +137,26 @@ uint32_t pingoo_ring_dequeue_requests(void* mem, PingooRequestSlot* out,
 int pingoo_ring_post_verdict(void* mem, uint64_t ticket, uint8_t action,
                              float bot_score);
 
+// Post a batch of verdicts in one call (one ctypes/FFI hop for the
+// Python sidecar instead of one per ticket); returns how many were
+// posted — fewer than `n` only when the verdict ring filled up, in
+// which case the caller retries from that index.
+uint32_t pingoo_ring_post_verdicts(void* mem, const uint64_t* tickets,
+                                   const uint8_t* actions, uint32_t n);
+
 // Poll one verdict; returns 0 on success, -1 if empty.
 int pingoo_ring_poll_verdict(void* mem, uint64_t* ticket_out,
                              uint8_t* action_out, float* score_out);
+
+// Read a claimed spill slot's full strings. Returns 0 on success and
+// fills the pointers/lengths (data stays valid until release).
+int pingoo_ring_spill_read(void* mem, uint8_t idx, const char** url,
+                           uint32_t* url_len, const char** path,
+                           uint32_t* path_len);
+
+// Release a spill slot back to the free pool (consumer side, after the
+// row's verdict was computed over the untruncated strings).
+void pingoo_ring_spill_release(void* mem, uint8_t idx);
 
 #ifdef __cplusplus
 }  // extern "C"

@@ -30,6 +30,7 @@ FIELD_CAPS = {"method": 16, "host": 256, "path": 2048, "url": 2048,
               "user_agent": 256}
 
 SLOT_FLAG_TRUNCATED = 0x1  # PINGOO_SLOT_FLAG_TRUNCATED
+SPILL_NONE = 0xFF  # PINGOO_SPILL_NONE
 
 # numpy mirror of PingooRequestSlot (natural alignment, no padding holes
 # beyond the explicit _pad).
@@ -43,7 +44,7 @@ REQUEST_SLOT_DTYPE = np.dtype([
     ("asn", "<u4"),
     ("country", "S2"),
     ("flags", "u1"),
-    ("_pad", "S1"),
+    ("spill_idx", "u1"),  # PINGOO_SPILL_NONE (0xFF) or the spill slot
     ("method", "u1", 16),
     ("host", "u1", 256),
     ("path", "u1", 2048),
@@ -91,10 +92,20 @@ def _load_lib():
     lib.pingoo_ring_post_verdict.restype = ctypes.c_int
     lib.pingoo_ring_post_verdict.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint8, ctypes.c_float]
+    lib.pingoo_ring_post_verdicts.restype = ctypes.c_uint32
+    lib.pingoo_ring_post_verdicts.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32]
     lib.pingoo_ring_poll_verdict.restype = ctypes.c_int
     lib.pingoo_ring_poll_verdict.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float)]
+    lib.pingoo_ring_spill_read.restype = ctypes.c_int
+    lib.pingoo_ring_spill_read.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint8,
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint32)]
+    lib.pingoo_ring_spill_release.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_uint8]
     return lib
 
 
@@ -155,6 +166,32 @@ class Ring:
         return self.lib.pingoo_ring_post_verdict(
             self.addr, ticket, action, score) == 0
 
+    def post_verdicts(self, tickets: np.ndarray, actions: np.ndarray) -> int:
+        """Batched post (one FFI hop); returns count posted — fewer than
+        len(tickets) only when the verdict ring is full."""
+        tickets = np.ascontiguousarray(tickets, dtype=np.uint64)
+        actions = np.ascontiguousarray(actions, dtype=np.uint8)
+        return int(self.lib.pingoo_ring_post_verdicts(
+            self.addr, tickets.ctypes.data_as(ctypes.c_void_p),
+            actions.ctypes.data_as(ctypes.c_void_p), len(tickets)))
+
+    def spill_read(self, idx: int) -> Optional[tuple[bytes, bytes]]:
+        """Full (url, path) bytes of a claimed spill slot, or None."""
+        url_p = ctypes.c_char_p()
+        path_p = ctypes.c_char_p()
+        url_n = ctypes.c_uint32()
+        path_n = ctypes.c_uint32()
+        if self.lib.pingoo_ring_spill_read(
+                self.addr, idx, ctypes.byref(url_p), ctypes.byref(url_n),
+                ctypes.byref(path_p), ctypes.byref(path_n)) != 0:
+            return None
+        url = ctypes.string_at(url_p, url_n.value)
+        path = ctypes.string_at(path_p, path_n.value)
+        return url, path
+
+    def spill_release(self, idx: int) -> None:
+        self.lib.pingoo_ring_spill_release(self.addr, idx)
+
     def poll_verdict(self) -> Optional[tuple[int, int, float]]:
         ticket = ctypes.c_uint64()
         action = ctypes.c_uint8()
@@ -207,14 +244,24 @@ def write_services_file(path: str, services: list) -> None:
 
 
 class RingSidecar:
-    """Drain loop: ring batches -> jitted verdict -> verdict ring."""
+    """Drain loop: ring batches -> jitted verdict -> verdict ring.
 
-    def __init__(self, ring: Ring, plan, lists, max_batch: int = 1024,
+    `ring` may be a single Ring or a list of Rings — the data plane
+    scales across cores as N SO_REUSEPORT worker processes with one
+    ring each (verdicts must return on the worker's own ring; the
+    verdict queue is MPMC, so co-consumers would steal each other's
+    tickets). The sidecar drains all rings into ONE merged device batch
+    per cycle and scatters the verdicts back per ring.
+    """
+
+    def __init__(self, ring, plan, lists, max_batch: int = 1024,
                  idle_sleep_s: float = 0.0002, pipeline_depth: int = 3,
                  services: Optional[list] = None):
         from .engine.verdict import make_lane_fn
 
-        self.ring = ring
+        self.rings: list[Ring] = list(ring) if isinstance(
+            ring, (list, tuple)) else [ring]
+        self.ring = self.rings[0]  # single-ring callers' view
         self.plan = plan
         self.lists = lists
         self.max_batch = max_batch
@@ -253,6 +300,10 @@ class RingSidecar:
         self._tables = plan.device_tables()
         self.processed = 0
         self.truncated_rows = 0
+        self.spilled_rows = 0  # overflow rows re-evaluated untruncated
+        self.batches = 0
+        self.device_wait_s = 0.0  # blocking time on device lane results
+        self._ring_rr = -1  # rotating drain start (multi-ring fairness)
         self._stop = False
 
     def run(self, max_requests: Optional[int] = None) -> int:
@@ -271,9 +322,26 @@ class RingSidecar:
 
         inflight: deque = deque()
         while not self._stop:
-            slots = self.ring.dequeue_batch(self.max_batch)
-            if len(slots):
-                n = len(slots)
+            # One merged batch per cycle across all worker rings. The
+            # start index rotates so a saturated ring cannot monopolize
+            # the budget and starve its siblings into the data plane's
+            # verdict timeout (which fails open).
+            parts: list[tuple[Ring, np.ndarray]] = []
+            budget = self.max_batch
+            nrings = len(self.rings)
+            self._ring_rr = (self._ring_rr + 1) % nrings
+            for i in range(nrings):
+                if budget <= 0:
+                    break
+                r = self.rings[(self._ring_rr + i) % nrings]
+                s = r.dequeue_batch(budget)
+                if len(s):
+                    parts.append((r, s))
+                    budget -= len(s)
+            n = sum(len(s) for _, s in parts)
+            if n:
+                slots = parts[0][1] if len(parts) == 1 else np.concatenate(
+                    [s for _, s in parts])
                 # Pad the batch axis to one fixed shape (a partial batch
                 # would otherwise be a new XLA program — compile stall on
                 # the serving path) and bucket field lengths to powers of
@@ -285,11 +353,10 @@ class RingSidecar:
                     RequestBatch(size=n, arrays=bucket_arrays(raw.arrays)),
                     self.max_batch)
                 dev = self._lane_fn(self._tables, batch.arrays)  # async
-                inflight.append((slots, raw, dev, n))
-            if inflight and (len(inflight) >= self.pipeline_depth
-                             or len(slots) == 0):
+                inflight.append((parts, slots, raw, dev, n))
+            if inflight and (len(inflight) >= self.pipeline_depth or n == 0):
                 self._complete(*inflight.popleft())
-            if len(slots) == 0 and not inflight:
+            if n == 0 and not inflight:
                 if max_requests is not None and self.processed >= max_requests:
                     break
                 time.sleep(self.idle_sleep_s)
@@ -300,13 +367,16 @@ class RingSidecar:
             self._complete(*inflight.popleft())
         return self.processed
 
-    def _complete(self, slots, raw_batch, dev, n: int) -> None:
+    def _complete(self, parts, slots, raw_batch, dev, n: int) -> None:
         from .engine.verdict import host_rule_lanes, merge_lanes
 
         # Host-interpreted rules run on the UNPADDED batch while the
         # device lanes are still in flight (jax dispatch is async).
         host = host_rule_lanes(self.plan, raw_batch, self.lists)
+        t0 = time.time()
         dev_lanes = np.asarray(dev)[:, :n]  # drop batch-padding rows
+        self.device_wait_s += time.time() - t0
+        self.batches += 1
         unverified, verified_block = merge_lanes(dev_lanes, host)
         # Rows the producer flagged as truncated (a field exceeded its
         # 2048-byte slot cap) were matched on the slot view — the widest
@@ -316,14 +386,7 @@ class RingSidecar:
         # (engine/service.py).
         self.truncated_rows += int(
             ((slots["flags"] & SLOT_FLAG_TRUNCATED) != 0).sum())
-        # Verdict byte carries BOTH client-state lanes (the reference
-        # action loop diverges for captcha-verified clients,
-        # http_listener.rs:251-264): bits 0-1 = unverified action
-        # (0 none / 1 block / 2 captcha), bit 2 = verified-block, and —
-        # when this sidecar routes for a native listener — bits 3-7 =
-        # the first matching service's order (31 = no service matched,
-        # reference service-selection loop http_listener.rs:266-270).
-        actions = unverified | (verified_block.astype(np.int32) << 2)
+        route = None
         if self.services is not None:
             route = np.asarray(dev_lanes[3], dtype=np.int64).copy()
             if self._host_routes:
@@ -345,15 +408,110 @@ class RingSidecar:
                             hit = False  # route errors fail to no-match
                         if hit:
                             route[i] = order
-            route_bits = np.minimum(route, 31).astype(np.int32)
-            actions = actions | (route_bits << 3)
-        tickets = slots["ticket"]
-        for i in range(n):
-            while not self.ring.post_verdict(int(tickets[i]), int(actions[i])):
-                if self._stop:  # a dead consumer must not wedge stop()
-                    return
-                time.sleep(self.idle_sleep_s)
+        # Rows whose url/path overflowed the slot caps carry their FULL
+        # strings in the owning ring's spill area: re-evaluate every
+        # lane for those rows through the host interpreter over the
+        # untruncated bytes — exact parity with the reference, which
+        # matches full strings (http_listener.rs:140-141). Rows flagged
+        # truncated WITHOUT a spill slot (pool exhausted / > 64 KiB)
+        # keep the slot-view verdict and remain visible in
+        # truncated_rows above.
+        off = 0
+        for ring, part in parts:
+            spilled = np.nonzero(part["spill_idx"] != SPILL_NONE)[0]
+            for j in spilled:
+                idx = int(part["spill_idx"][j])
+                full = ring.spill_read(idx)
+                if full is not None:
+                    unv, vblk, rt = self._interpret_overflow_row(
+                        part[j], full[0], full[1])
+                    unverified[off + j] = unv
+                    verified_block[off + j] = vblk
+                    if route is not None:
+                        route[off + j] = rt
+                    self.spilled_rows += 1
+                ring.spill_release(idx)
+            off += len(part)
+        # Verdict byte carries BOTH client-state lanes (the reference
+        # action loop diverges for captcha-verified clients,
+        # http_listener.rs:251-264): bits 0-1 = unverified action
+        # (0 none / 1 block / 2 captcha), bit 2 = verified-block, and —
+        # when this sidecar routes for a native listener — bits 3-7 =
+        # the first matching service's order (31 = no service matched,
+        # reference service-selection loop http_listener.rs:266-270).
+        actions = unverified | (verified_block.astype(np.int32) << 2)
+        if route is not None:
+            actions = actions | (np.minimum(route, 31).astype(np.int32) << 3)
+        acts = actions[:n].astype(np.uint8)
+        off = 0
+        for ring, part in parts:  # scatter back on each worker's ring
+            m = len(part)
+            tickets = np.ascontiguousarray(part["ticket"], dtype=np.uint64)
+            done = 0
+            while done < m:  # one FFI hop per batch, resume on a full ring
+                done += ring.post_verdicts(tickets[done:],
+                                           acts[off + done:off + m])
+                if done < m:
+                    if self._stop:  # a dead consumer must not wedge stop()
+                        return
+                    time.sleep(self.idle_sleep_s)
+            off += m
         self.processed += n
+
+    def _interpret_overflow_row(self, slot, url: bytes,
+                                path: bytes) -> tuple[int, bool, int]:
+        """(unverified, verified_block, route) for one overflow row via
+        the host interpreter over the UNTRUNCATED url/path (the parity
+        oracle), reproducing the reference's full-string matching."""
+        import ipaddress
+
+        from .engine.batch import RequestTuple, tuple_to_context
+        from .engine.verdict import LANE_NONE, action_lanes, \
+            interpret_rules_row
+
+        def field(name, ln):
+            return bytes(slot[name][:slot[ln]]).decode("latin-1")
+
+        addr = ipaddress.ip_address(bytes(slot["ip"]))
+        v4 = getattr(addr, "ipv4_mapped", None)
+        tup = RequestTuple(
+            host=field("host", "host_len"),
+            url=url.decode("latin-1"),
+            path=path.decode("latin-1"),
+            method=field("method", "method_len"),
+            user_agent=field("user_agent", "ua_len"),
+            ip=str(v4 or addr),
+            remote_port=int(slot["remote_port"]),
+            asn=int(slot["asn"]),
+            country=bytes(slot["country"]).decode("latin-1"),
+        )
+        ctx = tuple_to_context(tup, self.lists)
+        row = interpret_rules_row(self.plan, ctx)[None, :]
+        unv, vblk = action_lanes(self.plan, row)
+        rt = int(LANE_NONE)
+        for order, name in enumerate(self.services or []):
+            ridx = self.plan.route_index.get(name)
+            if ridx is None or row[0, ridx]:
+                rt = order
+                break
+        return int(unv[0]), bool(vblk[0]), rt
+
+    def stats(self) -> dict:
+        """Observability surface for the serving path (SURVEY §5):
+        scraped by operators next to the native plane's
+        /__pingoo/metrics endpoint."""
+        return {
+            "processed": self.processed,
+            "batches": self.batches,
+            "batch_occupancy": round(self.processed / self.batches, 2)
+            if self.batches else 0.0,
+            "device_wait_ms_per_batch": round(
+                1e3 * self.device_wait_s / self.batches, 3)
+            if self.batches else 0.0,
+            "truncated_rows": self.truncated_rows,
+            "spilled_rows": self.spilled_rows,
+            "rings": len(self.rings),
+        }
 
     def stop(self) -> None:
         self._stop = True

@@ -134,3 +134,120 @@ class TestNativeEndToEnd:
         assert result["blocked"] > n * 0.02, result
         assert result["blocked"] < n * 0.4, result
         assert sidecar.processed == n
+
+
+class TestMultiRingSidecar:
+    """One sidecar draining several worker rings (SO_REUSEPORT per-core
+    sharding): verdicts must return on the ring their request came
+    from, with first-match actions intact."""
+
+    def test_verdicts_scatter_to_owning_ring(self, tmp_path):
+        import threading
+        import time
+
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.expr import compile_expression
+
+        rules = [RuleConfig(
+            name="blk", actions=(Action.BLOCK,),
+            expression=compile_expression(
+                'http_request.path.starts_with("/evil")'))]
+        plan = compile_ruleset(rules, {})
+        rings = [Ring(str(tmp_path / f"r{i}"), capacity=64, create=True)
+                 for i in range(3)]
+        sidecar = RingSidecar(rings, plan, {}, max_batch=64)
+        t = threading.Thread(target=sidecar.run, daemon=True)
+        t.start()
+        try:
+            expect = {}  # ring index -> {ticket: want_block}
+            for i, ring in enumerate(rings):
+                expect[i] = {}
+                for j in range(5):
+                    evil = (i + j) % 2 == 0
+                    path = b"/evil" if evil else b"/fine"
+                    tk = ring.enqueue(path=path, url=path,
+                                      user_agent=b"ua", host=b"h")
+                    expect[i][tk] = evil
+            deadline = time.time() + 30
+            got = {i: {} for i in range(3)}
+            while time.time() < deadline and any(
+                    len(got[i]) < len(expect[i]) for i in range(3)):
+                for i, ring in enumerate(rings):
+                    v = ring.poll_verdict()
+                    if v is not None:
+                        got[i][v[0]] = v[1]
+                time.sleep(0.01)
+            for i in range(3):
+                assert set(got[i]) == set(expect[i]), (i, got[i], expect[i])
+                for tk, want in expect[i].items():
+                    assert (got[i][tk] & 3 == 1) == want, (i, tk, got[i][tk])
+        finally:
+            sidecar.stop()
+            t.join(timeout=10)
+            for ring in rings:
+                ring.close()
+
+
+class TestSpillOverflow:
+    """v3 ring: >2048-byte url/path rows carry FULL strings in the spill
+    area and get exact untruncated verdicts (VERDICT r2 item 5 — the
+    reference matches full strings, http_listener.rs:140-141)."""
+
+    def test_spill_roundtrip_and_release(self, tmp_path):
+        ring = Ring(str(tmp_path / "r"), capacity=64, create=True)
+        try:
+            long_url = b"/a" * 1500 + b"NEEDLE" + b"b" * 100  # > 2048
+            tk = ring.enqueue(path=b"/p", url=long_url, user_agent=b"ua")
+            assert tk is not None
+            slots = ring.dequeue_batch(8)
+            assert len(slots) == 1
+            s = slots[0]
+            assert s["flags"] & native_ring.SLOT_FLAG_TRUNCATED
+            assert s["spill_idx"] != native_ring.SPILL_NONE
+            got = ring.spill_read(int(s["spill_idx"]))
+            assert got is not None
+            url, path = got
+            assert url == long_url and path == b"/p"
+            ring.spill_release(int(s["spill_idx"]))
+            assert ring.spill_read(int(s["spill_idx"])) is None  # freed
+        finally:
+            ring.close()
+
+    def test_sidecar_blocks_on_content_past_slot_cap(self, tmp_path):
+        import threading
+        import time
+
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.expr import compile_expression
+
+        rules = [RuleConfig(
+            name="deep", actions=(Action.BLOCK,),
+            expression=compile_expression(
+                'http_request.url.contains("NEEDLE")'))]
+        plan = compile_ruleset(rules, {})
+        ring = Ring(str(tmp_path / "r"), capacity=64, create=True)
+        sidecar = RingSidecar(ring, plan, {}, max_batch=16)
+        t = threading.Thread(target=sidecar.run, daemon=True)
+        t.start()
+        try:
+            # marker entirely PAST the 2048-byte slot view
+            deep = b"/" + b"a" * 3000 + b"NEEDLE"
+            t_deep = ring.enqueue(path=deep, url=deep, user_agent=b"ua")
+            clean = b"/" + b"c" * 3000
+            t_clean = ring.enqueue(path=clean, url=clean, user_agent=b"ua")
+            got = {}
+            deadline = time.time() + 30
+            while time.time() < deadline and len(got) < 2:
+                v = ring.poll_verdict()
+                if v is not None:
+                    got[v[0]] = v[1]
+                time.sleep(0.01)
+            assert got[t_deep] & 3 == 1, got  # blocked on full-string match
+            assert got[t_clean] & 3 == 0, got
+            assert sidecar.spilled_rows == 2
+        finally:
+            sidecar.stop()
+            t.join(timeout=10)
+            ring.close()
