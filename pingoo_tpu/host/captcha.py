@@ -163,7 +163,19 @@ class CaptchaManager:
         if sub in ("", "/") and method == "GET":
             return 200, [("content-type", "text/html; charset=utf-8")], \
                 CAPTCHA_PAGE.encode()
-        if sub == "/api/init" and method == "POST":
+        if sub == "/assets/index.js" and method == "GET":
+            # The frontend's script asset (the reference serves its vite
+            # bundle under /assets, captcha.rs serve_asset).
+            from .captcha_frontend import APP_JS
+
+            return 200, [("content-type", "text/javascript"),
+                         ("cache-control",
+                          "public, no-cache, must-revalidate")], \
+                APP_JS.encode()
+        # The reference routes /api/init by path only (captcha.rs:167) —
+        # its frontend fetches it with GET; POST kept for existing
+        # clients of this implementation.
+        if sub == "/api/init" and method in ("GET", "POST"):
             payload, cookie = self.init_challenge(client_id)
             return 200, [("content-type", "application/json"),
                          ("set-cookie", cookie)], json.dumps(payload).encode()
@@ -182,54 +194,6 @@ class CaptchaManager:
         return 404, [("content-type", "text/plain")], b"not found"
 
 
-# Self-contained PoW frontend: checkbox -> init -> WebCrypto SHA-256
-# brute force -> verify -> reload (reference captcha/src/index.tsx).
-CAPTCHA_PAGE = """<!doctype html>
-<html><head><meta charset="utf-8"><title>Security check</title>
-<meta name="viewport" content="width=device-width, initial-scale=1">
-<style>
-body{font-family:system-ui,sans-serif;display:flex;align-items:center;
-justify-content:center;min-height:100vh;margin:0;background:#f5f5f5}
-.card{background:#fff;border:1px solid #ddd;border-radius:8px;
-padding:2rem;max-width:22rem;text-align:center}
-.row{display:flex;align-items:center;gap:.75rem;justify-content:center;
-margin:1rem 0}
-input[type=checkbox]{width:1.4rem;height:1.4rem}
-#status{color:#666;font-size:.9rem;min-height:1.2rem}
-</style></head><body><div class="card">
-<h3>Checking your browser</h3>
-<div class="row"><input id="cb" type="checkbox">
-<label for="cb">I am human</label></div>
-<div id="status"></div></div>
-<script>
-const enc = new TextEncoder();
-async function sha256hex(s){
-  const d = await crypto.subtle.digest('SHA-256', enc.encode(s));
-  return [...new Uint8Array(d)].map(b=>b.toString(16).padStart(2,'0')).join('');
-}
-async function proofOfWork(challenge, difficulty){
-  const prefix = '0'.repeat(difficulty);
-  for(let nonce=0;;nonce++){
-    const h = await sha256hex(challenge + String(nonce));
-    if(h.startsWith(prefix)) return {nonce:String(nonce), hash:h};
-  }
-}
-document.getElementById('cb').addEventListener('change', async (ev)=>{
-  if(!ev.target.checked) return;
-  ev.target.disabled = true;
-  const st = document.getElementById('status');
-  st.textContent = 'Solving challenge…';
-  try{
-    const init = await fetch('/__pingoo/captcha/api/init', {method:'POST'});
-    const {challenge, difficulty} = await init.json();
-    const {nonce, hash} = await proofOfWork(challenge, difficulty);
-    const res = await fetch('/__pingoo/captcha/api/verify', {
-      method:'POST', headers:{'content-type':'application/json'},
-      body: JSON.stringify({nonce, hash})});
-    if(res.ok){ st.textContent='Verified. Reloading…'; location.reload(); }
-    else { st.textContent='Verification failed. Try again.';
-           ev.target.disabled=false; ev.target.checked=false; }
-  }catch(e){ st.textContent='Error: '+e; ev.target.disabled=false; }
-});
-</script></body></html>
-"""
+# The challenge frontend: built-app parity with the reference's
+# Preact/vite bundle (see host/captcha_frontend.py for the derivation).
+from .captcha_frontend import INDEX_HTML as CAPTCHA_PAGE  # noqa: E402

@@ -245,6 +245,10 @@ class HttpListener:
                 if isinstance(event, h11.Request):
                     request = await self._read_request(conn, reader, event)
                     response = await self.handle_request(request, peer)
+                    if response.tunnel is not None:
+                        await self._pump_tunnel(conn, reader, writer,
+                                                response.tunnel)
+                        break  # raw bytes flowed: the h1 cycle is over
                     await self._send_response(conn, writer, request, response)
                     if conn.our_state is h11.MUST_CLOSE:
                         break
@@ -254,6 +258,46 @@ class HttpListener:
         finally:
             try:
                 writer.close()
+            except OSError:
+                pass
+
+    async def _pump_tunnel(self, conn, reader, writer, tunnel) -> None:
+        """Protocol upgrade (WebSocket): relay the upstream's response
+        head verbatim, then splice raw bytes both directions until
+        either side closes (reference http_listener.rs:277
+        serve_connection_with_upgrades)."""
+        up_reader, up_writer, head = tunnel
+        try:
+            writer.write(head)
+            # Bytes the client sent after its upgrade request are
+            # already buffered inside h11 — forward them first.
+            trailing, _ = conn.trailing_data
+            if trailing:
+                up_writer.write(trailing)
+            await writer.drain()
+            await up_writer.drain()
+
+            async def pump(src, dst):
+                try:
+                    while True:
+                        data = await src.read(65536)
+                        if not data:
+                            break
+                        dst.write(data)
+                        await dst.drain()
+                except (OSError, asyncio.IncompleteReadError):
+                    pass
+                finally:
+                    try:
+                        dst.write_eof()
+                    except OSError:
+                        pass
+
+            await asyncio.gather(pump(reader, up_writer),
+                                 pump(up_reader, writer))
+        finally:
+            try:
+                up_writer.close()
             except OSError:
                 pass
 

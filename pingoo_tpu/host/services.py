@@ -53,6 +53,12 @@ class Response:
     headers: list[tuple[str, str]]
     body: bytes = b""
     stream_path: Optional[str] = None  # large static files stream from disk
+    # Protocol upgrade (WebSocket): (upstream_reader, upstream_writer,
+    # raw response head bytes). The listener relays the head verbatim
+    # and then pumps raw bytes both ways until either side closes —
+    # the reference serves with upgrades enabled
+    # (http_listener.rs:277 serve_connection_with_upgrades).
+    tunnel: Optional[tuple] = None
 
 
 def match_route(route: Optional[Program], ctx: Context) -> bool:
@@ -112,12 +118,123 @@ class HttpProxyService:
                 self._h2_conns[key] = conn
             return conn
 
+    @staticmethod
+    def _upgrade_value(req) -> Optional[str]:
+        """The Upgrade token when this is an upgrade request (Connection
+        lists 'upgrade' and an Upgrade header names the protocol)."""
+        conn_v = ""
+        up_v = None
+        for n, v in req.headers:
+            ln = n.lower()
+            if ln == "connection":
+                conn_v = v.lower()
+            elif ln == "upgrade":
+                up_v = v
+        if up_v and "upgrade" in conn_v:
+            return up_v
+        return None
+
+    async def _handle_upgrade(self, req, request_ctx, upstream,
+                              upgrade: str) -> Response:
+        """Tunnel an Upgrade request: send it to the upstream over a raw
+        connection preserving the upgrade headers, read the response
+        head, and hand the open connection to the listener for
+        bidirectional pumping."""
+        target_host = upstream.ip or upstream.hostname
+        try:
+            if upstream.tls:
+                import ssl as ssl_mod
+
+                ctx = ssl_mod.create_default_context()
+                up_r, up_w = await asyncio.wait_for(
+                    asyncio.open_connection(
+                        target_host, upstream.port, ssl=ctx,
+                        server_hostname=upstream.hostname),
+                    CONNECT_TIMEOUT_S)
+            else:
+                up_r, up_w = await asyncio.wait_for(
+                    asyncio.open_connection(target_host, upstream.port),
+                    CONNECT_TIMEOUT_S)
+        except Exception:
+            return Response(502, [("content-type", "text/plain"),
+                                  ("server", "pingoo")], b"Bad Gateway")
+        head = f"{req.method} {req.target} HTTP/1.1\r\n"
+        head += f"host: {upstream.hostname}\r\n"
+        for n, v in req.headers:
+            ln = n.lower()
+            if ln in HOP_BY_HOP_HEADERS or ln == "host":
+                continue
+            head += f"{n}: {v}\r\n"
+        head += f"connection: upgrade\r\nupgrade: {upgrade}\r\n"
+        head += f"x-forwarded-for: {request_ctx.client_ip}\r\n"
+        head += ("x-forwarded-proto: "
+                 f"{'https' if request_ctx.tls else 'http'}\r\n")
+        head += f"pingoo-client-ip: {request_ctx.client_ip}\r\n\r\n"
+        try:
+            up_w.write(head.encode("latin-1"))
+            await up_w.drain()
+            resp_head = await asyncio.wait_for(
+                up_r.readuntil(b"\r\n\r\n"), 30)
+        except Exception:
+            up_w.close()
+            return Response(502, [("content-type", "text/plain"),
+                                  ("server", "pingoo")], b"Bad Gateway")
+        status_line = resp_head.split(b"\r\n", 1)[0]
+        parts = status_line.split()
+        status = int(parts[1]) if len(parts) >= 2 and parts[1].isdigit() \
+            else 502
+        if status != 101:
+            # Upstream REFUSED the upgrade: relay it as a normal framed
+            # response (entering the raw tunnel here would let follow-up
+            # keep-alive requests bypass rule evaluation entirely).
+            try:
+                return await self._read_refusal(up_r, resp_head, status)
+            finally:
+                up_w.close()
+        # Relay the 101 head verbatim (its Connection/Upgrade/
+        # Sec-WebSocket-* headers are the handshake).
+        return Response(101, [], tunnel=(up_r, up_w, resp_head))
+
+    @staticmethod
+    async def _read_refusal(up_r, resp_head: bytes, status: int) -> Response:
+        """Parse a non-101 answer to an upgrade request into a normal
+        Response (content-length framing; EOF framing otherwise)."""
+        headers = []
+        content_length = None
+        for line in resp_head.split(b"\r\n")[1:]:
+            name, _, value = line.partition(b":")
+            if not _:
+                continue
+            lname = name.decode("latin-1").strip().lower()
+            v = value.decode("latin-1").strip()
+            if lname == "content-length":
+                try:
+                    content_length = int(v)
+                except ValueError:
+                    pass
+            if lname in HOP_BY_HOP_HEADERS or lname in RESPONSE_STRIP_HEADERS \
+                    or lname == "content-length":
+                continue
+            headers.append((name.decode("latin-1").strip(), v))
+        if content_length is not None:
+            body = await asyncio.wait_for(
+                up_r.readexactly(content_length), 30) if content_length \
+                else b""
+        else:
+            body = await asyncio.wait_for(up_r.read(), 30)
+        headers.append(("server", "pingoo"))
+        return Response(status, headers, body)
+
     async def handle(self, req, request_ctx) -> Response:
         upstreams = self.registry.get_upstreams(self.name)
         if not upstreams:
             return Response(502, [("content-type", "text/plain")],
                             b"Bad Gateway")
         upstream = random.choice(upstreams)
+        upgrade = self._upgrade_value(req)
+        if upgrade is not None and not getattr(upstream, "h2", False):
+            return await self._handle_upgrade(req, request_ctx, upstream,
+                                              upgrade)
         scheme = "https" if upstream.tls else "http"
         target_host = upstream.ip or upstream.hostname
         url = f"{scheme}://{target_host}:{upstream.port}{req.target}"
