@@ -49,8 +49,10 @@ from . import repat
 # request whose field still exceeds its capacity is re-evaluated on the
 # host interpreter over the UNTRUNCATED strings (engine/service.py), so
 # on the Python plane padding a URL can never bypass a content rule.
-# (The native ring plane carries the same 2048-byte caps in its slots
-# and counts the >2048 residue via PINGOO_SLOT_FLAG_TRUNCATED.)
+# (The native ring plane carries the same 2048-byte caps in its slots;
+# overflow rows ship their FULL url/path through the ring's spill area
+# and are re-evaluated untruncated by the sidecar — native_ring.py
+# _interpret_overflow_row — so both planes match full strings.)
 DEFAULT_FIELD_SPECS = {
     "host": 256,
     "url": 2048,
