@@ -93,6 +93,13 @@ constexpr time_t kIdleTimeoutS = 30;
 constexpr time_t kVerdictTimeoutS = 3;   // then fail open
 constexpr time_t kTunnelIdleS = 300;     // upgraded (WebSocket) tunnels
 constexpr size_t kMaxReplay = 64 * 1024;  // pooled-retry replay budget
+// nghttp2 data-provider sentinel: no DATA available now; the session
+// parks the stream until nghttp2_session_resume_data.
+constexpr ssize_t kNghttp2ErrDeferred = -508;  // NGHTTP2_ERR_DEFERRED
+// Streamed h2 responses buffer at most this much de-framed body before
+// the upstream read side is paused (per stream).
+constexpr size_t kH2PendingCap = 256 * 1024;
+constexpr int kH2MaxStreamUpstreams = 32;  // concurrent upstreams per conn
 constexpr time_t kProxyIdleTimeoutS = 60;
 constexpr int kMaxRequestsPerConn = 1000;
 
@@ -600,10 +607,37 @@ struct Parsed {
 };
 
 // One multiplexed HTTP/2 request in flight on a connection.
+struct SockRef;
+
 struct H2Stream {
   Parsed p;
   std::string body;
   bool complete = false;
+  // Per-stream proxy state: streams are serviced CONCURRENTLY, each
+  // with its own upstream connection and de-framed response stream
+  // (reference: hyper multiplexes + streams bodies, http_listener.rs:276).
+  int up_fd = -1;
+  bool up_connected = false;
+  bool up_eof = false;
+  bool up_pooled = false;
+  uint64_t up_key = 0;
+  sockaddr_in up_target{};
+  std::string upbuf;       // request bytes awaiting the upstream socket
+  std::string up_replay;   // pooled-retry replay copy
+  std::string resp_head_buf;
+  bool resp_head_done = false;
+  BodyFramer resp_body;
+  bool up_keep = false;
+  bool up_junk = false;
+  bool submitted = false;  // response HEADERS handed to nghttp2
+  std::string pending;     // de-framed DATA bytes awaiting the session
+  bool data_eof = false;   // response body complete
+  bool verified = false;   // captcha cookie verified for this stream
+  bool up_queued = false;  // verdicted; waiting for an upstream slot
+  uint64_t ticket = UINT64_MAX;
+  uint64_t enq_ms = 0;
+  time_t verdict_at = 0;
+  SockRef* up_ref = nullptr;  // heap ref handed to epoll (deferred free)
 };
 
 std::string strip_host_port(const std::string& value);
@@ -924,6 +958,7 @@ struct Conn;
 struct SockRef {
   Conn* conn = nullptr;  // nullptr = the listening socket
   bool is_upstream = false;
+  int32_t h2_sid = 0;  // nonzero: a per-h2-stream upstream socket
 };
 
 struct Conn {
@@ -975,14 +1010,11 @@ struct Conn {
   nghttp2_session* h2 = nullptr;
   std::unordered_map<int32_t, H2Stream> h2_streams;
   std::vector<int32_t> h2_ready;   // completed requests awaiting service
-  int32_t h2_active = 0;           // stream currently verdicting/proxying
+  std::vector<int32_t> h2_proxy_wait;  // verdicted, waiting for a slot
+  int h2_upstreams = 0;            // streams with an open upstream socket
   // Per-stream response bodies served through the data provider (a
   // client flow-control stall can defer DATA past the next stream).
   std::unordered_map<int32_t, std::pair<std::string, size_t>> h2_send;
-  std::string h2_resp_head;        // upstream h1 response head (collect)
-  std::string h2_resp_body;        // de-framed upstream response payload
-  int h2_resp_status = 502;        // parsed once at head completion
-  std::vector<std::pair<std::string, std::string>> h2_resp_hdrs;
   time_t verdict_at = 0;           // when the active ticket was enqueued
 };
 
@@ -1155,7 +1187,6 @@ class Server {
   }
 
   void dispatch_route(Conn* c, uint8_t route) {
-    bool h2 = c->state == ConnState::kH2;
     sockaddr_in target{};
     switch (pick_route_target(route, &target)) {
       case Route::kOk:
@@ -1164,20 +1195,27 @@ class Server {
       case Route::kNoService:
         // Reference: no service matched -> 404 (http_listener.rs:270).
         stats_.no_service++;
-        if (h2) {
-          h2_respond_simple(c, c->h2_active, 404, "Not Found");
-          h2_flush(c);
-        } else {
-          respond_close(c, k404);
-        }
+        respond_close(c, k404);
         return;
       case Route::kNoUpstream:
-        if (h2) {
-          h2_respond_simple(c, c->h2_active, 502, "Bad Gateway");
-          h2_flush(c);
-        } else {
-          respond_502(c);
-        }
+        respond_502(c);
+        return;
+    }
+  }
+
+  void h2_dispatch_route(Conn* c, int32_t sid, uint8_t route) {
+    sockaddr_in target{};
+    switch (pick_route_target(route, &target)) {
+      case Route::kOk:
+        h2_start_stream_proxy(c, sid, target);
+        return;
+      case Route::kNoService:
+        stats_.no_service++;
+        h2_respond_simple(c, sid, 404, "Not Found");
+        return;
+      case Route::kNoUpstream:
+        stats_.upstream_fail++;
+        h2_respond_simple(c, sid, 502, "Bad Gateway");
         return;
     }
   }
@@ -1186,11 +1224,18 @@ class Server {
     sockaddr_in target{};
     if (default_target(&target)) {
       start_proxy(c, target);
-    } else if (c->state == ConnState::kH2) {
-      h2_respond_simple(c, c->h2_active, 502, "Bad Gateway");
-      h2_flush(c);
     } else {
       respond_502(c);
+    }
+  }
+
+  void h2_stream_fail_open(Conn* c, int32_t sid) {
+    sockaddr_in target{};
+    if (default_target(&target)) {
+      h2_start_stream_proxy(c, sid, target);
+    } else {
+      stats_.upstream_fail++;
+      h2_respond_simple(c, sid, 502, "Bad Gateway");
     }
   }
 
@@ -1251,11 +1296,18 @@ class Server {
         close(c->fd);
       }
       close_upstream(c);
+      for (auto& kv : c->h2_streams)
+        h2_release_stream_resources(c, kv.second);
       if (c->ticket != UINT64_MAX) awaiting_.erase(c->ticket);
       conns_.erase(c);
       delete c;
     }
     doomed_.clear();
+    for (SockRef* r : doomed_refs_) {
+      r->conn = nullptr;
+      delete r;
+    }
+    doomed_refs_.clear();
   }
 
   void set_now(time_t t) { now_ = t; }
@@ -1359,7 +1411,7 @@ class Server {
       if (c->state == ConnState::kReadingHead && c->inbuf.empty() &&
           c->outbuf.empty())
         mark_close(c);
-      else if (c->state == ConnState::kH2 && c->h2_active == 0 &&
+      else if (c->state == ConnState::kH2 && c->h2_streams.empty() &&
                c->h2_ready.empty() && c->outbuf.empty())
         // Idle h2 connection: no stream being serviced or queued. An
         // abrupt close (no GOAWAY) is within spec for shutdown; clients
@@ -1396,18 +1448,26 @@ class Server {
           // WebSockets idle legitimately (pings may be minutes apart).
           if (idle > kTunnelIdleS) mark_close(c);
           break;
-        case ConnState::kH2:
-          // A stream stuck awaiting a verdict fails open on its own
-          // timer (frame activity keeps last_active fresh, so the
+        case ConnState::kH2: {
+          // Streams stuck awaiting verdicts fail open on their own
+          // timers (frame activity keeps last_active fresh, so each
           // ticket gets a dedicated timestamp).
-          if (c->ticket != UINT64_MAX &&
-              now_ - c->verdict_at > kVerdictTimeoutS) {
-            drop_ticket(c);
-            stats_.fail_open++;
-            fail_open_proxy(c);
+          bool failed_open = false;
+          for (auto& kv : c->h2_streams) {
+            H2Stream& st = kv.second;
+            if (st.ticket != UINT64_MAX &&
+                now_ - st.verdict_at > kVerdictTimeoutS) {
+              awaiting_.erase(st.ticket);
+              st.ticket = UINT64_MAX;
+              stats_.fail_open++;
+              h2_stream_fail_open(c, kv.first);
+              failed_open = true;
+            }
           }
+          if (failed_open) h2_flush(c);
           if (idle > kProxyIdleTimeoutS) mark_close(c);
           break;
+        }
       }
     }
   }
@@ -1508,9 +1568,7 @@ class Server {
     uint32_t ev = 0;
     // Same level-trigger discipline: stop reading an EOF'd upstream and
     // pause reads while the client-side buffer is at its cap.
-    if (!c->upstream_eof && c->outbuf.size() < kMaxBuffered &&
-        c->h2_resp_body.size() <= kMaxBuffered)
-      ev = EPOLLIN;
+    if (!c->upstream_eof && c->outbuf.size() < kMaxBuffered) ev = EPOLLIN;
     if (!c->upbuf.empty() || !c->upstream_connected) ev |= EPOLLOUT;
     epoll_event e{};
     e.events = ev;
@@ -1547,8 +1605,7 @@ class Server {
   // the request once on a fresh connection (false when not applicable).
   bool try_pooled_retry(Conn* c) {
     if (!c->upstream_pooled || c->up_replay.empty()) return false;
-    if (!c->resp_head_buf.empty() || !c->h2_resp_head.empty() ||
-        c->resp_head_done)
+    if (!c->resp_head_buf.empty() || c->resp_head_done)
       return false;  // response started: not safe to replay
     close_upstream(c);
     int ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
@@ -1572,36 +1629,20 @@ class Server {
     return true;
   }
 
-  // Protocol-appropriate 502 (canned close for h1, stream response +
-  // next-stream processing for h2). Tears the failed upstream down
-  // FIRST: h2_finish_stream may immediately start the next stream's
-  // proxy, which must not race an fd still registered in epoll.
+  // h1 502 (h2 streams fail through h2_respond_simple). Tears the
+  // failed upstream down FIRST so a retry/new proxy never races an fd
+  // still registered in epoll.
   void respond_502(Conn* c) {
     if (try_pooled_retry(c)) return;
     stats_.upstream_fail++;
     close_upstream(c);
-    if (c->state == ConnState::kH2) {
-      c->h2_resp_head.clear();
-      c->h2_resp_body.clear();
-      c->resp_head_done = false;
-      h2_respond_simple(c, c->h2_active, 502, "Bad Gateway");
-      h2_flush(c);
-    } else {
-      respond_close(c, k502);
-    }
+    respond_close(c, k502);
   }
 
-  // Abort the active h2 stream without fabricating a response (e.g. a
+  // Abort one h2 stream without fabricating a response (e.g. a
   // truncated upstream body must NOT become a well-formed short 200).
-  void h2_abort_active(Conn* c) {
-    close_upstream(c);
-    c->h2_resp_head.clear();
-    c->h2_resp_body.clear();
-    c->resp_head_done = false;
-    if (c->h2_active != 0)
-      nghttp2_submit_rst_stream(c->h2, 0, c->h2_active,
-                                NGHTTP2_INTERNAL_ERROR);
-    h2_finish_stream(c);
+  void h2_abort_stream(Conn* c, int32_t sid) {
+    nghttp2_submit_rst_stream(c->h2, 0, sid, NGHTTP2_INTERNAL_ERROR);
     h2_flush(c);
   }
 
@@ -1689,19 +1730,10 @@ class Server {
     c->upstream_eof = false;
     c->last_active = now_;
 
-    if (c->state == ConnState::kH2) {
-      // h2 stream: state stays kH2; the synthesized head embeds the
-      // whole buffered request body.
-      c->upbuf = h2_upstream_head(c);
-      c->req_body_forwarded = true;
-      c->h2_resp_head.clear();
-      c->h2_resp_body.clear();
-    } else {
-      c->state = ConnState::kProxying;
-      // Rewritten head + whatever request-body bytes are buffered.
-      c->upbuf = rewrite_request_head(c->req, c->peer_ip, c->ssl != nullptr);
-      pump_request_body(c);
-    }
+    c->state = ConnState::kProxying;
+    // Rewritten head + whatever request-body bytes are buffered.
+    c->upbuf = rewrite_request_head(c->req, c->peer_ip, c->ssl != nullptr);
+    pump_request_body(c);
     // A POOLED connection can die between the liveness probe and our
     // write (server idle-timeout race). Keep the sent bytes around so
     // the request can be replayed once on a FRESH connection instead of
@@ -1796,11 +1828,20 @@ class Server {
     while (pingoo_ring_poll_verdict(ring_, &ticket, &action, &score) == 0) {
       auto it = awaiting_.find(ticket);
       if (it == awaiting_.end()) continue;  // connection died meanwhile
-      Conn* c = it->second;
+      Conn* c = it->second.conn;
+      int32_t sid = it->second.sid;
       awaiting_.erase(it);
-      c->ticket = UINT64_MAX;
       if (c->dead) continue;
-      apply_verdict(c, action);
+      if (sid != 0) {
+        auto sit = c->h2_streams.find(sid);
+        if (sit == c->h2_streams.end()) continue;  // stream reset meanwhile
+        sit->second.ticket = UINT64_MAX;
+        apply_h2_verdict(c, sid, action);
+        h2_flush(c);
+      } else {
+        c->ticket = UINT64_MAX;
+        apply_verdict(c, action);
+      }
     }
   }
 
@@ -1812,7 +1853,6 @@ class Server {
   void apply_verdict(Conn* c, uint8_t action) {
     stats_.verdicts++;
     if (c->enq_ms) record_wait(now_ms() - c->enq_ms);
-    bool h2 = c->state == ConnState::kH2;
     uint8_t decided;  // 0 proxy, 1 block, 2 captcha
     if (c->captcha_verified) {
       decided = (action & 4) ? 1 : 0;
@@ -1821,25 +1861,28 @@ class Server {
     }
     if (decided == 1) {
       stats_.blocked++;
+      respond_close(c, k403);
     } else if (decided == 2) {
       stats_.captcha++;
-    }
-    if (decided == 1) {
-      if (h2) {
-        h2_respond_simple(c, c->h2_active, 403, "Forbidden");
-        h2_flush(c);
-      } else {
-        respond_close(c, k403);
-      }
-    } else if (decided == 2) {
-      if (h2) {
-        h2_respond_redirect(c, c->h2_active);
-        h2_flush(c);
-      } else {
-        respond_close(c, kCaptcha);
-      }
+      respond_close(c, kCaptcha);
     } else {
       dispatch_route(c, (action >> 3) & 0x1f);
+    }
+  }
+
+  void apply_h2_verdict(Conn* c, int32_t sid, uint8_t action) {
+    stats_.verdicts++;
+    H2Stream& st = c->h2_streams[sid];
+    if (st.enq_ms) record_wait(now_ms() - st.enq_ms);
+    uint8_t decided = st.verified ? ((action & 4) ? 1 : 0) : (action & 3);
+    if (decided == 1) {
+      stats_.blocked++;
+      h2_respond_simple(c, sid, 403, "Forbidden");
+    } else if (decided == 2) {
+      stats_.captcha++;
+      h2_respond_redirect(c, sid);
+    } else {
+      h2_dispatch_route(c, sid, (action >> 3) & 0x1f);
     }
   }
 
@@ -1988,23 +2031,24 @@ class Server {
     kAwaitVerdict,     // enqueued; verdict callback decides
   };
 
-  Policy run_policy(Conn* c) {
+  Policy run_policy(Conn* c, int32_t sid = 0) {
     stats_.requests++;
+    Parsed& req = sid != 0 ? c->h2_streams[sid].p : c->req;
     // Empty or oversized UA -> 403 before the ring. The >= is the
     // reference's own explicit check (http_listener.rs:196).
-    if (c->req.user_agent.empty() || c->req.user_agent.size() >= 256) {
+    if (req.user_agent.empty() || req.user_agent.size() >= 256) {
       stats_.ua_rejected++;
       return Policy::kBlock;
     }
     // Over-long host becomes EMPTY, not truncated (get_host,
     // http_listener.rs:284-296).
-    if (c->req.host.size() > 256) c->req.host.clear();
+    if (req.host.size() > 256) req.host.clear();
 
     // Captcha endpoints bypass rules and go to the control plane — and
     // they come BEFORE the cookie gate, exactly like the reference
     // (http_listener.rs:200-204 precede :222-236), or a client with a
     // stale cookie could never reach the challenge to clear it.
-    if (c->req.path.compare(0, 17, "/__pingoo/captcha") == 0)
+    if (req.path.compare(0, 17, "/__pingoo/captcha") == 0)
       return has_captcha_upstream_ ? Policy::kCaptchaUpstream
                                    : Policy::kBlock;
 
@@ -2012,17 +2056,19 @@ class Server {
     // An INVALID present cookie serves the challenge immediately
     // (reference http_listener.rs:222-236) — here: redirect.
     std::string client_id = captcha_client_id(
-        c->peer_ip, c->req.user_agent, c->req.host);
+        c->peer_ip, req.user_agent, req.host);
     if (gate_ != nullptr) gate_->maybe_reload(now_);
-    c->captcha_verified = false;
-    if (!c->req.verified_cookie.empty() && gate_ != nullptr &&
+    bool verified = false;
+    if (!req.verified_cookie.empty() && gate_ != nullptr &&
         gate_->available()) {
-      if (gate_->verify(c->req.verified_cookie, client_id, now_)) {
-        c->captcha_verified = true;
+      if (gate_->verify(req.verified_cookie, client_id, now_)) {
+        verified = true;
       } else {
         return Policy::kCaptchaRedirect;
       }
     }
+    if (sid != 0) c->h2_streams[sid].verified = verified;
+    else c->captcha_verified = verified;
 
     uint8_t ip[16] = {0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0xff, 0xff, 0, 0, 0, 0};
     in_addr v4{};
@@ -2030,29 +2076,38 @@ class Server {
     std::memcpy(ip + 12, &v4, 4);
     char country[2] = {'X', 'X'};
     uint64_t ticket = pingoo_ring_enqueue_request(
-        ring_, c->req.method.data(), c->req.method.size(), c->req.host.data(),
-        c->req.host.size(), c->req.path.data(), c->req.path.size(),
-        c->req.target.data(), c->req.target.size(), c->req.user_agent.data(),
-        c->req.user_agent.size(), ip, c->peer_port, 0, country);
+        ring_, req.method.data(), req.method.size(), req.host.data(),
+        req.host.size(), req.path.data(), req.path.size(),
+        req.target.data(), req.target.size(), req.user_agent.data(),
+        req.user_agent.size(), ip, c->peer_port, 0, country);
     if (ticket == UINT64_MAX) {
       // Verdict ring full (sidecar stalled): FAIL OPEN — proxy without
       // a verdict (pingoo/rules.rs:41-44).
       return Policy::kFailOpenProxy;
     }
-    c->ticket = ticket;
-    c->verdict_at = now_;
-    c->enq_ms = now_ms();
-    awaiting_[ticket] = c;
+    if (sid != 0) {
+      H2Stream& st = c->h2_streams[sid];
+      st.ticket = ticket;
+      st.verdict_at = now_;
+      st.enq_ms = now_ms();
+    } else {
+      c->ticket = ticket;
+      c->verdict_at = now_;
+      c->enq_ms = now_ms();
+    }
+    awaiting_[ticket] = Awaiting{c, sid};
     return Policy::kAwaitVerdict;
   }
 
   // -- HTTP/2 mode -----------------------------------------------------------
   //
   // nghttp2 owns framing/HPACK/flow control; requests surface through
-  // the callbacks below and run the SAME run_policy/ring/proxy path as
-  // h1. Streams are serviced one at a time per connection (frame
-  // ingest keeps multiplexing; service is sequential — the Python
-  // plane's h2 listener handles streams concurrently).
+  // the callbacks below and run the SAME run_policy/ring path as h1.
+  // Streams are serviced CONCURRENTLY — each proxied stream owns an
+  // upstream socket and a streaming DATA provider, so responses flow
+  // as the upstream delivers them (no whole-body buffering) and a slow
+  // stream never blocks its siblings (reference: hyper auto builder,
+  // http_listener.rs:276-278).
 
   bool start_h2(Conn* c) {
     nghttp2_session_callbacks* cbs = nullptr;
@@ -2110,23 +2165,37 @@ class Server {
     update_client_events(c);
   }
 
+  // Service every completed stream CONCURRENTLY — each proxied stream
+  // gets its own upstream socket, so a slow stream never head-of-line
+  // blocks the connection (reference: hyper multiplexes streams,
+  // http_listener.rs:276). The upstream-socket count per connection is
+  // capped; excess ready streams wait their turn in h2_ready.
   void h2_process_next(Conn* c) {
-    while (c->h2_active == 0 && !c->h2_ready.empty()) {
-      int32_t sid = c->h2_ready.front();
-      c->h2_ready.erase(c->h2_ready.begin());
+    // First hand freed upstream slots to streams whose verdict already
+    // said proxy.
+    while (!c->h2_proxy_wait.empty() &&
+           c->h2_upstreams < kH2MaxStreamUpstreams) {
+      int32_t sid = c->h2_proxy_wait.front();
+      c->h2_proxy_wait.erase(c->h2_proxy_wait.begin());
+      auto it = c->h2_streams.find(sid);
+      if (it == c->h2_streams.end() || !it->second.up_queued) continue;
+      it->second.up_queued = false;
+      h2_start_stream_proxy(c, sid, it->second.up_target);
+    }
+    size_t i = 0;
+    while (i < c->h2_ready.size()) {
+      if (c->h2_upstreams >= kH2MaxStreamUpstreams) break;
+      int32_t sid = c->h2_ready[i];
+      c->h2_ready.erase(c->h2_ready.begin() + i);
       auto it = c->h2_streams.find(sid);
       if (it == c->h2_streams.end()) continue;  // reset meanwhile
-      c->h2_active = sid;
-      c->req = it->second.p;
-      if (c->req.path == "/__pingoo/metrics") {
+      if (it->second.p.path == "/__pingoo/metrics") {
         std::string body = metrics_body();
         h2_submit(c, sid, 200,
                   {{"content-type", "application/json"}}, std::move(body));
-        h2_finish_stream(c);
-        h2_flush(c);
         continue;
       }
-      Policy outcome = run_policy(c);
+      Policy outcome = run_policy(c, sid);
       switch (outcome) {
         case Policy::kBlock:
           h2_respond_simple(c, sid, 403, "Forbidden");
@@ -2135,30 +2204,182 @@ class Server {
           h2_respond_redirect(c, sid);
           break;
         case Policy::kCaptchaUpstream:
-          start_proxy(c, captcha_upstream_);
-          return;  // one stream in flight
+          h2_start_stream_proxy(c, sid, captcha_upstream_);
+          break;
         case Policy::kFailOpenProxy:
           stats_.fail_open++;
-          fail_open_proxy(c);
-          return;
+          h2_stream_fail_open(c, sid);
+          break;
         case Policy::kAwaitVerdict:
-          return;  // verdict callback resumes this stream
+          break;  // the verdict callback services this stream
       }
     }
   }
 
-  void h2_finish_stream(Conn* c) {
-    c->h2_active = 0;
+
+  // -- per-stream upstream proxying (concurrent h2) --------------------------
+
+  void h2_close_stream_upstream(Conn* c, H2Stream& st) {
+    if (st.up_fd >= 0) {
+      epoll_ctl(ep_, EPOLL_CTL_DEL, st.up_fd, nullptr);
+      close(st.up_fd);
+      st.up_fd = -1;
+      c->h2_upstreams--;
+    }
+    if (st.up_ref != nullptr) {
+      // Events already harvested this batch may still hold the ref:
+      // mark it dead and free it after the batch (like doomed conns).
+      st.up_ref->h2_sid = -1;
+      doomed_refs_.push_back(st.up_ref);
+      st.up_ref = nullptr;
+    }
+    st.up_connected = false;
+  }
+
+  void h2_release_stream_resources(Conn* c, H2Stream& st) {
+    if (st.ticket != UINT64_MAX) {
+      awaiting_.erase(st.ticket);
+      st.ticket = UINT64_MAX;
+    }
+    h2_close_stream_upstream(c, st);
+  }
+
+  // Response complete: pool the upstream connection when it is clean,
+  // then service streams that were waiting for an upstream slot.
+  void h2_stream_finish_upstream(Conn* c, H2Stream& st) {
+    bool can_pool = st.resp_body.done &&
+                    st.resp_body.mode != BodyFramer::kUntilEof &&
+                    !st.up_eof && st.up_keep && !st.up_junk &&
+                    st.upbuf.empty() &&  // request fully sent: an early
+                    // response over unsent body bytes would poison the
+                    // pooled connection for its next user
+                    st.up_key != 0 && st.up_fd >= 0 &&
+                    upstream_pool_[st.up_key].size() < kPoolPerTarget;
+    if (can_pool) {
+      epoll_ctl(ep_, EPOLL_CTL_DEL, st.up_fd, nullptr);
+      upstream_pool_[st.up_key].push_back(PooledUpstream{st.up_fd, now_});
+      st.up_fd = -1;
+      c->h2_upstreams--;
+      if (st.up_ref != nullptr) {
+        st.up_ref->h2_sid = -1;
+        doomed_refs_.push_back(st.up_ref);
+        st.up_ref = nullptr;
+      }
+      st.up_connected = false;
+    } else {
+      h2_close_stream_upstream(c, st);
+    }
     h2_process_next(c);
   }
 
-  void h2_submit(Conn* c, int32_t sid, int status,
-                 const std::vector<std::pair<std::string, std::string>>&
-                     headers,
-                 std::string body) {
-    std::string status_s = std::to_string(status);
+  void h2_update_stream_events(H2Stream& st) {
+    if (st.up_fd < 0 || st.up_ref == nullptr) return;
+    uint32_t ev = 0;
+    if (!st.up_eof && st.pending.size() < kH2PendingCap) ev = EPOLLIN;
+    if (!st.upbuf.empty() || !st.up_connected) ev |= EPOLLOUT;
+    epoll_event e{};
+    e.events = ev;
+    e.data.ptr = st.up_ref;
+    epoll_ctl(ep_, EPOLL_CTL_MOD, st.up_fd, &e);
+  }
+
+  void h2_start_stream_proxy(Conn* c, int32_t sid,
+                             const sockaddr_in& target) {
+    auto it = c->h2_streams.find(sid);
+    if (it == c->h2_streams.end()) return;
+    H2Stream& st = it->second;
+    if (c->h2_upstreams >= kH2MaxStreamUpstreams) {
+      // The per-connection upstream cap binds on EVERY dispatch path
+      // (verdicts arrive for all ready streams at once): park the
+      // stream until a slot frees (h2_process_next drains the queue).
+      st.up_target = target;
+      st.up_queued = true;
+      c->h2_proxy_wait.push_back(sid);
+      return;
+    }
+    uint64_t key = target_key(target);
+    int ufd = pop_pooled(key);
+    bool pooled = ufd >= 0;
+    if (!pooled) {
+      ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+      if (ufd < 0 ||
+          (connect(ufd, reinterpret_cast<const sockaddr*>(&target),
+                   sizeof(target)) != 0 &&
+           errno != EINPROGRESS)) {
+        if (ufd >= 0) close(ufd);
+        stats_.upstream_fail++;
+        h2_respond_simple(c, sid, 502, "Bad Gateway");
+        return;
+      }
+    }
+    st.up_fd = ufd;
+    st.up_key = key;
+    st.up_target = target;
+    st.up_pooled = pooled;
+    st.up_connected = pooled;
+    st.up_eof = false;
+    st.up_keep = false;
+    st.up_junk = false;
+    st.resp_head_buf.clear();
+    st.resp_head_done = false;
+    st.resp_body = BodyFramer();
+    st.pending.clear();
+    st.data_eof = false;
+    st.submitted = false;
+    st.upbuf = h2_upstream_head(c, st);
+    st.up_replay = st.upbuf;
+    if (st.up_replay.size() > kMaxReplay) {
+      st.up_replay.clear();
+      st.up_pooled = false;
+    }
+    st.up_ref = new SockRef{c, true, sid};
+    c->h2_upstreams++;
+    epoll_event ue{};
+    ue.events = EPOLLOUT | EPOLLIN;
+    ue.data.ptr = st.up_ref;
+    epoll_ctl(ep_, EPOLL_CTL_ADD, ufd, &ue);
+  }
+
+  bool h2_try_stream_retry(Conn* c, int32_t sid, H2Stream& st) {
+    if (!st.up_pooled || st.up_replay.empty()) return false;
+    if (!st.resp_head_buf.empty() || st.resp_head_done) return false;
+    h2_close_stream_upstream(c, st);
+    int ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (ufd < 0 ||
+        (connect(ufd, reinterpret_cast<const sockaddr*>(&st.up_target),
+                 sizeof(st.up_target)) != 0 &&
+         errno != EINPROGRESS)) {
+      if (ufd >= 0) close(ufd);
+      return false;
+    }
+    st.up_fd = ufd;
+    st.up_pooled = false;  // one retry only
+    st.up_connected = false;
+    st.up_eof = false;
+    st.upbuf = st.up_replay;
+    st.up_ref = new SockRef{c, true, sid};
+    c->h2_upstreams++;
+    epoll_event ue{};
+    ue.events = EPOLLOUT | EPOLLIN;
+    ue.data.ptr = st.up_ref;
+    epoll_ctl(ep_, EPOLL_CTL_ADD, ufd, &ue);
+    return true;
+  }
+
+  // Shared response-header build for the canned and streamed submit
+  // paths: ONE copy of the connection-specific-header filter, so the
+  // two paths cannot drift (connection-specific headers are illegal in
+  // h2, RFC 9113 §8.2.2).
+  void h2_submit_response_nva(Conn* c, int32_t sid,
+                              const std::string& status,
+                              const std::vector<std::pair<std::string,
+                                                          std::string>>& hdrs,
+                              long long content_length,
+                              nghttp2_data_provider* prd) {
     std::vector<nghttp2_nv> nva;
-    std::vector<std::string> keep;  // backing storage for nv pointers
+    std::vector<std::string> keep;
+    keep.reserve(hdrs.size() * 2 + 8);
+    nva.reserve(hdrs.size() + 4);
     auto push = [&](const std::string& n, const std::string& v) {
       keep.push_back(n);
       const std::string& nn = keep.back();
@@ -2172,44 +2393,244 @@ class Server {
       nv.flags = NGHTTP2_NV_FLAG_NONE;
       nva.push_back(nv);
     };
-    // keep must not reallocate after pointers are taken
-    keep.reserve(headers.size() * 2 + 8);
-    nva.reserve(headers.size() + 4);
-    push(":status", status_s);
-    for (const auto& kv : headers) {
+    push(":status", status);
+    for (const auto& kv : hdrs) {
       std::string lname = lower(kv.first);
       if (is_hop_header(lname) || lname == "content-length" ||
           lname == "transfer-encoding" || lname == "server" ||
           lname == "alt-svc" || lname.compare(0, 8, "x-accel-") == 0)
-        continue;  // connection-specific headers are illegal in h2
+        continue;
       push(lname, kv.second);
     }
     push("server", "pingoo");
-    push("content-length", std::to_string(body.size()));
+    if (content_length >= 0)
+      push("content-length", std::to_string(content_length));
+    if (nghttp2_submit_response(c->h2, sid, nva.data(), nva.size(), prd) !=
+        0)
+      c->h2_send.erase(sid);
+  }
+
+  // Submit the response HEADERS with a STREAMING data provider: DATA
+  // frames flow from st.pending as the upstream delivers bytes (no
+  // whole-body buffering; responses larger than memory stream through).
+  void h2_submit_streaming(Conn* c, int32_t sid, const RespHead& rh,
+                           const std::string& head) {
+    std::vector<std::pair<std::string, std::string>> hdrs;
+    parse_header_lines(head, &hdrs);
+    nghttp2_data_provider prd{};
+    prd.read_callback = h2_data_read;
+    h2_submit_response_nva(c, sid, std::to_string(rh.status), hdrs,
+                           rh.content_length, &prd);
+  }
+
+  // Returns false when the stream was aborted/serviced and reading
+  // must stop (the H2Stream reference may no longer be valid).
+  bool h2_stream_upstream_data(Conn* c, int32_t sid, H2Stream& st,
+                               const char* data, size_t len) {
+    if (!st.resp_head_done) {
+      st.resp_head_buf.append(data, len);
+      for (;;) {
+        size_t he = st.resp_head_buf.find("\r\n\r\n");
+        if (he == std::string::npos) {
+          if (st.resp_head_buf.size() > kMaxHead) {
+            h2_close_stream_upstream(c, st);
+            h2_abort_stream(c, sid);
+            return false;
+          }
+          return true;
+        }
+        std::string head = st.resp_head_buf.substr(0, he + 4);
+        int status = 0;
+        if (head.size() >= 12 && head.compare(0, 7, "HTTP/1.") == 0 &&
+            head[8] == ' ')
+          status = atoi(head.c_str() + 9);
+        if (status >= 100 && status < 200) {
+          st.resp_head_buf.erase(0, he + 4);  // interim: skip, keep parsing
+          continue;
+        }
+        std::string rest = st.resp_head_buf.substr(he + 4);
+        st.resp_head_buf.clear();
+        RespHead rh = rewrite_response_head(head, false);
+        if (!rh.ok) {
+          h2_close_stream_upstream(c, st);
+          stats_.upstream_fail++;
+          h2_respond_simple(c, sid, 502, "Bad Gateway");
+          h2_process_next(c);
+          return false;
+        }
+        st.up_keep = rh.upstream_keep;
+        bool head_only = st.p.method == "HEAD" || rh.status == 204 ||
+                         rh.status == 304;
+        if (head_only) st.resp_body.reset_none();
+        else if (rh.chunked) st.resp_body.reset_chunked();
+        else if (rh.content_length >= 0)
+          st.resp_body.reset_cl(rh.content_length);
+        else st.resp_body.reset_eof();
+        st.resp_head_done = true;
+        h2_submit_streaming(c, sid, rh, head);
+        st.submitted = true;
+        if (!rest.empty()) {
+          size_t take = st.resp_body.consume(rest.data(), rest.size(),
+                                             &st.pending);
+          if (take < rest.size()) st.up_junk = true;
+          if (st.resp_body.bad) {
+            h2_close_stream_upstream(c, st);
+            h2_abort_stream(c, sid);
+            return false;
+          }
+          nghttp2_session_resume_data(c->h2, sid);
+        }
+        return true;
+      }
+    }
+    if (!st.resp_body.done) {
+      size_t take = st.resp_body.consume(data, len, &st.pending);
+      if (take < len && st.resp_body.done) st.up_junk = true;
+      if (st.resp_body.bad) {
+        h2_close_stream_upstream(c, st);
+        h2_abort_stream(c, sid);
+        return false;
+      }
+      if (st.submitted && !st.pending.empty())
+        nghttp2_session_resume_data(c->h2, sid);
+    } else if (len > 0) {
+      st.up_junk = true;
+    }
+    return true;
+  }
+
+  void h2_stream_check_done(Conn* c, int32_t sid, H2Stream& st) {
+    if (!st.resp_head_done) {
+      if (st.up_eof) {
+        if (h2_try_stream_retry(c, sid, st)) return;
+        h2_close_stream_upstream(c, st);
+        stats_.upstream_fail++;
+        h2_respond_simple(c, sid, 502, "Bad Gateway");
+        h2_process_next(c);
+      }
+      return;
+    }
+    bool done = st.resp_body.done ||
+                (st.resp_body.mode == BodyFramer::kUntilEof && st.up_eof);
+    if (done && !st.data_eof) {
+      st.data_eof = true;
+      if (st.resp_body.mode == BodyFramer::kUntilEof)
+        st.resp_body.done = true;  // EOF framing: input ended the body
+      nghttp2_session_resume_data(c->h2, sid);
+      h2_stream_finish_upstream(c, st);
+      return;
+    }
+    if (st.up_eof && !st.resp_body.done && !st.data_eof &&
+        st.resp_body.mode != BodyFramer::kUntilEof) {
+      // Truncated CL/chunked response: reset the stream so the client
+      // sees the failure instead of a certified-short body.
+      h2_close_stream_upstream(c, st);
+      h2_abort_stream(c, sid);
+      h2_process_next(c);
+    }
+  }
+
+  void h2_stream_upstream_event(Conn* c, int32_t sid, uint32_t events) {
+    auto it = c->h2_streams.find(sid);
+    if (it == c->h2_streams.end()) return;
+    H2Stream& st = it->second;
+    if (st.up_fd < 0) return;
+    c->last_active = now_;
+    if (!st.up_connected && (events & (EPOLLOUT | EPOLLERR))) {
+      int err = 0;
+      socklen_t elen = sizeof(err);
+      getsockopt(st.up_fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+      if (err != 0) {
+        if (!h2_try_stream_retry(c, sid, st)) {
+          h2_close_stream_upstream(c, st);
+          stats_.upstream_fail++;
+          h2_respond_simple(c, sid, 502, "Bad Gateway");
+          h2_process_next(c);
+        }
+        h2_flush(c);
+        return;
+      }
+      st.up_connected = true;
+    }
+    if (events & EPOLLOUT) {
+      while (!st.upbuf.empty() && st.up_connected) {
+        ssize_t w = send(st.up_fd, st.upbuf.data(), st.upbuf.size(),
+                         MSG_NOSIGNAL);
+        if (w > 0) {
+          st.upbuf.erase(0, static_cast<size_t>(w));
+        } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          break;
+        } else {
+          if (!h2_try_stream_retry(c, sid, st)) {
+            h2_close_stream_upstream(c, st);
+            if (!st.resp_head_done) {
+              stats_.upstream_fail++;
+              h2_respond_simple(c, sid, 502, "Bad Gateway");
+            } else {
+              h2_abort_stream(c, sid);
+            }
+            h2_process_next(c);
+          }
+          h2_flush(c);
+          return;
+        }
+      }
+    }
+    if (events & EPOLLIN) {
+      char buf[16384];
+      while (st.up_fd >= 0) {
+        if (st.pending.size() > kH2PendingCap) break;  // backpressure
+        ssize_t r = read(st.up_fd, buf, sizeof(buf));
+        if (r > 0) {
+          if (!h2_stream_upstream_data(c, sid, st, buf,
+                                       static_cast<size_t>(r))) {
+            h2_flush(c);
+            return;  // stream aborted/serviced: st may be gone
+          }
+        } else if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+          break;
+        } else {
+          st.up_eof = true;
+          break;
+        }
+      }
+    }
+    if (events & (EPOLLHUP | EPOLLERR)) st.up_eof = true;
+    h2_stream_check_done(c, sid, st);
+    // After check_done the stream's upstream may be released; the map
+    // entry itself survives until nghttp2 closes the stream.
+    auto again = c->h2_streams.find(sid);
+    if (again != c->h2_streams.end() && again->second.up_fd >= 0)
+      h2_update_stream_events(again->second);
+    h2_flush(c);
+  }
+
+  void h2_submit(Conn* c, int32_t sid, int status,
+                 const std::vector<std::pair<std::string, std::string>>&
+                     headers,
+                 std::string body) {
+    long long content_length = static_cast<long long>(body.size());
     c->h2_send[sid] = {std::move(body), 0};
     nghttp2_data_provider prd{};
     prd.read_callback = h2_data_read;
-    if (nghttp2_submit_response(c->h2, sid, nva.data(), nva.size(), &prd) !=
-        0)
-      c->h2_send.erase(sid);
+    h2_submit_response_nva(c, sid, std::to_string(status), headers,
+                           content_length, &prd);
   }
 
   void h2_respond_simple(Conn* c, int32_t sid, int status,
                          const char* text) {
     h2_submit(c, sid, status,
               {{"content-type", "text/plain"}}, text);
-    h2_finish_stream(c);
   }
 
   void h2_respond_redirect(Conn* c, int32_t sid) {
     h2_submit(c, sid, 302, {{"location", "/__pingoo/captcha"}}, "");
-    h2_finish_stream(c);
   }
 
   // Synthesized upstream h1 request head for the active h2 stream
   // (h2 streams have no raw h1 head to rewrite).
-  std::string h2_upstream_head(Conn* c) {
-    const Parsed& p = c->req;
+  std::string h2_upstream_head(Conn* c, const H2Stream& st) {
+    const Parsed& p = st.p;
     std::string out = p.method + " " + p.target + " HTTP/1.1\r\n";
     if (!p.host.empty()) out += "host: " + p.host + "\r\n";
     for (const auto& kv : p.h2_headers) {
@@ -2217,7 +2638,6 @@ class Server {
         continue;
       out += kv.first + ": " + kv.second + "\r\n";
     }
-    const H2Stream& st = c->h2_streams[c->h2_active];
     out += "connection: keep-alive\r\n";
     if (!st.body.empty())
       out += "content-length: " + std::to_string(st.body.size()) + "\r\n";
@@ -2228,29 +2648,6 @@ class Server {
     out += "pingoo-client-ip: " + std::string(c->peer_ip) + "\r\n\r\n";
     out += st.body;
     return out;
-  }
-
-  // Collected upstream response -> h2 response for the active stream
-  // (status/headers were parsed once at head completion).
-  void h2_complete_response(Conn* c) {
-    int32_t sid = c->h2_active;
-    std::string body = std::move(c->h2_resp_body);
-    c->h2_resp_body.clear();
-    int status = c->h2_resp_status;
-    std::vector<std::pair<std::string, std::string>> headers;
-    headers.swap(c->h2_resp_hdrs);
-    if (c->resp_body.done && c->resp_body.mode != BodyFramer::kUntilEof &&
-        !c->upstream_eof && c->upstream_keep && !c->upstream_junk) {
-      release_upstream(c);
-    } else {
-      close_upstream(c);
-    }
-    c->h2_resp_head.clear();
-    c->resp_head_done = false;
-    if (c->req.method == "HEAD") body.clear();
-    h2_submit(c, sid, status, headers, std::move(body));
-    h2_finish_stream(c);
-    h2_flush(c);
   }
 
   static int h2_on_header(nghttp2_session*, const void* frame,
@@ -2314,18 +2711,14 @@ class Server {
   static int h2_on_stream_close(nghttp2_session*, int32_t stream_id,
                                 uint32_t, void* user_data) {
     Conn* c = static_cast<Conn*>(user_data);
-    c->h2_streams.erase(stream_id);
-    c->h2_send.erase(stream_id);
-    if (c->h2_active == stream_id && g_server != nullptr) {
-      // Peer reset the in-flight stream: abandon its verdict/upstream.
-      g_server->drop_ticket(c);
-      g_server->close_upstream(c);
-      c->h2_resp_head.clear();
-      c->h2_resp_body.clear();
-      c->resp_head_done = false;
-      c->h2_active = 0;
-      g_server->h2_process_next(c);
+    auto it = c->h2_streams.find(stream_id);
+    if (it != c->h2_streams.end()) {
+      if (g_server != nullptr)
+        g_server->h2_release_stream_resources(c, it->second);
+      c->h2_streams.erase(it);
     }
+    c->h2_send.erase(stream_id);
+    if (g_server != nullptr) g_server->h2_process_next(c);
     return 0;
   }
 
@@ -2335,21 +2728,42 @@ class Server {
                               void* user_data) {
     Conn* c = static_cast<Conn*>(user_data);
     auto it = c->h2_send.find(stream_id);
-    if (it == c->h2_send.end()) {
+    if (it != c->h2_send.end()) {  // canned (non-proxied) response
+      const std::string& body = it->second.first;
+      size_t& off = it->second.second;
+      size_t n = std::min(body.size() - off, length);
+      if (n > 0) {
+        std::memcpy(buf, body.data() + off, n);
+        off += n;
+      }
+      if (off >= body.size()) {
+        *data_flags = NGHTTP2_DATA_FLAG_EOF;
+        c->h2_send.erase(it);
+      }
+      return static_cast<ssize_t>(n);
+    }
+    // Streamed proxied response: DATA flows as the upstream delivers it.
+    auto sit = c->h2_streams.find(stream_id);
+    if (sit == c->h2_streams.end()) {
       *data_flags = NGHTTP2_DATA_FLAG_EOF;
       return 0;
     }
-    const std::string& body = it->second.first;
-    size_t& off = it->second.second;
-    size_t n = std::min(body.size() - off, length);
-    if (n > 0) {
-      std::memcpy(buf, body.data() + off, n);
-      off += n;
+    H2Stream& st = sit->second;
+    if (st.pending.empty()) {
+      if (st.data_eof) {
+        *data_flags = NGHTTP2_DATA_FLAG_EOF;
+        return 0;
+      }
+      return kNghttp2ErrDeferred;  // resumed when more bytes arrive
     }
-    if (off >= body.size()) {
+    size_t n = std::min(st.pending.size(), length);
+    std::memcpy(buf, st.pending.data(), n);
+    st.pending.erase(0, n);
+    if (st.pending.empty() && st.data_eof)
       *data_flags = NGHTTP2_DATA_FLAG_EOF;
-      c->h2_send.erase(it);
-    }
+    // Draining below the cap re-arms the paused upstream read side.
+    if (g_server != nullptr && st.up_fd >= 0)
+      g_server->h2_update_stream_events(st);
     return static_cast<ssize_t>(n);
   }
 
@@ -2447,8 +2861,7 @@ class Server {
 
   bool proxy_live(Conn* c) const {
     return c->state == ConnState::kProxying ||
-           c->state == ConnState::kTunnel ||
-           (c->state == ConnState::kH2 && c->upstream_fd >= 0);
+           c->state == ConnState::kTunnel;
   }
 
   void on_upstream_event(Conn* c, uint32_t events) {
@@ -2469,15 +2882,10 @@ class Server {
     if (events & EPOLLIN) {
       char buf[16384];
       for (;;) {
-        if (c->outbuf.size() > kMaxBuffered ||
-            c->h2_resp_body.size() > kMaxBuffered)
-          break;  // backpressure
+        if (c->outbuf.size() > kMaxBuffered) break;  // backpressure
         ssize_t r = read(c->upstream_fd, buf, sizeof(buf));
         if (r > 0) {
-          if (c->state == ConnState::kH2)
-            h2_on_upstream_data(c, buf, static_cast<size_t>(r));
-          else
-            on_upstream_data(c, buf, static_cast<size_t>(r));
+          on_upstream_data(c, buf, static_cast<size_t>(r));
           if (c->dead || !proxy_live(c)) return;
         } else if (r == 0) {
           c->upstream_eof = true;
@@ -2501,77 +2909,8 @@ class Server {
     update_upstream_events(c);
   }
 
-  // h2 mode: upstream h1 response is COLLECTED (head parsed, body
-  // de-framed — chunk metadata must not leak into h2 DATA frames).
-  void h2_on_upstream_data(Conn* c, const char* data, size_t len) {
-    size_t off = 0;
-    if (!c->resp_head_done) {
-      c->h2_resp_head.append(data, len);
-      for (;;) {
-        size_t he = c->h2_resp_head.find("\r\n\r\n");
-        if (he == std::string::npos) {
-          if (c->h2_resp_head.size() > kMaxHead) mark_close(c);
-          return;
-        }
-        // 1xx interim responses have no h2 representation we forward;
-        // skip to the final head.
-        int status = 0;
-        if (c->h2_resp_head.size() >= 12 &&
-            c->h2_resp_head.compare(0, 7, "HTTP/1.") == 0 &&
-            c->h2_resp_head[8] == ' ')
-          status = atoi(c->h2_resp_head.c_str() + 9);
-        if (status >= 100 && status < 200) {
-          c->h2_resp_head.erase(0, he + 4);
-          continue;
-        }
-        std::string rest = c->h2_resp_head.substr(he + 4);
-        c->h2_resp_head.erase(he + 4);
-        // Body framing from the head.
-        RespHead rh = rewrite_response_head(c->h2_resp_head, false);
-        bool head_only = c->req.method == "HEAD" || rh.status == 204 ||
-                         rh.status == 304;
-        if (!rh.ok) {
-          respond_502(c);
-          return;
-        }
-        // Parse the response metadata ONCE; h2_complete_response sends
-        // exactly this (no second parser over the same bytes).
-        c->h2_resp_status = rh.status;
-        c->upstream_keep = rh.upstream_keep;
-        c->h2_resp_hdrs.clear();
-        parse_header_lines(c->h2_resp_head, &c->h2_resp_hdrs);
-        if (head_only) c->resp_body.reset_none();
-        else if (rh.chunked) c->resp_body.reset_chunked();
-        else if (rh.content_length >= 0)
-          c->resp_body.reset_cl(rh.content_length);
-        else c->resp_body.reset_eof();
-        c->resp_head_done = true;
-        if (!rest.empty()) {
-          size_t take = c->resp_body.consume(rest.data(), rest.size(),
-                                             &c->h2_resp_body);
-          if (take < rest.size()) c->upstream_junk = true;
-          if (c->resp_body.bad) {
-            mark_close(c);
-            return;
-          }
-        }
-        break;
-      }
-    } else if (!c->resp_body.done) {
-      size_t take = c->resp_body.consume(data + off, len - off,
-                                         &c->h2_resp_body);
-      if (take < len - off && c->resp_body.done) c->upstream_junk = true;
-      if (c->resp_body.bad) {
-        mark_close(c);
-        return;
-      }
-    }
-    // Responses are submitted whole; one larger than the buffer cap can
-    // never complete — abort the stream instead of stalling the
-    // connection (the Python h2 plane handles arbitrary sizes).
-    if (c->h2_resp_body.size() > kMaxBuffered) h2_abort_active(c);
-  }
-
+  // h1 proxy: stream the upstream response to the client, rewriting
+  // the head (and entering raw-tunnel mode on an accepted upgrade).
   void on_upstream_data(Conn* c, const char* data, size_t len) {
     if (c->state == ConnState::kTunnel) {
       c->outbuf.append(data, len);  // raw splice after the 101
@@ -2580,8 +2919,8 @@ class Server {
     if (!c->resp_head_done) {
       c->resp_head_buf.append(data, len);
       // Parse heads in a loop: 1xx interim responses (e.g. 100
-      // Continue for Expect: 100-continue POSTs) are relayed verbatim
-      // and the FINAL response head follows on the same connection.
+      // Continue for Expect: 100-continue POSTs) are relayed and the
+      // FINAL response head follows on the same connection.
       for (;;) {
         size_t he = c->resp_head_buf.find("\r\n\r\n");
         if (he == std::string::npos) {
@@ -2591,7 +2930,7 @@ class Server {
         std::string head = c->resp_head_buf.substr(0, he + 4);
         RespHead rh = rewrite_response_head(head, c->req.keep_alive);
         if (!rh.ok) {
-          respond_close(c, k502);
+          respond_502(c);
           return;
         }
         if (rh.status == 101 && c->req.is_upgrade()) {
@@ -2675,24 +3014,6 @@ class Server {
       if (c->upstream_eof && c->outbuf.empty()) mark_close(c);
       return;
     }
-    if (c->state == ConnState::kH2) {
-      if (c->upstream_fd < 0) return;  // no proxy in flight
-      if (!c->resp_head_done) {
-        if (c->upstream_eof) respond_502(c);  // EOF before any head
-        return;
-      }
-      if (c->resp_body.done ||
-          (c->resp_body.mode == BodyFramer::kUntilEof && c->upstream_eof)) {
-        h2_complete_response(c);
-      } else if (c->upstream_eof) {
-        // Truncated CL/chunked response: a rebuilt content-length would
-        // certify the partial body as complete — reset the stream so
-        // the client sees the failure (the h1 path relays the original
-        // framing and closes, which is equally detectable).
-        h2_abort_active(c);
-      }
-      return;
-    }
     if (c->state != ConnState::kProxying || !c->resp_head_done) {
       // EOF from upstream before any response head -> 502
       if (c->state == ConnState::kProxying && c->upstream_eof &&
@@ -2721,7 +3042,8 @@ class Server {
     // known-clean state: explicit framing fully consumed, no EOF, no
     // bytes past the response end, and the upstream allows keep-alive.
     if (c->resp_body.done && c->resp_body.mode != BodyFramer::kUntilEof &&
-        !c->upstream_eof && c->upstream_keep && !c->upstream_junk) {
+        !c->upstream_eof && c->upstream_keep && !c->upstream_junk &&
+        c->upbuf.empty() && c->req_body_forwarded) {
       release_upstream(c);
     } else {
       close_upstream(c);
@@ -2764,10 +3086,16 @@ class Server {
     mark_close(c);
   }
 
-  void handle(Conn* c, bool is_upstream, uint32_t events) {
+  void handle(SockRef* ref, uint32_t events) {
+    Conn* c = ref->conn;
+    if (c == nullptr || ref->h2_sid < 0) return;  // dead stream ref
     if (c->dead) return;  // stale event within this batch
-    if (is_upstream) {
-      if (proxy_live(c)) on_upstream_event(c, events);
+    if (ref->is_upstream) {
+      if (ref->h2_sid > 0) {
+        h2_stream_upstream_event(c, ref->h2_sid, events);
+      } else if (proxy_live(c)) {
+        on_upstream_event(c, events);
+      }
       return;
     }
     switch (c->state) {
@@ -2837,7 +3165,12 @@ class Server {
   std::unordered_map<uint64_t, std::vector<PooledUpstream>> upstream_pool_;
   Stats stats_;
   std::unordered_set<Conn*> conns_;
-  std::unordered_map<uint64_t, Conn*> awaiting_;
+  struct Awaiting {
+    Conn* conn;
+    int32_t sid;  // 0 = the h1 request cycle, else an h2 stream
+  };
+  std::unordered_map<uint64_t, Awaiting> awaiting_;
+  std::vector<SockRef*> doomed_refs_;  // per-stream refs freed after the batch
   std::unordered_map<SSL*, Conn*> ssl_conn_;
   std::vector<Conn*> doomed_;
   time_t now_ = 0;
@@ -3130,7 +3463,7 @@ int main(int argc, char** argv) {
         continue;
       }
       SockRef* ref = static_cast<SockRef*>(events[i].data.ptr);
-      server.handle(ref->conn, ref->is_upstream, events[i].events);
+      server.handle(ref, events[i].events);
     }
     server.flush_doomed();
     if (draining) {

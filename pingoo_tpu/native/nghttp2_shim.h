@@ -101,6 +101,7 @@ int nghttp2_submit_response(nghttp2_session* session, int32_t stream_id,
                             const nghttp2_data_provider* data_prd);
 int nghttp2_submit_rst_stream(nghttp2_session* session, uint8_t flags,
                               int32_t stream_id, uint32_t error_code);
+int nghttp2_session_resume_data(nghttp2_session* session, int32_t stream_id);
 int nghttp2_session_want_read(nghttp2_session* session);
 int nghttp2_session_want_write(nghttp2_session* session);
 

@@ -1308,3 +1308,120 @@ class TestWebSocketPassthrough:
         finally:
             stack.stop()
             ws.close()
+
+
+class _DelayEchoUpstream(http.server.BaseHTTPRequestHandler):
+    """Path-programmable upstream: /slow waits 1s; /big streams 4 MiB."""
+
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self):
+        if self.path.startswith("/slow"):
+            time.sleep(1.0)
+        if self.path.startswith("/big"):
+            size = 4 * 1024 * 1024
+            self.send_response(200)
+            self.send_header("content-length", str(size))
+            self.end_headers()
+            chunk = b"B" * 65536
+            sent = 0
+            while sent < size:
+                self.wfile.write(chunk)
+                sent += len(chunk)
+            return
+        body = f"resp:{self.path}".encode()
+        self.send_response(200)
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+class TestH2ConcurrentStreaming:
+    """VERDICT r2 item 6: h2 streams are serviced CONCURRENTLY (a slow
+    stream must not head-of-line block its siblings) and response bodies
+    STREAM (a response larger than the old 1 MiB whole-buffer cap must
+    arrive intact)."""
+
+    def _stack(self, tmp_path):
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0),
+                                              _DelayEchoUpstream)
+        threading.Thread(target=srv.serve_forever, daemon=True).start()
+        stack = NativeStack(tmp_path, _block_rules())
+        stack.proc.kill()
+        stack.proc.wait()
+        stack.proc = subprocess.Popen(
+            [HTTPD, str(stack.port), stack.ring_path, "127.0.0.1",
+             str(srv.server_address[1])],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        assert b"listening" in stack.proc.stdout.readline()
+        return srv, stack
+
+    def test_slow_stream_does_not_block_fast_sibling(self, tmp_path):
+        from pingoo_tpu.host import h2 as h2mod
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+        from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+        srv, stack = self._stack(tmp_path)
+        loop = asyncio.new_event_loop()
+        try:
+            async def run():
+                conn = H2UpstreamConnection("127.0.0.1", stack.port)
+                await conn.connect()
+                order = []
+
+                async def one(path, tag):
+                    st, _, body = await conn.request(
+                        "GET", "t.test", path, [("user-agent", "ua")], b"")
+                    order.append(tag)
+                    return st, body
+
+                # the slow stream FIRST, so sequential servicing would
+                # finish it before the fast one
+                slow = asyncio.create_task(one("/slow/a", "slow"))
+                await asyncio.sleep(0.15)  # slow stream reaches upstream
+                fast = asyncio.create_task(one("/fast/b", "fast"))
+                (s_st, s_body), (f_st, f_body) = await asyncio.gather(
+                    slow, fast)
+                await conn.close()
+                assert s_st == 200 and b"resp:/slow/a" in s_body
+                assert f_st == 200 and b"resp:/fast/b" in f_body
+                return order
+
+            order = loop.run_until_complete(asyncio.wait_for(run(), 60))
+            assert order[0] == "fast", order  # no head-of-line blocking
+        finally:
+            loop.close()
+            stack.stop()
+            srv.shutdown()
+
+    def test_big_response_streams_past_buffer_cap(self, tmp_path):
+        from pingoo_tpu.host import h2 as h2mod
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+        from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+        srv, stack = self._stack(tmp_path)
+        loop = asyncio.new_event_loop()
+        try:
+            async def run():
+                conn = H2UpstreamConnection("127.0.0.1", stack.port)
+                await conn.connect()
+                st, headers, body = await conn.request(
+                    "GET", "t.test", "/big", [("user-agent", "ua")], b"")
+                await conn.close()
+                return st, body
+
+            st, body = loop.run_until_complete(asyncio.wait_for(run(), 120))
+            assert st == 200
+            assert len(body) == 4 * 1024 * 1024  # > the old 1 MiB cap
+            assert body[:4] == b"BBBB" and body[-4:] == b"BBBB"
+        finally:
+            loop.close()
+            stack.stop()
+            srv.shutdown()
