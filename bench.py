@@ -29,9 +29,12 @@ includes \\b and >31-position multi-word patterns — whatever the
 compiler cannot lower is host-interpreted and reported via
 `device_residency`) + 128k-entry IP blocklist + 4k ASN bitset;
 replayed-log-style traffic at 5% attack rate. Timing uses a device-side
-chained loop (each iteration's verdict feeds a carried checksum) with an
-empty-loop floor subtracted: per-call wall timing is unreliable on
-tunneled devices, where dispatch returns before execution completes. The
+chained loop (each iteration's verdict feeds a carried checksum, and the
+checksum salts EVERY input column of the next iteration, so XLA's
+while-loop invariant code motion cannot hoist any of the verdict out of
+the loop) with an empty-loop floor subtracted: per-call wall timing is
+unreliable on tunneled devices, where dispatch returns before execution
+completes. The
 per-batch figure is therefore pure on-chip verdict time over the
 device-resident rules; `p_batch_ms` is also the added verdict latency
 for a full batch (the <2 ms budget).
@@ -344,7 +347,20 @@ def main() -> None:
     def verdict_body(tables, arrays, salt):
         B = arrays["asn"].shape[0]
         a = dict(arrays)
-        a["asn"] = a["asn"] + salt  # defeat cross-iteration CSE
+        # Salt EVERY input column so no per-batch work is loop-invariant:
+        # XLA's while-loop code motion hoists computations whose inputs
+        # don't change across iterations, and an asn-only salt (the r1/r2
+        # bench) let it hoist the NFA scans — the dominant cost — out of
+        # the timed loop, overstating throughput ~2x. With the byte
+        # tensors and numeric columns all salted by the carried checksum,
+        # every iteration re-runs the full verdict.
+        a["asn"] = a["asn"] + salt
+        for k in list(a):
+            if k.endswith("_bytes"):
+                a[k] = a[k] ^ salt.astype(jnp.uint8)
+            elif k != "asn" and not k.endswith("_len") and \
+                    jnp.issubdtype(a[k].dtype, jnp.integer):
+                a[k] = a[k] + salt.astype(a[k].dtype)
         leaves = _eval_leaves(plan, tables, a, B)
         eff = [None] * len(plan.leaves)
         for leaf_id, (v, e) in leaves.items():

@@ -25,6 +25,7 @@ http_listener.rs:251-264 -> bel tree-walk with Rust regex).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
 
 import jax
@@ -45,6 +46,16 @@ class NfaTables:
     """
 
     byte_table: jax.Array  # [256, W] uint32
+    # Byte-class compression of byte_table: rows dedup to C <= 256
+    # distinct classes (CRS-scale banks measure C in the tens). cls_map
+    # sends a byte to its class id; cls_table is the deduped [C, W]
+    # table; cls_u16 is the same table split into u16 halves as f32
+    # [C, 2W] for the one-hot-matmul lookup (every value < 2^16 is exact
+    # in f32, and a one-hot row selects exactly one table row, so the
+    # MXU reduction is exact — see scan_chunk's `lookup` strategies).
+    cls_map: jax.Array  # [256] int32
+    cls_table: jax.Array  # [C, W] uint32
+    cls_u16: jax.Array  # [C, 2W] float32
     init_anchored: jax.Array  # [W] injected at t == 0 only
     init_unanchored: jax.Array  # [W] injected every step
     opt: jax.Array  # [W]
@@ -78,12 +89,25 @@ class NfaTables:
 
 jax.tree_util.register_dataclass(
     NfaTables,
-    data_fields=["byte_table", "init_anchored", "init_unanchored", "opt",
+    data_fields=["byte_table", "cls_map", "cls_table", "cls_u16",
+                 "init_anchored", "init_unanchored", "opt",
                  "rep", "carry_mask", "sticky", "accept_word", "accept_mask",
                  "accept_member", "slot_always", "slot_empty_ok"],
     meta_fields=["has_carry", "extra_passes", "identity_accept", "halo_ok",
                  "max_footprint", "num_words", "atoms"],
 )
+
+
+def class_compress(byte_table: np.ndarray):
+    """Dedup a [256, W] byte table into (cls_map [256] i32, cls_table
+    [C, W] u32, cls_u16 [C, 2W] f32 u16-halves). Single source of truth
+    for the class encoding — bank_to_tables and the tp padding path
+    (parallel/mesh.py) must produce bit-identical tables."""
+    cls_table, cls_map = np.unique(byte_table, axis=0, return_inverse=True)
+    cls_u16 = np.concatenate(
+        [(cls_table & 0xFFFF).astype(np.float32),
+         (cls_table >> 16).astype(np.float32)], axis=1)
+    return cls_map.astype(np.int32), cls_table, cls_u16
 
 
 def bank_to_tables(bank: NfaBank) -> NfaTables:
@@ -102,6 +126,9 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
         bt = np.zeros((256, W), dtype=np.uint32)
         bt[:, : byte_table.shape[1]] = byte_table
         byte_table = bt
+
+    # Byte-class compression (trace-free: computed on host numpy).
+    cls_map, cls_table, cls_u16 = class_compress(byte_table)
 
     # Flatten accept pairs in slot order; never-match slots contribute a
     # dead pair (word 0, mask 0) so the identity fast path (J == P, pair
@@ -136,6 +163,9 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
             atoms[-1] = (atoms[-1][0], w + 1)
     return NfaTables(
         byte_table=jnp.asarray(byte_table),
+        cls_map=jnp.asarray(cls_map),
+        cls_table=jnp.asarray(cls_table),
+        cls_u16=jnp.asarray(cls_u16),
         init_anchored=jnp.asarray(pad(bank.init_anchored)),
         init_unanchored=jnp.asarray(pad(bank.init_unanchored)),
         opt=jnp.asarray(pad(bank.opt)),
@@ -159,12 +189,65 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
     )
 
 
+# Byte-class lookup strategy for the scan step (measured on the v5e —
+# see the knob notes in engine/verdict.py):
+#   take     — bc = byte_table[c]: one [256, W] row gather per step.
+#   cls_take — map bytes to class ids once outside the loop, then gather
+#              from the deduped [C, W] table per step.
+#   oh_f32   — class ids once outside the loop, then per step a one-hot
+#              [B, C] f32 matmul against cls_u16 [C, 2W]: the MXU does
+#              the row selection (exact: one-hot x u16-valued f32), the
+#              VPU only recombines the halves. Wins where XLA's gather
+#              lowering is the bottleneck.
+#   auto     — oh_f32 on TPU backends, take elsewhere (CPU test meshes).
+LOOKUP_MODE = os.environ.get("PINGOO_NFA_LOOKUP", "auto")
+
+
+def _resolve_lookup(lookup: str | None) -> str:
+    mode = lookup or LOOKUP_MODE
+    if mode == "auto":
+        return "oh_f32" if jax.default_backend() not in ("cpu",) else "take"
+    return mode
+
+
+def _class_data(tables: NfaTables, data: jax.Array, lookup: str) -> jax.Array:
+    """Pre-transform the byte tensor for the chosen lookup: class-id
+    strategies map bytes -> class ids ONCE, outside the scan loop."""
+    if lookup == "take":
+        return data
+    return jnp.take(tables.cls_map, data.astype(jnp.int32))
+
+
+def _bc_fn(tables: NfaTables, lookup: str):
+    """Per-step byte-class mask: class-ids/bytes [B] -> bc [B, W]."""
+    if lookup == "take":
+        return lambda c: jnp.take(
+            tables.byte_table, c.astype(jnp.int32), axis=0)
+    if lookup == "cls_take":
+        return lambda c: jnp.take(tables.cls_table, c, axis=0)
+    if lookup == "oh_f32":
+        C = tables.cls_u16.shape[0]
+        W = tables.opt.shape[0]
+
+        def bc(c):
+            oh = (c[:, None] == jnp.arange(C, dtype=c.dtype)[None, :]
+                  ).astype(jnp.float32)
+            halves = jnp.dot(oh, tables.cls_u16,
+                             preferred_element_type=jnp.float32)
+            return (halves[:, :W].astype(jnp.uint32)
+                    | (halves[:, W:].astype(jnp.uint32) << jnp.uint32(16)))
+
+        return bc
+    raise ValueError(f"unknown nfa lookup {lookup!r}")
+
+
 def scan_chunk(
     tables: NfaTables,
     data: jax.Array,
     lengths: jax.Array,
     state: jax.Array,
     t_offset,
+    lookup: str | None = None,
 ) -> jax.Array:
     """Advance the NFA over one [B, Lc] byte chunk whose first column sits
     at global position `t_offset`; returns the new [B, W] state. Chunks
@@ -173,6 +256,9 @@ def scan_chunk(
     (the within-device halo split stacks chunks as extra rows, each with
     its own global offset).
     """
+    lookup = _resolve_lookup(lookup)
+    data = _class_data(tables, data, lookup)
+    bc_of = _bc_fn(tables, lookup)
     Lc = data.shape[1]
     one = jnp.uint32(1)
     opt = tables.opt
@@ -193,9 +279,9 @@ def scan_chunk(
         return jnp.pad(x[:, :-1], ((0, 0), (1, 0)))
 
     def step(S, xs):
-        c, t_local = xs  # c: [B] uint8
+        c, t_local = xs  # c: [B] byte or class id
         t = t_local + t_offset  # global byte position ([B] when per_row)
-        bc = jnp.take(tables.byte_table, c.astype(jnp.int32), axis=0)  # [B, W]
+        bc = bc_of(c)  # [B, W]
         if per_row:
             inj = tables.init_unanchored[None, :] | jnp.where(
                 (t == 0)[:, None], tables.init_anchored[None, :],
@@ -259,7 +345,8 @@ def extract_slots(tables: NfaTables, state: jax.Array, lengths: jax.Array,
     return hit | tables.slot_always[None, :]
 
 
-def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array) -> jax.Array:
+def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array,
+             lookup: str | None = None) -> jax.Array:
     """Run the bank over a byte batch.
 
     data: [B, L] uint8 (zero-padded), lengths: [B] int32
@@ -267,7 +354,8 @@ def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array) -> jax.Arra
     """
     B, L = data.shape
     state = scan_chunk(
-        tables, data, lengths, init_scan_state(B, tables.opt.shape[0]), 0)
+        tables, data, lengths, init_scan_state(B, tables.opt.shape[0]), 0,
+        lookup=lookup)
     return extract_slots(tables, state, lengths)
 
 
@@ -527,8 +615,11 @@ def _batch_stacked_states(
         rows = jnp.concatenate([data[k] for k in keys], axis=0)  # [F*B, L]
         lens = jnp.concatenate(
             [lengths[k].astype(jnp.int32) for k in keys])
+        # The union table's class-compression fields are stale (they are
+        # the first member's); force the raw byte_table lookup here.
         state = scan_chunk(union, rows, lens,
-                           init_scan_state(rows.shape[0], offs[-1]), 0)
+                           init_scan_state(rows.shape[0], offs[-1]), 0,
+                           lookup="take")
         for i, k in enumerate(keys):
             out[k] = state[i * B:(i + 1) * B, offs[i]:offs[i + 1]]
     return out

@@ -77,6 +77,14 @@ def table_shardings(mesh: Mesh, tables: Mapping[str, Any]) -> dict:
         return replace(
             t,
             byte_table=NamedSharding(mesh, P(None, "tp")),
+            # Class-compression tables: cls_table shares the word axis;
+            # cls_map is [256] and cls_u16 interleaves u16 halves along
+            # its second axis (lo block then hi block), so a tp split
+            # would not align halves to words — replicate it (it is
+            # C x 2W u32-equivalent, tiny next to the batch tensors).
+            cls_map=repl,
+            cls_table=NamedSharding(mesh, P(None, "tp")),
+            cls_u16=repl,
             init_anchored=w,
             init_unanchored=w,
             opt=w,
@@ -148,9 +156,19 @@ def pad_tables_for_tp(np_tables: dict, tp: int) -> dict:
             # Pad only the word axis; padded words carry no init bits and
             # no carry flag, so their lanes stay dead. Accept/slot arrays
             # index words by value and are replicated, so they need no pad.
+            # The class-compression tables are rebuilt from the padded
+            # byte table (zero padding columns preserve row equality
+            # classes, so the class count is unchanged).
+            from ..ops.nfa_scan import class_compress
+
+            bt = pad_axis(np.asarray(val.byte_table), 1, tp)
+            cls_map, cls_table, cls_u16 = class_compress(bt)
             out[key] = replace(
                 val,
-                byte_table=pad_axis(np.asarray(val.byte_table), 1, tp),
+                byte_table=bt,
+                cls_map=cls_map,
+                cls_table=cls_table,
+                cls_u16=cls_u16,
                 init_anchored=pad_axis(np.asarray(val.init_anchored), 0, tp),
                 init_unanchored=pad_axis(np.asarray(val.init_unanchored), 0, tp),
                 opt=pad_axis(np.asarray(val.opt), 0, tp),

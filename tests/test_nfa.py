@@ -638,3 +638,106 @@ class TestHaloSplitScan:
         nt, _ = self._bank([r"a+b"])
         assert not nt.halo_ok
         assert halo_split_k(nt, 128) == 1
+
+
+class TestLookupStrategies:
+    """Every byte-class lookup strategy of scan_chunk (take / cls_take /
+    oh_f32 — see ops/nfa_scan._bc_fn) must produce bit-identical
+    verdicts: they are alternate lowerings of the same [256, W] table
+    lookup, selected for speed per backend (the one-hot f32 matmul is
+    exact because the table splits into u16 halves, all < 2^16 and so
+    exactly representable in f32, and a one-hot row selects exactly one
+    table row)."""
+
+    SOURCES = [
+        r"(?i)union\s+select", r"\.\./", r"a{10,20}b", r"etc/passwd",
+        r"(?i)<script", r"%3[Cc]", r"eval\(", r"curl/\d", r"bot$",
+        r"\bzgrab\b", r"^/(admin|wp-admin)",
+    ]
+
+    def _build(self):
+        from pingoo_tpu.ops.nfa_scan import bank_to_tables
+
+        patterns = []
+        for src in self.SOURCES:
+            patterns.extend(compile_regex(src))
+        return bank_to_tables(build_bank(patterns))
+
+    def _data(self, rng, B, L):
+        alphabet = b"abcxyz/.<%3CeUNIONunion selectadmivp-curl8botzgra("
+        data = np.zeros((B, L), dtype=np.uint8)
+        lens = np.zeros(B, dtype=np.int32)
+        specials = [b"", b"union  select", b"../", b"a" * 15 + b"b",
+                    b"/etc/passwd", b"<SCRIPT>", b"%3c", b"eval(",
+                    b"curl/7", b"xbot", b"zgrab ", b"/admin"]
+        for i in range(B):
+            raw = specials[i] if i < len(specials) else bytes(
+                rng.choice(alphabet) for _ in range(rng.randint(0, L)))
+            raw = raw[:L]
+            data[i, :len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+            lens[i] = len(raw)
+        return data, lens
+
+    def test_class_compression_is_sound(self):
+        tables = self._build()
+        bt = np.asarray(tables.byte_table)
+        cls_map = np.asarray(tables.cls_map)
+        cls_table = np.asarray(tables.cls_table)
+        # cls_table[cls_map] reconstructs the byte table exactly
+        np.testing.assert_array_equal(cls_table[cls_map], bt)
+        # u16 halves recombine to the class table exactly
+        u16 = np.asarray(tables.cls_u16)
+        W = bt.shape[1]
+        lo = u16[:, :W].astype(np.uint32)
+        hi = u16[:, W:].astype(np.uint32)
+        np.testing.assert_array_equal(lo | (hi << 16), cls_table)
+
+    @pytest.mark.parametrize("lookup", ["cls_take", "oh_f32"])
+    def test_lookup_matches_take(self, lookup):
+        import jax
+
+        from pingoo_tpu.ops.nfa_scan import nfa_scan
+
+        tables = self._build()
+        rng = random.Random(7)
+        data, lens = self._data(rng, 41, 96)
+        want = np.asarray(nfa_scan(tables, data, lens, lookup="take"))
+        got = np.asarray(jax.jit(
+            lambda t, d, n: nfa_scan(t, d, n, lookup=lookup)
+        )(tables, data, lens))
+        np.testing.assert_array_equal(want, got)
+
+    @pytest.mark.parametrize("lookup", ["cls_take", "oh_f32"])
+    def test_lookup_matches_take_in_halo_split(self, lookup):
+        """halo_split_scan routes through scan_chunk with per-row
+        t_offsets; the lookup strategies must compose with that path."""
+        import jax
+
+        from pingoo_tpu.ops.nfa_scan import (halo_split_scan, nfa_scan,
+                                             scan_chunk)
+
+        from pingoo_tpu.ops.nfa_scan import bank_to_tables
+
+        patterns = []
+        for src in (r"(?i)sqlmap", r"curl/\d", r"bot$", r"a{6}b"):
+            patterns.extend(compile_regex(src))
+        tables = bank_to_tables(build_bank(patterns))
+        assert tables.halo_ok
+        rng = random.Random(13)
+        data, lens = self._data(rng, 19, 128)
+        want = np.asarray(nfa_scan(tables, data, lens, lookup="take"))
+        # monkeypatch-free: force the strategy through scan_chunk's env
+        # default by calling with explicit chunks via halo_split_scan,
+        # whose scan_chunk call uses the module default. Instead compare
+        # the strategy directly on the split layout by patching the
+        # default for the duration.
+        import pingoo_tpu.ops.nfa_scan as mod
+        old = mod.LOOKUP_MODE
+        mod.LOOKUP_MODE = lookup
+        try:
+            got = np.asarray(jax.jit(
+                lambda t, d, n: halo_split_scan(t, d, n, 2))(
+                    tables, data, lens))
+        finally:
+            mod.LOOKUP_MODE = old
+        np.testing.assert_array_equal(want, got)
