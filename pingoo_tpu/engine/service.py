@@ -39,23 +39,69 @@ from .batch import (
 from .verdict import action_lanes, evaluate_batch, make_verdict_fn
 
 
-def ensure_jax_backend() -> bool:
-    """Probe the jax backend, degrading axon/tpu failures to CPU.
+def force_cpu_backend() -> None:
+    """Pin jax to the CPU platform before any device op runs.
 
     The ambient environment may pin JAX_PLATFORMS to an accelerator
-    backend whose registration failed (e.g. a dropped tunnel); any jax
-    array op would then raise at an arbitrary point later. Returns True
-    if SOME backend works after probing (possibly CPU), False if jax is
-    unusable entirely.
+    plugin that overrides the env var at registration time, so the
+    config update (not the env var) is the authoritative pin."""
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def ensure_jax_backend(probe_timeout_s: float | None = None) -> bool:
+    """Probe the jax backend, degrading accelerator failures to CPU.
+
+    The ambient environment may pin JAX_PLATFORMS to an accelerator
+    backend whose registration failed or whose transport is wedged
+    (e.g. a dropped device tunnel). A failed registration makes any jax
+    array op raise later; a wedged transport makes backend init HANG —
+    so the probe runs `jax.devices()` in a SUBPROCESS with a deadline
+    (PINGOO_DEVICE_PROBE_TIMEOUT_S, default 60 s; the first accelerator
+    handshake is slow but bounded). On probe failure or timeout the
+    process pins the CPU platform BEFORE its own first device op, which
+    is what makes the device->CPU-XLA->interpreter degradation ladder
+    reachable at all. Returns True if some backend works (possibly
+    CPU), False if jax is unusable entirely.
     """
+    import os
+    import subprocess
+    import sys
+
     try:
         import jax
+    except Exception:
+        return False
 
+    if probe_timeout_s is None:
+        probe_timeout_s = float(
+            os.environ.get("PINGOO_DEVICE_PROBE_TIMEOUT_S", "60"))
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    if platforms != "cpu":
+        # An accelerator may be in play (explicitly requested, or — with
+        # the env var unset — auto-registered by an installed PJRT
+        # plugin): probe it out-of-process so a hung transport cannot
+        # hang us. The probe child inherits our env and so makes the
+        # same backend choice this process would.
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; jax.devices(); print('ok')"],
+                timeout=probe_timeout_s, capture_output=True)
+            if proc.returncode != 0 or b"ok" not in proc.stdout:
+                raise RuntimeError(proc.stderr.decode()[-200:])
+        except Exception:
+            force_cpu_backend()
+    try:
         try:
             jax.devices()
             return True
         except RuntimeError:
-            jax.config.update("jax_platforms", "cpu")
+            force_cpu_backend()
             jax.devices()
             return True
     except Exception:

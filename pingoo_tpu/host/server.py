@@ -70,10 +70,17 @@ class Server:
         lists = load_lists(config.lists)
 
         # Probe the accelerator before table building touches jax at all;
-        # a dead backend degrades to CPU XLA (or pure interpreter).
-        from ..engine.service import ensure_jax_backend
+        # a dead backend degrades to CPU XLA (or pure interpreter). With
+        # --no-device, pin CPU outright: plan assembly below still
+        # builds jax arrays, and an ambient accelerator plugin with a
+        # wedged transport would otherwise hang that first device op.
+        from ..engine.service import ensure_jax_backend, force_cpu_backend
 
-        use_device = self.use_device and ensure_jax_backend()
+        if self.use_device:
+            use_device = ensure_jax_backend()
+        else:
+            force_cpu_backend()
+            use_device = False
         from ..compiler.cache import compile_ruleset_cached
 
         # Service route predicates compile into the same plan as extra

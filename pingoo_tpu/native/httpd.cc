@@ -2151,7 +2151,13 @@ class Server {
   }
 
   void h2_flush(Conn* c) {
-    for (;;) {
+    // Client-side backpressure: stop pulling frames out of nghttp2 once
+    // outbuf is at the cap — a client that raises its flow-control
+    // windows but never reads its socket must not grow outbuf without
+    // bound (streamed DATA bypasses the per-stream pending cap the
+    // moment it leaves `pending`). nghttp2 keeps the frames queued;
+    // the client-socket EPOLLOUT path resumes the drain.
+    while (c->outbuf.size() < kMaxBuffered) {
       const uint8_t* out = nullptr;
       ssize_t n = nghttp2_session_mem_send(c->h2, &out);
       if (n <= 0) break;
@@ -2163,6 +2169,14 @@ class Server {
       return;
     }
     update_client_events(c);
+    // outbuf may have drained below the cap: re-arm upstream reads that
+    // h2_update_stream_events paused on the outbuf gate.
+    if (c->outbuf.size() < kMaxBuffered) {
+      for (auto& [sid, st] : c->h2_streams) {
+        if (st.up_fd >= 0 && st.up_ref != nullptr)
+          h2_update_stream_events(c, st);
+      }
+    }
   }
 
   // Service every completed stream CONCURRENTLY — each proxied stream
@@ -2182,9 +2196,14 @@ class Server {
       it->second.up_queued = false;
       h2_start_stream_proxy(c, sid, it->second.up_target);
     }
+    // Policy runs for EVERY ready stream regardless of upstream-slot
+    // availability: 403s, captcha redirects, and the metrics endpoint
+    // need no upstream, and kAwaitVerdict must enqueue to the verdict
+    // ring promptly. Proxy outcomes that hit the per-connection slot
+    // cap are parked by h2_start_stream_proxy (h2_proxy_wait) and
+    // dispatched as slots free.
     size_t i = 0;
     while (i < c->h2_ready.size()) {
-      if (c->h2_upstreams >= kH2MaxStreamUpstreams) break;
       int32_t sid = c->h2_ready[i];
       c->h2_ready.erase(c->h2_ready.begin() + i);
       auto it = c->h2_streams.find(sid);
@@ -2272,10 +2291,16 @@ class Server {
     h2_process_next(c);
   }
 
-  void h2_update_stream_events(H2Stream& st) {
+  void h2_update_stream_events(Conn* c, H2Stream& st) {
     if (st.up_fd < 0 || st.up_ref == nullptr) return;
     uint32_t ev = 0;
-    if (!st.up_eof && st.pending.size() < kH2PendingCap) ev = EPOLLIN;
+    // Read from the upstream only while BOTH buffers have room: the
+    // per-stream pending cap bounds de-framed bytes awaiting nghttp2,
+    // and the connection outbuf cap bounds bytes a non-reading client
+    // has already been framed (h2_flush re-arms when it drains).
+    if (!st.up_eof && st.pending.size() < kH2PendingCap &&
+        c->outbuf.size() < kMaxBuffered)
+      ev = EPOLLIN;
     if (!st.upbuf.empty() || !st.up_connected) ev |= EPOLLOUT;
     epoll_event e{};
     e.events = ev;
@@ -2601,7 +2626,7 @@ class Server {
     // entry itself survives until nghttp2 closes the stream.
     auto again = c->h2_streams.find(sid);
     if (again != c->h2_streams.end() && again->second.up_fd >= 0)
-      h2_update_stream_events(again->second);
+      h2_update_stream_events(c, again->second);
     h2_flush(c);
   }
 
@@ -2763,7 +2788,7 @@ class Server {
       *data_flags = NGHTTP2_DATA_FLAG_EOF;
     // Draining below the cap re-arms the paused upstream read side.
     if (g_server != nullptr && st.up_fd >= 0)
-      g_server->h2_update_stream_events(st);
+      g_server->h2_update_stream_events(c, st);
     return static_cast<ssize_t>(n);
   }
 
