@@ -849,7 +849,10 @@ class TestNativeH2ChunkedUpstream:
             ring.close()
 
 
-class TestNativeH2TruncatedUpstream:
+class TestNativeH2StreamEdges:
+    """h2 proxying edge behavior against hand-rolled upstreams/clients:
+    truncated upstream bodies and stalled (non-reading) clients."""
+
     def test_truncated_cl_response_resets_stream(self, tmp_path):
         """An upstream dying mid content-length body must NOT become a
         well-formed short response over h2 — the stream is reset so the
@@ -911,6 +914,108 @@ class TestNativeH2TruncatedUpstream:
 
             asyncio.run(flow())
         finally:
+            proc.kill()
+            proc.wait()
+            lsock.close()
+            sidecar.stop()
+            ring.close()
+
+
+    def test_stalled_client_bounds_buffering(self, tmp_path):
+        """h2 client-side backpressure: a client that raises its
+        flow-control windows sky-high and then never reads its socket
+        must NOT make httpd buffer the upstream response without bound.
+        h2_flush stops pulling frames at the outbuf cap and
+        h2_update_stream_events pauses the upstream read, so the bytes
+        httpd drains from an endless upstream plateau near
+        kMaxBuffered + kH2PendingCap + kernel socket buffers."""
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(8)
+        sent = [0]
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = lsock.accept()
+                except OSError:
+                    return
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    ch = conn.recv(65536)
+                    if not ch:
+                        break
+                    data += ch
+                # Endless EOF-framed response: stream until the proxy
+                # stops reading (send blocks) or the test tears down.
+                try:
+                    conn.sendall(b"HTTP/1.1 200 OK\r\n\r\n")
+                    chunk = b"x" * 65536
+                    conn.settimeout(1.0)
+                    while True:
+                        conn.sendall(chunk)
+                        sent[0] += len(chunk)
+                except OSError:
+                    pass
+                finally:
+                    conn.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+
+        from pingoo_tpu.compiler import compile_ruleset
+
+        plan = compile_ruleset(_block_rules(), {})
+        ring = Ring(str(tmp_path / "ring"), capacity=256, create=True)
+        sidecar = RingSidecar(ring, plan, {}, max_batch=32)
+        threading.Thread(target=sidecar.run, daemon=True).start()
+        port = _free_port()
+        proc = subprocess.Popen(
+            [HTTPD, str(port), str(tmp_path / "ring"), "127.0.0.1",
+             str(lsock.getsockname()[1])], stdout=subprocess.PIPE)
+        assert b"listening" in proc.stdout.readline()
+        c = None
+        try:
+            # Hand-rolled h2 client: preface, SETTINGS raising
+            # INITIAL_WINDOW_SIZE to max, a huge connection
+            # WINDOW_UPDATE, one GET — then never read.
+            def frame(ftype, flags, stream, payload):
+                return (len(payload).to_bytes(3, "big")
+                        + bytes([ftype, flags])
+                        + stream.to_bytes(4, "big") + payload)
+
+            settings = frame(0x4, 0, 0,
+                             (4).to_bytes(2, "big")
+                             + (2**31 - 1).to_bytes(4, "big"))
+            winupd = frame(0x8, 0, 0, (2**30).to_bytes(4, "big"))
+            hpack = (b"\x82"            # :method GET (static 2)
+                     b"\x86"            # :scheme http (static 6)
+                     b"\x44\x04/big"    # :path literal, name static 4
+                     b"\x41\x06t.test"  # :authority
+                     b"\x7a\x02ua")     # user-agent
+            headers = frame(0x1, 0x5, 1, hpack)  # END_STREAM|END_HEADERS
+            c = socket.create_connection(("127.0.0.1", port), timeout=10)
+            # Shrink our receive buffer so the kernel absorbs little on
+            # the stalled side and httpd's caps do the bounding.
+            c.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 16384)
+            c.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+                      + settings + winupd + headers
+                      + frame(0x4, 0x1, 0, b""))  # ack server SETTINGS
+            # Wait for the verdict + proxying to start, then give the
+            # upstream time to push as much as httpd will take.
+            deadline = time.time() + 20
+            last = -1
+            while time.time() < deadline:
+                time.sleep(1.0)
+                if sent[0] == last and sent[0] > 0:
+                    break  # upstream send has blocked: backpressure
+                last = sent[0]
+            # kMaxBuffered (1 MiB) + kH2PendingCap (256 KiB) + kernel
+            # socket buffers on both hops; 16 MiB of headroom vs the
+            # endless stream proves the read side actually paused.
+            assert 0 < sent[0] < 16 * 1024 * 1024, sent[0]
+        finally:
+            if c is not None:
+                c.close()
             proc.kill()
             proc.wait()
             lsock.close()
