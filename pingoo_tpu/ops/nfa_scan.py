@@ -199,8 +199,14 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
 #              the row selection (exact: one-hot x u16-valued f32), the
 #              VPU only recombines the halves. Wins where XLA's gather
 #              lowering is the bottleneck.
+#   pair     — class ids outside the loop, then ONE gather from a
+#              [C^2, 2W] pair table per TWO bytes: halves the serial
+#              scan length at the cost of a bigger (trace-derived)
+#              table; falls back to cls_take when the table would
+#              exceed PAIR_TABLE_MAX_BYTES.
 #   auto     — oh_f32 on TPU backends, take elsewhere (CPU test meshes).
 LOOKUP_MODE = os.environ.get("PINGOO_NFA_LOOKUP", "auto")
+PAIR_TABLE_MAX_BYTES = 16 << 20  # C^2 x 2W u32 pair table cap
 
 
 def _resolve_lookup(lookup: str | None) -> str:
@@ -257,8 +263,11 @@ def scan_chunk(
     its own global offset).
     """
     lookup = _resolve_lookup(lookup)
+    if lookup == "pair":
+        C_, W_ = tables.cls_table.shape
+        if C_ * C_ * 2 * W_ * 4 > PAIR_TABLE_MAX_BYTES:
+            lookup = "cls_take"  # pair table would blow HBM; same data prep
     data = _class_data(tables, data, lookup)
-    bc_of = _bc_fn(tables, lookup)
     Lc = data.shape[1]
     one = jnp.uint32(1)
     opt = tables.opt
@@ -278,10 +287,8 @@ def scan_chunk(
         """[B, W] -> value of word w-1 moved into word w (word 0 gets 0)."""
         return jnp.pad(x[:, :-1], ((0, 0), (1, 0)))
 
-    def step(S, xs):
-        c, t_local = xs  # c: [B] byte or class id
-        t = t_local + t_offset  # global byte position ([B] when per_row)
-        bc = bc_of(c)  # [B, W]
+    def advance(S, bc, t):
+        """One byte of the sticky-accept algebra at global position t."""
         if per_row:
             inj = tables.init_unanchored[None, :] | jnp.where(
                 (t == 0)[:, None], tables.init_anchored[None, :],
@@ -305,8 +312,55 @@ def scan_chunk(
         live = t < lengths
         if t_can_be_negative:  # halo warm-up prefix on device 0
             live = (t >= 0) & live
-        S = jnp.where(live[:, None], S_new, S)
-        return S, None
+        return jnp.where(live[:, None], S_new, S)
+
+    if lookup == "pair":
+        # Two bytes per iteration: ONE gather from the [C^2, 2W] pair
+        # table feeds two advance() half-steps, halving the serial loop
+        # length (the gather is the per-step cost driver; see the knob
+        # notes in engine/verdict.py). The pair table is derived from
+        # cls_table INSIDE the trace — loop-invariant, so XLA builds it
+        # once per call, and NfaTables needs no extra (possibly huge)
+        # persistent field.
+        C = tables.cls_table.shape[0]
+        W = tables.opt.shape[0]
+        odd = bool(Lc % 2)
+        if odd:
+            # The pad column is SYNTHETIC, not request data: in chunked
+            # callers (ring / halo) its global position can lie inside
+            # the request — the next chunk owns that byte — so the live
+            # gate alone must NOT be trusted to kill it; the last pair's
+            # second half-step is skipped structurally below.
+            data = jnp.pad(data, ((0, 0), (0, 1)))
+            Lc += 1
+        Lp = Lc // 2
+        pairs = (data[:, 0::2].astype(jnp.int32) * C
+                 + data[:, 1::2].astype(jnp.int32))  # [B, Lp]
+        pair_table = jnp.concatenate(
+            [jnp.repeat(tables.cls_table, C, axis=0),
+             jnp.tile(tables.cls_table, (C, 1))], axis=1)  # [C^2, 2W]
+
+        def pstep(S, xs):
+            pc, tp = xs  # pc: [B] pair id, tp: pair index
+            t = 2 * tp + t_offset
+            bc2 = jnp.take(pair_table, pc, axis=0)  # [B, 2W]
+            S1 = advance(S, bc2[:, :W], t)
+            S2 = advance(S1, bc2[:, W:], t + 1)
+            if odd:
+                S2 = jnp.where(tp == Lp - 1, S1, S2)  # pad byte: no-op
+            return S2, None
+
+        state, _ = jax.lax.scan(
+            pstep, state, (pairs.T, jnp.arange(Lp, dtype=jnp.int32)),
+            unroll=8 if Lp >= 8 else 1)
+        return state
+
+    bc_of = _bc_fn(tables, lookup)
+
+    def step(S, xs):
+        c, t_local = xs  # c: [B] byte or class id
+        t = t_local + t_offset  # global byte position ([B] when per_row)
+        return advance(S, bc_of(c), t), None
 
     # unroll amortizes loop bookkeeping and lets XLA fuse across steps
     # while the single carry stays register/VMEM-resident (~20% on the

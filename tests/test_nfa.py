@@ -692,7 +692,7 @@ class TestLookupStrategies:
         hi = u16[:, W:].astype(np.uint32)
         np.testing.assert_array_equal(lo | (hi << 16), cls_table)
 
-    @pytest.mark.parametrize("lookup", ["cls_take", "oh_f32"])
+    @pytest.mark.parametrize("lookup", ["cls_take", "oh_f32", "pair"])
     def test_lookup_matches_take(self, lookup):
         import jax
 
@@ -707,7 +707,49 @@ class TestLookupStrategies:
         )(tables, data, lens))
         np.testing.assert_array_equal(want, got)
 
-    @pytest.mark.parametrize("lookup", ["cls_take", "oh_f32"])
+    def test_pair_mode_odd_chunk_composition(self):
+        """Pair mode over ODD-width chunks composed ring-style: the
+        synthetic pad byte of a non-final chunk sits at a global
+        position the NEXT chunk owns, so it must be structurally
+        skipped — the live gate alone cannot kill it (its t is inside
+        the request length). Splits a 66-byte field at column 33 and
+        matches a pattern straddling the cut."""
+        import jax
+
+        from pingoo_tpu.ops.nfa_scan import (bank_to_tables, extract_slots,
+                                             init_scan_state, nfa_scan,
+                                             scan_chunk)
+
+        patterns = []
+        for src in (r"needle", r"cut{2}ing", r"bot$"):
+            patterns.extend(compile_regex(src))
+        tables = bank_to_tables(build_bank(patterns))
+        rng = random.Random(3)
+        B, L, cut = 37, 66, 33
+        data = np.zeros((B, L), dtype=np.uint8)
+        lens = np.zeros(B, dtype=np.int32)
+        specials = [b"x" * 30 + b"needle" + b"y" * 20,  # straddles col 33
+                    b"x" * 28 + b"cutting",
+                    b"z" * 60 + b"bot", b"needle", b"bot"]
+        alphabet = b"needlcutibot xyz"
+        for i in range(B):
+            raw = specials[i] if i < len(specials) else bytes(
+                rng.choice(alphabet) for _ in range(rng.randint(0, L)))
+            raw = raw[:L]
+            data[i, :len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+            lens[i] = len(raw)
+        want = np.asarray(nfa_scan(tables, data, lens, lookup="take"))
+
+        def chunked(t, d, n):
+            st = init_scan_state(B, t.opt.shape[0])
+            st = scan_chunk(t, d[:, :cut], n, st, 0, lookup="pair")
+            st = scan_chunk(t, d[:, cut:], n, st, cut, lookup="pair")
+            return extract_slots(t, st, n)
+
+        got = np.asarray(jax.jit(chunked)(tables, data, lens))
+        np.testing.assert_array_equal(want, got)
+
+    @pytest.mark.parametrize("lookup", ["cls_take", "oh_f32", "pair"])
     def test_lookup_matches_take_in_halo_split(self, lookup):
         """halo_split_scan routes through scan_chunk with per-row
         t_offsets; the lookup strategies must compose with that path."""
