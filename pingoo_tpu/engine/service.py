@@ -18,6 +18,7 @@ counted on the metrics surface.
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -216,6 +217,20 @@ class VerdictService:
                 loop = asyncio.get_running_loop()
                 await loop.run_in_executor(
                     None, self._evaluate_sync, [RequestTuple()])
+            # Device-level tracing (SURVEY.md §5 tracing/profiling): the
+            # structured logs + per-batch verdict timings are always on;
+            # PINGOO_PROFILE_DIR additionally captures a jax.profiler
+            # trace of the serving window for offline kernel analysis
+            # (viewable in TensorBoard / xprof).
+            profile_dir = os.environ.get("PINGOO_PROFILE_DIR")
+            if profile_dir and self.use_device:
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(profile_dir)
+                    self._tracing = True
+                except Exception:
+                    self._tracing = False
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -225,6 +240,14 @@ class VerdictService:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        if getattr(self, "_tracing", False):
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._tracing = False
 
     async def evaluate(self, req: RequestTuple) -> Verdict:
         """Await the verdict for one request (the per-request hot call)."""

@@ -52,24 +52,28 @@ from ..ops.nfa_scan import (extract_slots, halo_split_k, halo_split_scan,
 
 I64_MIN = -(2**63)
 
-# Scan layout knobs (measured on the v5e chip, round 3 — see bench.py):
+# Scan layout knobs (see bench.py for the measurement method):
+#
+# MEASUREMENT CAVEAT (round 3): the numbers in earlier revisions of
+# these notes (field 1.73M vs fill 0.74M / halo a wash) came from a
+# timing loop whose scan inputs were loop-invariant, which XLA's
+# while-loop code motion could hoist — they overstate absolute
+# throughput (honest loop: ~2x lower) and the RELATIVE comparisons are
+# suspect in proportion to how much of each variant was hoisted. The
+# knobs remain selectable; defaults will follow honest re-measurement
+# (salted-input chained loops, as bench.py now does).
 #
 # PINGOO_SCAN_PACK: lane/row grouping strategy for the NFA scans
-# (ops/nfa_scan.pack_scan_groups / _batch_stacked_states). "field" (one
-# scan per field) measured FASTEST: 1.73M req/s vs "fill" 0.74M and
-# "single" 0.60M — per-step cost is dominated by the per-field byte-
-# class gather, so lane-sharing multiplies gather-steps instead of
-# saving padding; "length"/"batch" are no-ops on the CRS traffic whose
-# fields bucket to distinct lengths. Kept selectable for re-measurement
-# on other topologies.
+# (ops/nfa_scan.pack_scan_groups / _batch_stacked_states): "field" (one
+# scan per field, the default), "length"/"fill" lane-packing, "single",
+# "batch" row-stacking.
 #
 # PINGOO_HALO_SPLIT: within-device sequence split for bounded-memory
 # banks (ops/nfa_scan.halo_split_scan) — trades serial steps for batch
-# rows (user_agent: 128 steps -> 52 at 4x rows). Measured a WASH on the
-# v5e (1.316 vs 1.308 ms/batch): per-step cost scales with rows, so the
-# step reduction is spent on row growth. Default off; kept selectable
-# because the trade flips wherever the scan is latency- rather than
-# throughput-bound (e.g. small batches).
+# rows (user_agent: 128 steps -> 52 at 4x rows). Default off.
+#
+# PINGOO_NFA_LOOKUP (read in ops/nfa_scan.py): byte-class lookup
+# strategy per scan step — take / cls_take / oh_f32 / pair / auto.
 import os as _os
 
 SCAN_PACK_MODE = _os.environ.get("PINGOO_SCAN_PACK", "field")

@@ -285,6 +285,38 @@ class TestBackendProbe:
             assert "TIMEOUT_DEGRADED_OK" in proc.stdout
 
 
+class TestProfilerHook:
+    def test_profile_dir_captures_trace(self, loop_runner, tmp_path,
+                                        monkeypatch):
+        """PINGOO_PROFILE_DIR wraps the serving window in a
+        jax.profiler trace (SURVEY §5 tracing/profiling)."""
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.engine.batch import RequestTuple
+        from pingoo_tpu.engine.service import VerdictService
+        from pingoo_tpu.expr import compile_expression
+
+        monkeypatch.setenv("PINGOO_PROFILE_DIR", str(tmp_path))
+        rules = [RuleConfig(
+            name="r", actions=(Action.BLOCK,),
+            expression=compile_expression('http_request.path == "/x"'))]
+        plan = compile_ruleset(rules, {})
+        svc = VerdictService(plan, {}, use_device=True, max_wait_us=100)
+
+        async def flow():
+            await svc.start()
+            try:
+                return await svc.evaluate(RequestTuple(path="/x"))
+            finally:
+                await svc.stop()
+
+        v = loop_runner.run(flow())
+        assert v.block
+        # jax writes plugins/profile/<ts>/*.xplane.pb under the dir
+        produced = list(tmp_path.rglob("*"))
+        assert any(p.is_file() for p in produced), produced
+
+
 class TestVerdictServiceFallback:
     def test_host_fallback_on_device_error(self, loop_runner):
         from pingoo_tpu.compiler import compile_ruleset
