@@ -2435,6 +2435,38 @@ class Server {
       c->h2_send.erase(sid);
   }
 
+  // Non-final (1xx) HEADERS: no data provider, stream stays open for
+  // the final response. Headers go through the same strip policy as
+  // final heads (strip_response_header via parse of the rewritten
+  // interim head).
+  void h2_submit_interim(Conn* c, int32_t sid, int status,
+                         const std::string& head) {
+    std::string clean = rewrite_interim_head(head);
+    std::vector<std::pair<std::string, std::string>> hdrs;
+    parse_header_lines(clean, &hdrs);
+    std::vector<nghttp2_nv> nva;
+    std::vector<std::string> keep;
+    keep.reserve(hdrs.size() * 2 + 2);
+    nva.reserve(hdrs.size() + 1);
+    auto push = [&](const std::string& n, const std::string& v) {
+      keep.push_back(n);
+      const std::string& nn = keep.back();
+      keep.push_back(v);
+      const std::string& vv = keep.back();
+      nghttp2_nv nv{};
+      nv.name = reinterpret_cast<uint8_t*>(const_cast<char*>(nn.data()));
+      nv.value = reinterpret_cast<uint8_t*>(const_cast<char*>(vv.data()));
+      nv.namelen = nn.size();
+      nv.valuelen = vv.size();
+      nv.flags = NGHTTP2_NV_FLAG_NONE;
+      nva.push_back(nv);
+    };
+    push(":status", std::to_string(status));
+    for (const auto& kv : hdrs) push(lower(kv.first), kv.second);
+    nghttp2_submit_headers(c->h2, 0, sid, nullptr, nva.data(), nva.size(),
+                           nullptr);
+  }
+
   // Submit the response HEADERS with a STREAMING data provider: DATA
   // frames flow from st.pending as the upstream delivers bytes (no
   // whole-body buffering; responses larger than memory stream through).
@@ -2470,7 +2502,12 @@ class Server {
             head[8] == ' ')
           status = atoi(head.c_str() + 9);
         if (status >= 100 && status < 200) {
-          st.resp_head_buf.erase(0, he + 4);  // interim: skip, keep parsing
+          // Forward interim responses as non-final h2 HEADERS (hyper
+          // relays them; reference http_listener.rs:276-278), with the
+          // same hop-header/identity stripping as final heads. 101 is
+          // not representable in h2 — drop it like nghttp2 would.
+          if (status != 101) h2_submit_interim(c, sid, status, head);
+          st.resp_head_buf.erase(0, he + 4);
           continue;
         }
         std::string rest = st.resp_head_buf.substr(he + 4);

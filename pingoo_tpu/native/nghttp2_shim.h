@@ -99,6 +99,12 @@ int nghttp2_submit_settings(nghttp2_session* session, uint8_t flags,
 int nghttp2_submit_response(nghttp2_session* session, int32_t stream_id,
                             const nghttp2_nv* nva, size_t nvlen,
                             const nghttp2_data_provider* data_prd);
+// pri_spec declared as const void*: we only ever pass NULL, so the
+// struct layout never matters on this side of the ABI.
+int nghttp2_submit_headers(nghttp2_session* session, uint8_t flags,
+                           int32_t stream_id, const void* pri_spec,
+                           const nghttp2_nv* nva, size_t nvlen,
+                           void* stream_user_data);
 int nghttp2_submit_rst_stream(nghttp2_session* session, uint8_t flags,
                               int32_t stream_id, uint32_t error_code);
 int nghttp2_session_resume_data(nghttp2_session* session, int32_t stream_id);

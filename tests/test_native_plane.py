@@ -921,6 +921,73 @@ class TestNativeH2StreamEdges:
             ring.close()
 
 
+    def test_interim_1xx_forwarded_on_h2(self, tmp_path):
+        """An upstream 100 Continue must be relayed as a non-final h2
+        HEADERS (hyper forwards interim responses) without corrupting
+        the final response on the same stream."""
+        from pingoo_tpu.host import h2 as h2mod
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+
+        lsock = socket.socket()
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(8)
+
+        def serve():
+            while True:
+                try:
+                    conn, _ = lsock.accept()
+                except OSError:
+                    return
+                data = b""
+                while b"\r\n\r\n" not in data:
+                    ch = conn.recv(65536)
+                    if not ch:
+                        break
+                    data += ch
+                conn.sendall(
+                    b"HTTP/1.1 100 Continue\r\nserver: leaky\r\n\r\n"
+                    b"HTTP/1.1 200 OK\r\ncontent-length: 2\r\n\r\nok")
+                conn.close()
+
+        threading.Thread(target=serve, daemon=True).start()
+
+        from pingoo_tpu.compiler import compile_ruleset
+
+        plan = compile_ruleset(_block_rules(), {})
+        ring = Ring(str(tmp_path / "ring"), capacity=256, create=True)
+        sidecar = RingSidecar(ring, plan, {}, max_batch=32)
+        threading.Thread(target=sidecar.run, daemon=True).start()
+        port = _free_port()
+        proc = subprocess.Popen(
+            [HTTPD, str(port), str(tmp_path / "ring"), "127.0.0.1",
+             str(lsock.getsockname()[1])], stdout=subprocess.PIPE)
+        assert b"listening" in proc.stdout.readline()
+        try:
+            from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+            async def flow():
+                conn = H2UpstreamConnection("127.0.0.1", port)
+                await conn.connect()
+                try:
+                    return await asyncio.wait_for(
+                        conn.request("GET", "t.test", "/t",
+                                     [("user-agent", "ua")]), 10)
+                finally:
+                    await conn.close()
+
+            st, headers, body = asyncio.run(flow())
+            assert st == 200 and body == b"ok"
+            # the interim head's identity header must not leak through
+            assert ("server", "leaky") not in headers
+        finally:
+            proc.kill()
+            proc.wait()
+            lsock.close()
+            sidecar.stop()
+            ring.close()
+
     def test_stalled_client_bounds_buffering(self, tmp_path):
         """h2 client-side backpressure: a client that raises its
         flow-control windows sky-high and then never reads its socket
