@@ -95,8 +95,11 @@ def bench_blocklist_1m(iters: int = 50, batch: int = 8192) -> dict:
     @jax.jit
     def run_n(buckets, ips, n):
         def body(i, acc):
+            # Salt depends on the carried checksum (defeats dead-code
+            # elimination) AND the loop index (alternates even if the
+            # hit-count parity sticks, so inputs are never invariant).
             salted = ips.at[:, 3].set(
-                ips[:, 3] + (acc % 2).astype(jnp.uint32))
+                ips[:, 3] + ((acc + i) % 2).astype(jnp.uint32))
             hit = v4_buckets_contains(buckets, salted)
             return acc + hit.sum().astype(jnp.int64)
         return jax.lax.fori_loop(0, n, body, jnp.int64(0))
@@ -353,7 +356,10 @@ def main() -> None:
         # bench) let it hoist the NFA scans — the dominant cost — out of
         # the timed loop, overstating throughput ~2x. With the byte
         # tensors and numeric columns all salted by the carried checksum,
-        # every iteration re-runs the full verdict.
+        # every iteration re-runs the full verdict. The salt itself mixes
+        # the LOOP INDEX in (see run_n): a checksum-parity-only salt can
+        # stick at 0 when the match count stays even, which would make
+        # the inputs invariant after all.
         a["asn"] = a["asn"] + salt
         for k in list(a):
             if k.endswith("_bytes"):
@@ -389,7 +395,7 @@ def main() -> None:
     @jax.jit
     def run_n(tables, arrays, n):
         def body(i, acc):
-            m = verdict_body(tables, arrays, acc % 2)
+            m = verdict_body(tables, arrays, (acc + i) % 2)
             return acc + m.sum().astype(jnp.int64)
         return jax.lax.fori_loop(0, n, body, jnp.int64(0))
 

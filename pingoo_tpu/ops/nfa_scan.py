@@ -197,14 +197,20 @@ def bank_to_tables(bank: NfaBank) -> NfaTables:
 #   oh_f32   — class ids once outside the loop, then per step a one-hot
 #              [B, C] f32 matmul against cls_u16 [C, 2W]: the MXU does
 #              the row selection (exact: one-hot x u16-valued f32), the
-#              VPU only recombines the halves. Wins where XLA's gather
-#              lowering is the bottleneck.
+#              VPU only recombines the halves.
 #   pair     — class ids outside the loop, then ONE gather from a
 #              [C^2, 2W] pair table per TWO bytes: halves the serial
 #              scan length at the cost of a bigger (trace-derived)
 #              table; falls back to cls_take when the table would
 #              exceed PAIR_TABLE_MAX_BYTES.
-#   auto     — oh_f32 on TPU backends, take elsewhere (CPU test meshes).
+#   auto     — take, everywhere. Re-measured round 3 with the forced-
+#              alternating salt (the earlier "oh_f32 wins on TPU" call
+#              came from the hoistable loop): on the v5e the [256, W]
+#              row gather beats every other strategy on all three
+#              CRS-corpus banks — oh_f32 by 3.3x on a small-W bank
+#              (user_agent W=5: 0.54 vs 2.77 ms), by 1.7x on the widest
+#              (url W=140: 0.94 vs 1.56 ms) — and on the CPU test
+#              backend take was already the choice.
 LOOKUP_MODE = os.environ.get("PINGOO_NFA_LOOKUP", "auto")
 PAIR_TABLE_MAX_BYTES = 16 << 20  # C^2 x 2W u32 pair table cap
 
@@ -212,7 +218,7 @@ PAIR_TABLE_MAX_BYTES = 16 << 20  # C^2 x 2W u32 pair table cap
 def _resolve_lookup(lookup: str | None) -> str:
     mode = lookup or LOOKUP_MODE
     if mode == "auto":
-        return "oh_f32" if jax.default_backend() not in ("cpu",) else "take"
+        return "take"
     return mode
 
 
