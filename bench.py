@@ -59,8 +59,8 @@ def bench_blocklist_1m(iters: int = 50, batch: int = 8192) -> dict:
     import jax.numpy as jnp
 
     from pingoo_tpu.ops.cidr import (
-        V4PrefixBuckets,
         build_cidr_table,
+        index_v4_buckets,
         v4_buckets_contains,
     )
 
@@ -74,12 +74,11 @@ def bench_blocklist_1m(iters: int = 50, batch: int = 8192) -> dict:
     keys = np.full((2, nmax), 0xFFFFFFFF, dtype=np.uint32)
     keys[0, : len(nets24)] = np.sort(nets24)
     keys[1, : len(addrs)] = np.sort(addrs)
-    buckets = V4PrefixBuckets(
-        keys=jnp.asarray(keys),
-        bucket_prefix=jnp.asarray(np.array([24, 32], dtype=np.int32)),
-        bucket_size=jnp.asarray(
-            np.array([len(nets24), len(addrs)], dtype=np.int32)),
-        aux=build_cidr_table([]),
+    buckets = index_v4_buckets(
+        keys,
+        np.array([24, 32], dtype=np.int32),
+        np.array([len(nets24), len(addrs)], dtype=np.int32),
+        build_cidr_table([]),
     )
 
     # ~10% member probes, v6-mapped words.

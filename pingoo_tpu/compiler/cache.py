@@ -25,7 +25,7 @@ from ..config.schema import RuleConfig
 from ..expr.values import Ip
 from .plan import RulesetPlan, compile_ruleset
 
-FORMAT_VERSION = 6  # bump when plan/table layout changes
+FORMAT_VERSION = 7  # bump when plan/table layout changes
 
 
 def ruleset_fingerprint(rules: list[RuleConfig], lists: dict,

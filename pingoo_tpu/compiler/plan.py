@@ -46,6 +46,7 @@ from .nfa import build_bank
 from ..ops.cidr import build_cidr_table, build_int_set, build_v4_buckets, ip_to_words
 from ..ops.match_ops import build_pattern_table, build_suffix_table
 from ..ops.nfa_scan import bank_to_tables
+from ..ops.window_match import build_window_table
 
 
 @dataclass
@@ -231,19 +232,37 @@ def _assemble_tables(plan: RulesetPlan) -> None:
 
     for field, entries in nfa_groups.items():
         patterns = []
+        win_patterns: list = []
         for leaf_id, leaf in entries:
             if leaf.kind == "contains":
                 alts = [repat.literal_pattern(
                     leaf.pattern.encode("latin-1"), case_insensitive=leaf.ci)]
             else:
                 alts = repat.compile_regex(leaf.pattern)
+            # Fixed-shape literal-ish leaves skip the serial NFA scan
+            # entirely: every alternative must lower to a window pattern
+            # (ops/window_match.py — one MXU conv pair per field instead
+            # of one VPU step per byte).
+            wins = [repat.to_window(lp) for lp in alts
+                    if not lp.never_match]
+            if wins and all(w is not None for w in wins):
+                start = len(win_patterns)
+                win_patterns.extend(wins)
+                plan.bindings[leaf_id] = LeafBinding(
+                    kind="window", field=field,
+                    span=(start, len(win_patterns)),
+                    table_key=f"win_{field}")
+                continue
             start = len(patterns)
             patterns.extend(alts)
             plan.bindings[leaf_id] = LeafBinding(
                 kind="nfa", field=field, span=(start, len(patterns)),
                 table_key=f"nfa_{field}")
-        bank = build_bank(patterns)
-        plan.np_tables[f"nfa_{field}"] = bank_to_tables(bank)
+        if patterns:
+            bank = build_bank(patterns)
+            plan.np_tables[f"nfa_{field}"] = bank_to_tables(bank)
+        if win_patterns:
+            plan.np_tables[f"win_{field}"] = build_window_table(win_patterns)
 
     if ip_preds:
         nets = np.zeros((len(ip_preds), 4), dtype=np.uint32)

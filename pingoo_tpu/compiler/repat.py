@@ -509,6 +509,62 @@ _SPACE = frozenset([0x09, 0x0A, 0x0B, 0x0C, 0x0D, 0x20])
 _ALL = frozenset(range(256))
 
 
+MAX_WINDOW_POSITIONS = 24  # conv kernel width cap for window lowering
+
+
+def to_window(lp: LinearPattern):
+    """Try to express a linear pattern as a fixed-length window pattern
+    for the MXU correlation matcher (ops/window_match.py). Returns a
+    WindowPattern or None.
+
+    Eligible: unanchored, no word boundaries, and — after stripping
+    leading/trailing optional runs, which is exact under search
+    semantics (an unanchored pattern matches iff its mandatory core
+    does; optional edges can always consume nothing) — every position
+    is mandatory and single-byte (or an upper/lower fold pair, or a
+    truly-any byte class). Classes like `.` (everything but \\n) or
+    ranges stay on the NFA path: the zero-weight window position would
+    accept bytes the class excludes.
+    """
+    from ..ops.window_match import ANY, FOLD, RAW, WindowPattern
+
+    if (lp.never_match or lp.anchor_start or lp.anchor_end
+            or lp.anchor_end_abs or lp.boundary_start or lp.boundary_end):
+        return None
+    positions = list(lp.positions)
+    out: list[tuple[int, int]] = []
+    lo = 0
+    hi = len(positions)
+    while lo < hi and positions[lo].quant in (Quant.OPT, Quant.STAR):
+        lo += 1
+    while hi > lo and positions[hi - 1].quant in (Quant.OPT, Quant.STAR):
+        hi -= 1
+    for k in range(lo, hi):
+        pos = positions[k]
+        quant = pos.quant
+        if quant == Quant.PLUS and (k == lo or k == hi - 1):
+            quant = Quant.ONE  # edge x+ keeps one mandatory x; the
+            # repetition extends the match without gating it
+        if quant != Quant.ONE:
+            return None
+        cls = pos.bytes
+        if len(cls) == 1:
+            out.append((RAW, next(iter(cls))))
+        elif len(cls) == 256:
+            out.append((ANY, 0))
+        elif len(cls) == 2:
+            a, b = sorted(cls)
+            if b == a + 0x20 and 0x41 <= a <= 0x5A:
+                out.append((FOLD, b))  # store the lowercase byte
+            else:
+                return None
+        else:
+            return None
+    if len(out) > MAX_WINDOW_POSITIONS:
+        return None
+    return WindowPattern(positions=tuple(out))
+
+
 def _fold_byte(b: int) -> frozenset[int]:
     if 0x41 <= b <= 0x5A:
         return frozenset([b, b + 0x20])

@@ -46,9 +46,10 @@ from ..compiler.plan import RulesetPlan
 from ..config.schema import Action
 from ..expr import execute_as_bool
 from ..ops.cidr import cidr_contains, int_set_contains, v4_buckets_contains
-from ..ops.match_ops import eq_match, prefix_match, reverse_bytes, suffix_match
+from ..ops.match_ops import eq_match, prefix_match, suffix_match
 from ..ops.nfa_scan import (extract_slots, halo_split_k, halo_split_scan,
                             nfa_scan, packed_scan_states)
+from ..ops.window_match import window_hits
 
 I64_MIN = -(2**63)
 
@@ -148,15 +149,6 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
     results: dict[int, tuple] = {}
     no_err = jnp.zeros((B,), dtype=bool)
 
-    # Shared per-field products.
-    rev_cache: dict[str, Any] = {}
-
-    def rev_field(field):
-        if field not in rev_cache:
-            rev_cache[field] = reverse_bytes(
-                arrays[f"{field}_bytes"], arrays[f"{field}_len"])
-        return rev_cache[field]
-
     group_cols: dict[str, Any] = {}
 
     def group_result(key, field, kind):
@@ -169,7 +161,7 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
             elif kind == "prefix":
                 group_cols[key] = prefix_match(data, lens, table)
             else:
-                group_cols[key] = suffix_match(rev_field(field), lens, table)
+                group_cols[key] = suffix_match(data, lens, table)
         return group_cols[key]
 
     nfa_cache: dict[str, Any] = {}
@@ -203,37 +195,46 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
                 nfa_cache[key] = extract_slots(
                     banks[key], states[key], lens[key])
 
-    # Per-leaf NFA extraction: leaves own contiguous slot spans; doing a
-    # per-leaf slice+any would issue hundreds of tiny ops, so instead one
-    # [B, P] x [P, n_leaves] matmul reduces every span at once (MXU does
-    # the OR as a count > 0).
-    nfa_leaf_cache: dict[str, Any] = {}
+    # Per-leaf NFA/window extraction: leaves own contiguous slot spans;
+    # doing a per-leaf slice+any would issue hundreds of tiny ops, so
+    # instead one [B, P] x [P, n_leaves] matmul reduces every span at
+    # once (MXU does the OR as a count > 0).
+    leaf_matrix_cache: dict[str, Any] = {}
 
-    def nfa_leaf_matrix(key, field, spans):
-        if key not in nfa_leaf_cache:
-            hits = nfa_result(key, field)
+    def span_leaf_matrix(key, hits_fn, spans):
+        if key not in leaf_matrix_cache:
+            hits = hits_fn()
             P = hits.shape[1]
             member = np.zeros((P, len(spans)), dtype=np.float32)
             for j, (lo, hi) in enumerate(spans):
                 member[lo:hi, j] = 1.0
             counts = jnp.dot(hits.astype(jnp.float32), jnp.asarray(member),
                              preferred_element_type=jnp.float32)
-            nfa_leaf_cache[key] = counts > 0.0
-        return nfa_leaf_cache[key]
+            leaf_matrix_cache[key] = counts > 0.0
+        return leaf_matrix_cache[key]
 
     ip_one_cache: Any = None
 
-    # Group NFA leaves per bank so extraction is one matmul per bank.
+    # Group NFA/window leaves per bank so extraction is one matmul each.
     nfa_groups: dict[str, tuple[str, list]] = {}
+    win_groups: dict[str, tuple[str, list]] = {}
     for leaf_id, binding in plan.bindings.items():
         if binding.kind == "nfa":
             entry = nfa_groups.setdefault(binding.table_key, (binding.field, []))
+            entry[1].append((leaf_id, binding.span))
+        elif binding.kind == "window":
+            entry = win_groups.setdefault(binding.table_key, (binding.field, []))
             entry[1].append((leaf_id, binding.span))
     if nfa_groups:
         run_packed_scans(nfa_groups)
     nfa_leaf_col = {
         leaf_id: (key, j)
         for key, (field, members) in nfa_groups.items()
+        for j, (leaf_id, _) in enumerate(members)
+    }
+    win_leaf_col = {
+        leaf_id: (key, j)
+        for key, (field, members) in win_groups.items()
         for j, (leaf_id, _) in enumerate(members)
     }
 
@@ -245,7 +246,19 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
         elif k == "nfa":
             key, col = nfa_leaf_col[leaf_id]
             field, members = nfa_groups[key]
-            mat = nfa_leaf_matrix(key, field, [span for _, span in members])
+            mat = span_leaf_matrix(key, lambda key=key, field=field:
+                                   nfa_result(key, field),
+                                   [span for _, span in members])
+            results[leaf_id] = (mat[:, col], no_err)
+        elif k == "window":
+            key, col = win_leaf_col[leaf_id]
+            field, members = win_groups[key]
+            mat = span_leaf_matrix(
+                key,
+                lambda key=key, field=field: window_hits(
+                    tables[key], arrays[f"{field}_bytes"],
+                    arrays[f"{field}_len"]),
+                [span for _, span in members])
             results[leaf_id] = (mat[:, col], no_err)
         elif k == "str_list":
             table = tables[binding.table_key]

@@ -105,18 +105,61 @@ def cidr_match_one(net_words: np.ndarray, prefix: int, ips: jax.Array) -> jax.Ar
     return jnp.all(diff == 0, axis=1)
 
 
+SLOT_BITS = 16  # top-slot fan-out of the bucket index (65536 slots)
+
+
 class V4PrefixBuckets(NamedTuple):
     """Large-list lowering: per-prefix-length sorted v4 key arrays.
 
     keys[i] holds entries of bucket i left-justified; bucket_prefix gives
     each bucket's prefix length; bucket_size the live entry count.
     Non-v4 entries go to an auxiliary CidrTable.
+
+    `starts` (optional) indexes each bucket by the top SLOT_BITS bits of
+    the key: starts[i, h] = first position in keys[i] whose top bits
+    reach h. A probe then binary-searches only its slot's span — the
+    serial gather chain drops from log2(N) steps (jnp.searchsorted: 17
+    at 131k keys, ~16 us/step on the v5e) to a handful. `span_pad` is a
+    DUMMY array whose SHAPE carries the static worst-case slot span in
+    bits (shape is trace-static, so it can drive the Python loop count;
+    the values are meaningless).
     """
 
     keys: jax.Array  # [NB, Nmax] uint32 sorted per bucket
     bucket_prefix: jax.Array  # [NB] int32
     bucket_size: jax.Array  # [NB] int32
     aux: CidrTable  # non-v4 (or odd) entries
+    starts: jax.Array | None = None  # [NB, 2^SLOT_BITS + 1] int32
+    span_pad: jax.Array | None = None  # [max_span.bit_length()] uint8
+
+
+def index_v4_buckets(
+    keys: np.ndarray, bucket_prefix: np.ndarray, bucket_size: np.ndarray,
+    aux: CidrTable,
+) -> V4PrefixBuckets:
+    """Attach the top-bit slot index to raw bucket arrays (keys must be
+    sorted per bucket with live entries left-justified)."""
+    NB = keys.shape[0]
+    nslots = 1 << SLOT_BITS
+    starts = np.zeros((NB, nslots + 1), dtype=np.int32)
+    max_span = 1
+    for i in range(NB):
+        size = int(bucket_size[i])
+        p = int(bucket_prefix[i])
+        live = keys[i, :size].astype(np.uint64)
+        his = live >> max(p - SLOT_BITS, 0)
+        counts = np.bincount(his.astype(np.int64), minlength=nslots)
+        starts[i, 1:] = np.cumsum(counts).astype(np.int32)
+        if size:
+            max_span = max(max_span, int(counts.max()))
+    return V4PrefixBuckets(
+        keys=jnp.asarray(keys),
+        bucket_prefix=jnp.asarray(bucket_prefix),
+        bucket_size=jnp.asarray(bucket_size),
+        aux=aux,
+        starts=jnp.asarray(starts),
+        span_pad=jnp.zeros(int(max_span).bit_length(), dtype=jnp.uint8),
+    )
 
 
 def build_v4_buckets(entries: list[Ip]) -> V4PrefixBuckets:
@@ -143,12 +186,17 @@ def build_v4_buckets(entries: list[Ip]) -> V4PrefixBuckets:
         keys[i, : len(vals)] = np.array(vals, dtype=np.uint32)
         bucket_prefix[i] = p
         bucket_size[i] = len(vals)
-    return V4PrefixBuckets(
-        keys=jnp.asarray(keys),
-        bucket_prefix=jnp.asarray(bucket_prefix),
-        bucket_size=jnp.asarray(bucket_size),
-        aux=build_cidr_table(aux),
-    )
+    return index_v4_buckets(keys, bucket_prefix, bucket_size,
+                            build_cidr_table(aux))
+
+
+def _bucket_key(prefix, v4: jax.Array) -> jax.Array:
+    """Probe key for one bucket: the ip's right-justified top-p bits
+    (shift-by->=32 for prefix 0 / 32 guarded via explicit selects)."""
+    shift = (32 - prefix).astype(jnp.uint32)
+    shifted = v4 >> jnp.clip(shift, 1, 31)
+    return jnp.where(prefix >= 32, v4,
+                     jnp.where(prefix <= 0, jnp.uint32(0), shifted))
 
 
 def v4_buckets_contains(buckets: V4PrefixBuckets, ips: jax.Array) -> jax.Array:
@@ -156,20 +204,41 @@ def v4_buckets_contains(buckets: V4PrefixBuckets, ips: jax.Array) -> jax.Array:
     is_v4 = (ips[:, 0] == 0) & (ips[:, 1] == 0) & (ips[:, 2] == 0xFFFF)
     v4 = ips[:, 3]  # [B] uint32
 
-    def check_bucket(prefix, size, keys_row):
-        shift = (32 - prefix).astype(jnp.uint32)
-        # Guard shift-by->=32 (prefix 0 or 32) via explicit selects.
-        shifted = v4 >> jnp.clip(shift, 1, 31)
-        key = jnp.where(prefix >= 32, v4,
-                        jnp.where(prefix <= 0, jnp.uint32(0), shifted))
-        idx = jnp.searchsorted(keys_row, key)
-        idx = jnp.clip(idx, 0, keys_row.shape[0] - 1)
-        found = (jnp.take(keys_row, idx) == key) & (idx < size)
-        return found  # [B]
+    if buckets.starts is not None:
+        # Slot-indexed lower bound: 2 gathers locate the span, then a
+        # static span_pad.bit_length-long binary search resolves it.
+        steps = buckets.span_pad.shape[0]
 
-    hits = jax.vmap(check_bucket)(
-        buckets.bucket_prefix, buckets.bucket_size, buckets.keys
-    )  # [NB, B]
+        def check_bucket(prefix, size, keys_row, starts_row):
+            key = _bucket_key(prefix, v4)
+            hi = (key >> jnp.clip(prefix - SLOT_BITS, 0, 31).astype(
+                jnp.uint32)).astype(jnp.int32)
+            lo = jnp.take(starts_row, hi)
+            n = jnp.take(starts_row, hi + 1) - lo
+            for _ in range(steps):
+                half = n >> 1
+                mid = lo + half
+                go_right = jnp.take(keys_row, mid) < key
+                lo = jnp.where(go_right, mid + 1, lo)
+                n = jnp.where(go_right, n - half - 1, half)
+            found = (jnp.take(keys_row, jnp.minimum(
+                lo, keys_row.shape[0] - 1)) == key) & (lo < size)
+            return found  # [B]
+
+        hits = jax.vmap(check_bucket)(
+            buckets.bucket_prefix, buckets.bucket_size, buckets.keys,
+            buckets.starts,
+        )  # [NB, B]
+    else:
+        def check_bucket_ss(prefix, size, keys_row):
+            key = _bucket_key(prefix, v4)
+            idx = jnp.searchsorted(keys_row, key)
+            idx = jnp.clip(idx, 0, keys_row.shape[0] - 1)
+            return (jnp.take(keys_row, idx) == key) & (idx < size)
+
+        hits = jax.vmap(check_bucket_ss)(
+            buckets.bucket_prefix, buckets.bucket_size, buckets.keys
+        )
     v4_hit = jnp.any(hits, axis=0) & is_v4
     aux_hit = cidr_contains(buckets.aux, ips)
     return v4_hit | aux_hit

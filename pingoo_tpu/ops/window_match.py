@@ -1,0 +1,143 @@
+"""MXU windowed literal matcher — serial-free `contains`/`matches` for
+fixed-shape patterns.
+
+Most CRS-style signatures are (case-folded) literals: scanner
+user-agents ("sqlmap", "nikto"), keyword `contains` rules ("<?php",
+"${jndi:"), generated tokens. The bit-parallel NFA scan handles them,
+but it pays one serial VPU step per byte position — ~4 us per 128-lane
+tile-step on a v5e regardless of how few patterns ride the bank
+(ops/nfa_scan.py). A fixed-length class-sequence needs none of that
+machinery: matching it at EVERY window offset simultaneously is a
+correlation, and correlations are matmuls — the MXU's home turf.
+
+The trick: a window matches pattern p at offset o iff the weighted sum
+of squared NIBBLE differences is zero:
+
+    ssd[b, o, p] = sum_j w[p,j] * ((hi[b,o+j] - hip[p,j])^2
+                                   + (lo[b,o+j] - lop[p,j])^2)
+
+with hi = byte >> 4, lo = byte & 15. Expanding the squares turns the
+data-dependent parts into ONE correlation of four streams per case
+channel (hi^2, lo^2, hi, lo) against per-pattern kernels, lowered by
+XLA onto the MXU; the pattern-only term is a constant. The nibble
+split is what makes this exact at the TPU's DEFAULT precision: every
+stream value is <= 225 and every kernel value is <= 30 — all integers
+with <= 8 significant bits, bf16-representable — and bf16 x bf16
+products accumulate exactly in f32 (16-bit products, sums < 2^24).
+A whole-byte SSD would need byte^2 terms up to 65025 in the conv
+INPUT, which bf16 cannot represent: that variant verifiably misfires
+on a real v5e while passing on CPU. (Precision.HIGHEST also fixes it,
+but costs ~3x the conv time for the same answer.)
+
+Eight input channels carry the raw and ASCII-lowercased streams; each
+pattern POSITION weights exactly one case channel (raw for
+case-sensitive positions, folded for case-insensitive ones) or
+neither (truly-any positions), so one conv serves any mix of case
+sensitivity. Which patterns qualify is the compiler's call
+(compiler/repat.py to_window): no anchors/boundaries, all positions
+single-byte after optional folding (or any-byte), leading/trailing
+optional runs stripped (sound for search semantics: an unanchored
+pattern matches iff its mandatory core matches).
+
+Replaces per-request Rust regex execution for these rules (reference
+pingoo/rules.rs:37-51 via the bel `matches`/`contains` functions,
+docs/rules.md:71-76) with one batched conv pair per field.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+RAW, FOLD, ANY = 0, 1, 2  # per-position channel codes (ANY: no channel)
+
+
+class WindowPattern(NamedTuple):
+    """One fixed-length window pattern: per-position (channel, byte)."""
+
+    positions: tuple[tuple[int, int], ...]  # (RAW/FOLD/ANY, byte)
+
+
+class WindowTable(NamedTuple):
+    """Device tables for one field's window-pattern group.
+
+    Kernel channel layout (4 per case channel, raw then folded):
+    [hi^2, lo^2, hi, lo] weights — see the module docstring's
+    expansion of the nibble SSD.
+    """
+
+    kernel: jax.Array  # [P, 8, M] f32
+    const: jax.Array  # [P] f32: sum of w * (hip^2 + lop^2)
+    min_len: jax.Array  # [P] int32 pattern length (windows must fit)
+
+
+def build_window_table(patterns: list[WindowPattern]) -> WindowTable:
+    P = max(len(patterns), 1)
+    M = max((len(p.positions) for p in patterns), default=1)
+    M = max(M, 1)
+    kernel = np.zeros((P, 8, M), dtype=np.float32)
+    const = np.zeros(P, dtype=np.float32)
+    min_len = np.zeros(P, dtype=np.int32)
+    if not patterns:
+        # Dead table: an impossible min_len keeps the one pad pattern
+        # from ever matching.
+        min_len[0] = 1 << 20
+    for i, pat in enumerate(patterns):
+        min_len[i] = len(pat.positions)
+        for j, (chan, b) in enumerate(pat.positions):
+            if chan == ANY:
+                continue
+            hp, lp = b >> 4, b & 15
+            base = 4 * chan
+            kernel[i, base + 0, j] = 1.0  # x hi^2
+            kernel[i, base + 1, j] = 1.0  # x lo^2
+            kernel[i, base + 2, j] = -2.0 * hp  # x hi
+            kernel[i, base + 3, j] = -2.0 * lp  # x lo
+            const[i] += float(hp * hp + lp * lp)
+    return WindowTable(
+        kernel=jnp.asarray(kernel),
+        const=jnp.asarray(const),
+        min_len=jnp.asarray(min_len),
+    )
+
+
+def _fold_lower(x: jax.Array) -> jax.Array:
+    is_upper = (x >= 0x41) & (x <= 0x5A)
+    return jnp.where(is_upper, x + 0x20, x)
+
+
+def window_hits(table: WindowTable, data: jax.Array,
+                lengths: jax.Array) -> jax.Array:
+    """data [B, L] uint8 (zero-padded), lengths [B] -> hits [B, P] bool.
+
+    hit[b, p] = exists o: data[b, o : o + m_p] matches pattern p and
+    o + m_p <= lengths[b]. Zero-length patterns match everything
+    (min_len 0 admits o = 0 for every row).
+    """
+    B, L = data.shape
+    P, _, M = table.kernel.shape
+    folded = _fold_lower(data)
+
+    def nibble_streams(d):
+        hi = (d >> 4).astype(jnp.float32)
+        lo = (d & 15).astype(jnp.float32)
+        return [hi * hi, lo * lo, hi, lo]
+
+    x = jnp.stack(nibble_streams(data) + nibble_streams(folded),
+                  axis=1)  # [B, 8, L]
+    x = jnp.pad(x, ((0, 0), (0, 0), (0, M)))  # windows may start at L-1
+    dn = ("NCH", "OIH", "NCH")  # 1-D conv: batch/channel/spatial
+    # Default precision is exact here BY CONSTRUCTION (nibble streams;
+    # see module docstring) — do not "optimize" the streams back to
+    # whole bytes without restoring Precision.HIGHEST.
+    ssd = jax.lax.conv_general_dilated(
+        x, table.kernel, window_strides=(1,), padding="VALID",
+        dimension_numbers=dn) + table.const[None, :, None]  # [B, P, O]
+    O = ssd.shape[2]
+    offs = jnp.arange(O, dtype=jnp.int32)
+    fits = (offs[None, None, :] + table.min_len[None, :, None]
+            <= lengths.astype(jnp.int32)[:, None, None])
+    return ((ssd == 0.0) & fits).any(axis=2)  # [B, P]

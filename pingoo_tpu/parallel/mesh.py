@@ -29,6 +29,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.match_ops import PatternTable
 from ..ops.nfa_scan import NfaTables
+from ..ops.window_match import WindowTable
 
 
 def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
@@ -98,10 +99,23 @@ def table_shardings(mesh: Mesh, tables: Mapping[str, Any]) -> dict:
             slot_empty_ok=repl,
         )
 
+    def shard_window_table(t: WindowTable) -> WindowTable:
+        # Pattern axis is rule-parallel, like PatternTable; the conv and
+        # the per-pattern fit mask are elementwise in P, and the leaf
+        # span matmul contracts P (GSPMD inserts the psum).
+        return WindowTable(
+            kernel=NamedSharding(mesh, P("tp", None, None)),
+            const=NamedSharding(mesh, P("tp")),
+            min_len=NamedSharding(mesh, P("tp")),
+        )
+
     out: dict = {}
     for key, val in tables.items():
         if isinstance(val, PatternTable) and _divisible(val.bytes.shape[0], mesh, "tp"):
             out[key] = shard_pattern_table(val)
+        elif isinstance(val, WindowTable) and _divisible(
+                val.kernel.shape[0], mesh, "tp"):
+            out[key] = shard_window_table(val)
         elif isinstance(val, NfaTables) and _divisible(
                 val.opt.shape[0], mesh, "tp"):
             out[key] = shard_nfa(val)
@@ -149,6 +163,15 @@ def pad_tables_for_tp(np_tables: dict, tp: int) -> dict:
                 lengths=pad_axis(np.asarray(val.lengths), 0, tp,
                                  fill=np.int32(2**30)),
                 ci=pad_axis(np.asarray(val.ci), 0, tp),
+            )
+        elif isinstance(val, WindowTable):
+            # Padded patterns have zero weights (ssd identically 0) but
+            # an impossible min_len, so the fit gate kills them.
+            out[key] = WindowTable(
+                kernel=pad_axis(np.asarray(val.kernel), 0, tp),
+                const=pad_axis(np.asarray(val.const), 0, tp),
+                min_len=pad_axis(np.asarray(val.min_len), 0, tp,
+                                 fill=np.int32(1 << 20)),
             )
         elif isinstance(val, NfaTables):
             from dataclasses import replace

@@ -226,19 +226,24 @@ class TestBackendProbe:
     (found live: a stale device claim hung `jax.devices()` forever and
     the server never bound its listeners)."""
 
-    def test_bogus_accelerator_degrades_to_cpu(self):
+    def test_bogus_accelerator_degrades_to_working_backend(self):
         import subprocess
         import sys
 
         # Separate interpreter: the probe mutates global jax config.
+        # The probe must land on SOME working backend: CPU on plain
+        # hosts, or a real accelerator when one is attached (degrading
+        # past a bogus platform name to a live TPU is correct, so the
+        # assertion accepts any platform that initializes and computes).
         code = (
             "import os; os.environ['JAX_PLATFORMS']='nonexistent_accel';\n"
             "from pingoo_tpu.engine.service import ensure_jax_backend\n"
             "ok = ensure_jax_backend(probe_timeout_s=30)\n"
-            "import jax\n"
+            "import jax, jax.numpy as jnp\n"
             "assert ok, 'backend probe failed entirely'\n"
-            "assert jax.devices()[0].platform == 'cpu', jax.devices()\n"
-            "print('DEGRADED_OK')\n"
+            "assert len(jax.devices()) >= 1, 'no devices after probe'\n"
+            "assert int(jnp.arange(4).sum()) == 6\n"
+            "print('DEGRADED_OK', jax.devices()[0].platform)\n"
         )
         proc = subprocess.run([sys.executable, "-c", code], timeout=120,
                               capture_output=True, text=True)

@@ -27,7 +27,6 @@ from pingoo_tpu.ops.match_ops import (
     build_suffix_table,
     eq_match,
     prefix_match,
-    reverse_bytes,
     suffix_match,
 )
 from pingoo_tpu.ops.nfa_scan import bank_to_tables, nfa_scan
@@ -105,14 +104,20 @@ class TestMatchOps:
             assert got[i, 0] == (d == b"/.env")
             assert got[i, 1] == (d == b"")
 
-        spats = [(b".html", False), (b".env", False), (b".HTML", True)]
+        spats = [(b".html", False), (b".env", False), (b".HTML", True),
+                 (b"", False)]
         stable = build_suffix_table(spats)
-        rev = reverse_bytes(mat, lens)
-        got = np.asarray(suffix_match(rev, lens, stable))
+        got = np.asarray(suffix_match(mat, lens, stable))
         for i, d in enumerate(inputs):
             for j, (p, ci) in enumerate(spats):
                 want = (d.lower() if ci else d).endswith(p.lower() if ci else p)
                 assert got[i, j] == want, (d, p, ci)
+
+    def test_suffix_longer_than_row(self):
+        mat, lens = to_matrix([b"ab", b"xyzab"], L=8)
+        stable = build_suffix_table([(b"zab", False), (b"ab", False)])
+        got = np.asarray(suffix_match(mat, lens, stable))
+        assert got.tolist() == [[False, True], [True, True]]
 
     def test_pattern_longer_than_field(self):
         mat, lens = to_matrix([b"abc"], L=3)
@@ -224,3 +229,138 @@ class TestMultiWordJax:
             for i, d in enumerate(inputs):
                 assert got[i, lo:hi].any() == (gold.search(d) is not None), (
                     src, d)
+
+
+class TestV4BucketIndex:
+    def test_clustered_keys_slot_index(self):
+        """Keys crammed into few top-16 slots stress the slot-span binary
+        search (span >> 1); parity vs the plain searchsorted path."""
+        import jax.numpy as jnp
+        from pingoo_tpu.ops.cidr import index_v4_buckets, SLOT_BITS
+
+        rng = random.Random(9)
+        # 5000 /32 keys all inside 10.0.0.0/18 -> a handful of slots.
+        base = 10 << 24
+        addrs = sorted({base + rng.randrange(1 << 18) for _ in range(5000)})
+        keys = np.array([addrs], dtype=np.uint32)
+        sizes = np.array([len(addrs)], dtype=np.int32)
+        prefixes = np.array([32], dtype=np.int32)
+        indexed = index_v4_buckets(keys, prefixes, sizes, build_cidr_table([]))
+        plain = indexed._replace(starts=None, span_pad=None)
+        probes = [Ip(str(ipaddress.ip_address(base + rng.randrange(1 << 18))))
+                  for _ in range(200)]
+        probes += [Ip(str(ipaddress.ip_address(a))) for a in addrs[:50]]
+        ips = encode_ip_batch(probes)
+        got = np.asarray(v4_buckets_contains(indexed, ips))
+        want = np.asarray(v4_buckets_contains(plain, ips))
+        assert (got == want).all()
+        member = set(addrs)
+        for i, p in enumerate(probes):
+            assert got[i] == (int(p.addr) in member)
+
+    def test_low_prefix_buckets_indexed(self):
+        """Buckets with prefix < SLOT_BITS (keys shorter than the slot
+        id) still index correctly: hi == key."""
+        entries = [Ip("10.0.0.0/8"), Ip("11.0.0.0/8"), Ip("192.168.0.0/16")]
+        buckets = build_v4_buckets(entries)
+        assert buckets.starts is not None
+        probes = [Ip("10.200.1.1"), Ip("11.0.0.1"), Ip("12.0.0.1"),
+                  Ip("192.168.3.4"), Ip("192.169.0.1")]
+        ips = encode_ip_batch(probes)
+        got = np.asarray(v4_buckets_contains(buckets, ips))
+        want = [any(e.contains(p) for e in entries) for p in probes]
+        assert got.tolist() == want
+
+
+class TestWindowMatch:
+    def _hits(self, patterns, inputs, L=None):
+        from pingoo_tpu.ops.window_match import build_window_table, window_hits
+
+        table = build_window_table(patterns)
+        mat, lens = to_matrix(inputs, L=L)
+        return np.asarray(window_hits(table, mat, lens))
+
+    def test_literal_fold_any_vs_re(self):
+        from pingoo_tpu.compiler.repat import compile_regex, to_window
+
+        sources = [r"sqlmap", r"(?i)nikto", r"(?i)python-requests/1\.",
+                   r"(?i)<script", r"\$\{jndi:", r"(?i)union"]
+        pats, keep = [], []
+        for src in sources:
+            alts = compile_regex(src)
+            wins = [to_window(lp) for lp in alts]
+            assert all(w is not None for w in wins), src
+            pats.extend(wins)
+            keep.append(src)
+        inputs = [b"", b"sqlmap/1.8", b"SQLMAP", b"Nikto/2.5.0",
+                  b"python-requests/1.9", b"python-requests/2.0",
+                  b"x<SCRipt>alert(1)", b"a${jndi:ldap://x}", b"UNION SELECT",
+                  b"clean mozilla agent", b"sqlma", b"qlmap"]
+        got = self._hits(pats, inputs)
+        for j, src in enumerate(keep):
+            gold = re.compile(src.encode())
+            for i, d in enumerate(inputs):
+                assert got[i, j] == (gold.search(d) is not None), (src, d)
+
+    def test_window_respects_length_mask(self):
+        """Bytes past lengths[b] are dead even if present in the buffer."""
+        from pingoo_tpu.compiler.repat import compile_regex, to_window
+
+        pats = [to_window(compile_regex("abc")[0])]
+        mat, lens = to_matrix([b"xxabc"], L=8)
+        lens[0] = 3  # only b"xxa" is live
+        from pingoo_tpu.ops.window_match import build_window_table, window_hits
+        got = np.asarray(window_hits(build_window_table(pats), mat, lens))
+        assert not got[0, 0]
+        lens[0] = 5
+        got = np.asarray(window_hits(build_window_table(pats), mat, lens))
+        assert got[0, 0]
+
+    def test_edge_optional_stripping(self):
+        from pingoo_tpu.compiler.repat import compile_regex, to_window
+
+        # Trailing star/opt and edge plus are strippable; mid-pattern
+        # optionals and non-fold classes are not.
+        assert to_window(compile_regex(r"(?i)tok3n[0-9a-f]*")[0]) is not None
+        assert to_window(compile_regex(r"ab?")[0]) is not None
+        assert to_window(compile_regex(r"ab+")[0]) is not None
+        assert to_window(compile_regex(r"a[0-9]c")[0]) is None
+        assert to_window(compile_regex(r"a.c")[0]) is None  # . excludes \n
+        assert to_window(compile_regex(r"^abc")[0]) is None
+        assert to_window(compile_regex(r"abc$")[0]) is None
+        assert to_window(compile_regex(r"\babc")[0]) is None
+        assert to_window(compile_regex(r"ab?c")[0]) is None
+
+    def test_edge_plus_and_star_vs_re(self):
+        from pingoo_tpu.compiler.repat import compile_regex, to_window
+
+        for src in (r"ab+", r"(?i)tok3n[0-9a-f]*", r"x*yz"):
+            alts = compile_regex(src)
+            wins = [to_window(lp) for lp in alts]
+            assert all(w is not None for w in wins), src
+            gold = re.compile(src.encode())
+            inputs = [b"", b"a", b"ab", b"abb", b"TOK3Nff", b"tok3n",
+                      b"yz", b"xxyz", b"xy", b"zzz"]
+            got = self._hits(wins, inputs)
+            for i, d in enumerate(inputs):
+                assert got[i].any() == (gold.search(d) is not None), (src, d)
+
+    def test_plan_routes_literalish_leaves_to_window(self):
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.expr import compile_expression
+
+        rules = [
+            RuleConfig(name="ua", actions=(Action.BLOCK,), expression=
+                compile_expression('http_request.user_agent.matches("(?i)sqlmap")')),
+            RuleConfig(name="kw", actions=(Action.BLOCK,), expression=
+                compile_expression('http_request.url.contains("<?php")')),
+            RuleConfig(name="rx", actions=(Action.BLOCK,), expression=
+                compile_expression(r'http_request.url.matches("sleep\\(\\d+\\)")')),
+        ]
+        plan = compile_ruleset(rules, {})
+        kinds = {b.kind for b in plan.bindings.values()}
+        assert "window" in kinds and "nfa" in kinds
+        win_fields = {b.field for b in plan.bindings.values()
+                      if b.kind == "window"}
+        assert win_fields == {"user_agent", "url"}
