@@ -166,6 +166,21 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
             [lg_bin, str(hport), str(n_requests), "4096", "100"],
             capture_output=True, text=True, timeout=300)
         res = json.loads(out.stdout.strip())
+        # The native plane's own counters explain the block/fail-open
+        # split: behind a slow transport, verdicts that miss the 3 s
+        # deadline fail open (attacks pass rather than stall), so
+        # e2e_blocked alone under-reports the WAF (e2e_fail_open says
+        # how many requests the timeout released).
+        stats = {}
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{hport}/__pingoo/metrics",
+                    timeout=5) as resp:
+                stats = json.loads(resp.read())
+        except Exception:
+            pass
     finally:
         pong.kill()
         httpd.kill()
@@ -177,11 +192,15 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
         "e2e_added_p99_ms": res["p99_ms"],
         "e2e_completed": res["completed"],
         "e2e_blocked": res["blocked"],
+        "e2e_fail_open": stats.get("fail_open"),
+        "e2e_verdicts": stats.get("verdicts"),
         "e2e_errors": res["errors"],
         "e2e_note": ("verdict device reached through a network tunnel in "
                      "this environment; e2e latency/throughput are "
                      "dominated by per-batch tunnel transfers, not chip "
-                     "or data-plane capability"),
+                     "or data-plane capability; verdicts missing the "
+                     "native plane's 3 s deadline fail open, so blocked "
+                     "counts only verdicts that beat the tunnel"),
     }
 
 

@@ -31,6 +31,17 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--bot-score-params", default=None,
                         help="npz of trained bot-score head weights "
                              "(models/botscore.save_params)")
+    parser.add_argument("--native-plane", action="store_true",
+                        help="front traffic with the C++ data plane "
+                             "(epoll httpd + shared-memory verdict ring); "
+                             "the Python plane moves to loopback as the "
+                             "captcha/fail-open target")
+    parser.add_argument("--native-workers", type=int, default=1,
+                        help="SO_REUSEPORT httpd workers per listener "
+                             "(one verdict ring each)")
+    parser.add_argument("--state-dir", default="/var/run/pingoo",
+                        help="ring files + services table directory "
+                             "(native plane)")
     args = parser.parse_args(argv)
 
     init_logging()
@@ -47,20 +58,32 @@ def main(argv: list[str] | None = None) -> int:
         log.info("child process started",
                  extra={"fields": {"pid": child.pid}})
 
-    from .host.server import run
-
     log.info("starting pingoo-tpu", extra={"fields": {
         "config": args.config,
         "listeners": [f"{l.protocol.value}://{l.host}:{l.port}"
                       for l in config.listeners],
         "rules": len(config.rules),
         "device": not args.no_device,
+        "native_plane": args.native_plane,
     }})
     try:
-        asyncio.run(run(config, use_device=not args.no_device,
-                        enable_docker=not args.no_docker,
-                        cache_dir=args.cache_dir,
-                        bot_score_params_path=args.bot_score_params))
+        if args.native_plane:
+            from .host.native_plane import run_native
+
+            asyncio.run(run_native(
+                config, state_dir=args.state_dir,
+                workers=args.native_workers,
+                use_device=not args.no_device,
+                enable_docker=not args.no_docker,
+                cache_dir=args.cache_dir,
+                bot_score_params_path=args.bot_score_params))
+        else:
+            from .host.server import run
+
+            asyncio.run(run(config, use_device=not args.no_device,
+                            enable_docker=not args.no_docker,
+                            cache_dir=args.cache_dir,
+                            bot_score_params_path=args.bot_score_params))
     except KeyboardInterrupt:
         pass
     finally:

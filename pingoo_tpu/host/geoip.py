@@ -251,9 +251,18 @@ class GeoipDB:
         now = time.monotonic()
         hit = self._cache.get(addr)
         if hit is not None and hit[1] > now:
+            if hit[0] is None:  # cached miss
+                raise AddressNotFound(str(ip))
             return hit[0]
         raw = self.reader.lookup_raw(addr)
         if raw is None or not isinstance(raw, dict):
+            # Cache the MISS too: with a partial database, absent
+            # addresses are the common case on hot serving paths (the
+            # ring sidecar enriches every request), and re-walking the
+            # mmdb tree per request would defeat the cache entirely.
+            if len(self._cache) >= self.CACHE_MAX:
+                self._cache.clear()
+            self._cache[addr] = (None, now + self.CACHE_TTL_S)
             raise AddressNotFound(str(ip))
         record = record_from_raw(raw)
         if len(self._cache) >= self.CACHE_MAX:

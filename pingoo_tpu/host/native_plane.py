@@ -1,0 +1,361 @@
+"""Production native-plane runner: the C++ front door as THE data plane.
+
+Topology (reference pingoo runs one Rust process, main.rs:33-85; here
+the data plane is a C++ epoll process per listener and this Python
+process is the policy/control plane):
+
+    client
+      -> native/httpd            public bind, TLS + SNI + acme-tls/1,
+                                 h1/h2, captcha cookie gate, per-request
+                                 WAF verdict enforcement, native service
+                                 routing over the services table,
+                                 graceful SIGTERM drain (20 s cap)
+           -> upstreams          direct, chosen by the on-device route
+                                 verdict (http_listener.rs:266-270 +
+                                 http_proxy_service.rs:101-118 semantics)
+           -> python plane       fail-open target (ring full / verdict
+              (loopback)         deadline), captcha endpoints, and any
+                                 service the native plane cannot carry
+
+This process runs:
+  * the full Python host plane (host/server.py) REBASED to loopback
+    ports — captcha `/__pingoo/captcha*`, static sites, and the
+    fail-open path all land on a complete rules-enforcing server, so
+    degradation never bypasses policy;
+  * the ring sidecar (device verdicts, host-rule merge, geoip
+    enrichment of the C++ plane's asn/country-unknown slots);
+  * a discovery republisher: every 2 s (service_registry.rs:86) the
+    registry snapshot is written to the services table file, which the
+    C++ plane hot-reloads on mtime change;
+  * child lifecycle: SIGTERM to each httpd starts its graceful drain.
+
+Constraint: every HTTP listener must carry the same service ORDER (the
+verdict byte's 5-bit route field indexes one global ordering); configs
+that violate this are rejected at startup rather than mis-routed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import socket
+import subprocess
+from typing import Optional
+
+from ..config.schema import Config
+from ..logging_utils import get_logger
+from .server import Server
+
+log = get_logger("pingoo_tpu.native_plane")
+
+REPUBLISH_INTERVAL_S = 2.0  # reference discovery tick, service_registry.rs:86
+DRAIN_CAP_S = 20.0  # reference graceful-shutdown cap, listeners/mod.rs:28
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _loopback_rebase(config: Config) -> tuple[Config, dict[str, int]]:
+    """Copy the config with every listener moved to a loopback ephemeral
+    port; returns (rebased config, original-listener-name -> new port).
+    The native plane takes over the PUBLIC addresses."""
+    import dataclasses
+
+    from ..config.schema import ListenerProtocol
+
+    ports: dict[str, int] = {}
+    listeners = []
+    for listener in config.listeners:
+        if not listener.protocol.is_http:
+            # TCP proxying stays on the Python plane AT ITS PUBLIC
+            # address — the native front door only fronts HTTP(S), and
+            # silently rebasing a tcp listener to loopback would strand
+            # its clients.
+            listeners.append(listener)
+            continue
+        port = _free_port()
+        ports[listener.name] = port
+        proto = listener.protocol
+        # The Python plane sits behind the native proxy on loopback; TLS
+        # terminates at the native edge, so the inner hop is plaintext.
+        if proto == ListenerProtocol.HTTPS:
+            proto = ListenerProtocol.HTTP
+        listeners.append(dataclasses.replace(
+            listener, host="127.0.0.1", port=port, protocol=proto))
+    rebased = dataclasses.replace(config, listeners=type(config.listeners)(
+        listeners))
+    return rebased, ports
+
+
+class NativePlane:
+    """Owns the C++ httpd processes + ring sidecar + loopback plane."""
+
+    def __init__(self, config: Config, state_dir: str,
+                 use_device: bool = True, workers: int = 1,
+                 httpd_bin: Optional[str] = None, **server_kwargs):
+        from .. import native_ring
+
+        self.config = config
+        self.state_dir = state_dir
+        self.workers = max(1, workers)
+        self.httpd_bin = httpd_bin or os.path.join(
+            native_ring.NATIVE_DIR, "httpd")
+        rebased, self._loopback_ports = _loopback_rebase(config)
+        self.server = Server(rebased, use_device=use_device,
+                             **server_kwargs)
+        self.sidecar = None
+        self._sidecar_thread = None
+        self.rings = []
+        self.procs: list[subprocess.Popen] = []
+        self._republish_task = None
+        self._service_names: list[str] = []
+        self.services_path = os.path.join(state_dir, "services.tbl")
+
+    async def start(self) -> None:
+        import threading
+
+        from .. import native_ring
+        from ..native_ring import Ring, RingSidecar
+
+        if not native_ring.ensure_built():
+            raise RuntimeError(
+                "native data plane requested but the C++ toolchain is "
+                "unavailable (make -C pingoo_tpu/native)")
+        await asyncio.to_thread(
+            subprocess.run, ["make", "-C", native_ring.NATIVE_DIR, "httpd"],
+            check=True, capture_output=True)
+        os.makedirs(self.state_dir, exist_ok=True)
+
+        # Deployment env for the LOOPBACK plane, set here (not in
+        # __init__) so merely constructing a NativePlane cannot leak
+        # these into an unrelated internet-facing Server in the same
+        # process. Server.start() reads both.
+        # - TRUST_XFF: captcha client ids must bind the real client
+        #   address the native gate injects via x-forwarded-for.
+        # - TLS_ALPN: the native TLS transport fronts the public ports,
+        #   so ACME must validate via tls-alpn-01 (http-01 would hit
+        #   the native verdict/route path, not the challenge handler).
+        os.environ["PINGOO_TRUST_XFF"] = "1"
+        if self.config.tls.acme is not None and self.config.tls.acme.domains:
+            os.environ["PINGOO_TLS_ALPN"] = "1"
+
+        await self.server.start()
+
+        if any(l.protocol.is_tls and l.protocol.is_http
+               for l in self.config.listeners):
+            # The rebased config has no TLS listener, so Server skipped
+            # TlsManager — but the NATIVE edge terminates TLS and needs
+            # the store populated (first boot: the self-signed `*`
+            # default, tlsmgr.py; reference tls_manager.rs:193-231).
+            from .tlsmgr import TlsManager
+
+            TlsManager(self.server.tls_dir)
+
+        http_listeners = [l for l in self.config.listeners
+                          if l.protocol.is_http]
+        if not http_listeners:
+            raise RuntimeError("native plane needs at least one http(s) "
+                               "listener")
+        # One global service order: the route verdict's 5-bit field
+        # indexes it (native_ring.write_services_file order).
+        orders = {tuple(l.services) for l in http_listeners}
+        if len(orders) > 1:
+            raise RuntimeError(
+                "native plane requires every HTTP listener to share one "
+                f"service order; got {sorted(orders)} — run the Python "
+                "plane for per-listener service sets")
+        names = [n for n in http_listeners[0].services
+                 if self._is_http_service(n)]
+        self._service_names = names
+
+        # One ring PER (listener, worker): the verdict queue is MPMC, so
+        # two httpd processes sharing a ring would steal each other's
+        # tickets (each discards tickets it does not own, and the victim
+        # requests fail open at the verdict deadline).
+        ring_paths: dict[tuple[str, int], str] = {}
+        for listener in http_listeners:
+            for w in range(self.workers):
+                path = os.path.join(self.state_dir,
+                                    f"ring_{listener.name}_{w}")
+                ring_paths[(listener.name, w)] = path
+                self.rings.append(Ring(path, capacity=16384, create=True))
+        self.sidecar = RingSidecar(
+            self.rings, self.server.plan, self.server.lists,
+            max_batch=1024, services=names or None,
+            geoip=self.server.geoip)
+        self._sidecar_thread = threading.Thread(
+            target=self.sidecar.run, daemon=True)
+        self._sidecar_thread.start()
+
+        await asyncio.to_thread(self._write_services)
+
+        tls_dir = self.server.tls_dir
+        alpn_dir = os.path.join(tls_dir, "alpn")
+        for listener in http_listeners:
+            fail_open_port = self._loopback_ports[listener.name]
+            for w in range(self.workers):
+                argv = [
+                    self.httpd_bin, str(listener.port),
+                    ring_paths[(listener.name, w)],
+                    "127.0.0.1", str(fail_open_port),
+                    "--captcha-upstream", f"127.0.0.1:{fail_open_port}",
+                    "--jwks", self.server.captcha_jwks_path,
+                    "--services", self.services_path,
+                    "--bind", listener.host,
+                ]
+                if listener.protocol.is_tls:
+                    argv += ["--tls-dir", tls_dir]
+                    if os.path.isdir(alpn_dir):
+                        argv += ["--alpn-dir", alpn_dir]
+                proc = subprocess.Popen(argv, stdout=subprocess.PIPE)
+                self.procs.append(proc)  # before the bind check: a
+                # failed worker must still be reaped by stop()
+                try:
+                    # The bind banner arrives only after cert/ring setup;
+                    # a wedged child must not freeze the event loop (and
+                    # with it the loopback plane + signal handling).
+                    line = await asyncio.wait_for(
+                        asyncio.to_thread(proc.stdout.readline), timeout=60)
+                except asyncio.TimeoutError:
+                    raise RuntimeError(
+                        f"native httpd stalled before binding "
+                        f"{listener.host}:{listener.port}")
+                if b"listening" not in line:
+                    raise RuntimeError(
+                        f"native httpd failed to bind "
+                        f"{listener.host}:{listener.port}: {line!r}")
+            log.info("native listener up", extra={"fields": {
+                "listener": listener.name,
+                "address": f"{listener.host}:{listener.port}",
+                "tls": listener.protocol.is_tls,
+                "workers": self.workers,
+                "fail_open": f"127.0.0.1:{fail_open_port}",
+            }})
+        self._republish_task = asyncio.create_task(self._republish_loop())
+
+    def _is_http_service(self, name: str) -> bool:
+        svc = next(s for s in self.config.services if s.name == name)
+        return svc.tcp_proxy is None
+
+    def _loopback_target(self, name: str) -> tuple[str, int]:
+        listener = next(l for l in self.config.listeners
+                        if name in l.services)
+        return ("127.0.0.1", self._loopback_ports[listener.name])
+
+    def _write_services(self) -> None:
+        """Snapshot the registry into the native routing table (runs in
+        a worker thread: gethostbyname blocks). Targets the native
+        connector cannot speak to directly — static sites, TLS
+        upstreams — route to the loopback Python plane, which serves /
+        proxies them with full policy; plain upstreams whose address
+        cannot resolve are skipped."""
+        from ..native_ring import write_services_file
+
+        table = []
+        for name in self._service_names:
+            svc = next(s for s in self.config.services if s.name == name)
+            ups = []
+            via_python = False
+            if svc.static is not None:
+                via_python = True  # served by the Python plane
+            else:
+                for u in self.server.registry.get_upstreams(name):
+                    if u.tls:
+                        # Native upstream hop is plaintext h1/h2; the
+                        # Python proxy carries the TLS hop instead.
+                        via_python = True
+                        continue
+                    addr = u.ip or u.hostname
+                    try:
+                        addr = socket.gethostbyname(addr)
+                    except OSError:
+                        # Unresolvable here (or IPv6-only —
+                        # gethostbyname is v4): the Python proxy can
+                        # still reach it, so route via the loopback
+                        # plane instead of publishing a dead service.
+                        via_python = True
+                        continue
+                    ups.append((addr, u.port))
+            if via_python:
+                ups.append(self._loopback_target(name))
+            table.append((name, ups))
+        write_services_file(self.services_path, table)
+
+    async def _republish_loop(self) -> None:
+        last = None
+        while True:
+            await asyncio.sleep(REPUBLISH_INTERVAL_S)
+            try:
+                snapshot = [
+                    (n, tuple(
+                        (u.ip or u.hostname, u.port, u.tls)
+                        for u in self.server.registry.get_upstreams(n)))
+                    for n in self._service_names
+                ]
+                if snapshot != last:
+                    await asyncio.to_thread(self._write_services)
+                    last = snapshot
+            except Exception as exc:  # keep the loop alive on blips
+                log.warning("services republish failed",
+                            extra={"fields": {"error": repr(exc)}})
+
+    async def serve_forever(self) -> None:
+        await self.server.serve_forever()
+
+    async def stop(self) -> None:
+        if self._republish_task is not None:
+            self._republish_task.cancel()
+        # Graceful drain: SIGTERM starts the C++ plane's connection
+        # drain; it exits when idle or at its internal cap.
+        for proc in self.procs:
+            log.info("draining native worker", extra={"fields": {
+                "pid": proc.pid, "poll": proc.poll()}})
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        deadline = asyncio.get_event_loop().time() + DRAIN_CAP_S
+        for proc in self.procs:
+            remaining = deadline - asyncio.get_event_loop().time()
+            try:
+                await asyncio.wait_for(
+                    asyncio.to_thread(proc.wait),
+                    timeout=max(0.5, remaining))
+            except asyncio.TimeoutError:
+                proc.kill()
+        if self.sidecar is not None:
+            self.sidecar.stop()
+        if self._sidecar_thread is not None:
+            self._sidecar_thread.join(timeout=10)
+        for ring in self.rings:
+            ring.close()
+        await self.server.stop()
+
+
+async def run_native(config: Config, state_dir: str, **kwargs) -> None:
+    """Native-plane main(): build, serve, drain on SIGINT/SIGTERM."""
+    plane = NativePlane(config, state_dir, **kwargs)
+    try:
+        await plane.start()
+    except BaseException:
+        # Partial startup must not orphan C++ workers holding public
+        # ports (their ring would have no consumer once we exit).
+        await plane.stop()
+        raise
+    loop = asyncio.get_running_loop()
+    stop_event = asyncio.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop_event.set)
+        except NotImplementedError:
+            pass
+    serve_task = asyncio.create_task(plane.serve_forever())
+    await stop_event.wait()
+    log.info("shutdown signal: draining native plane")
+    serve_task.cancel()
+    await plane.stop()
+    log.info("native plane drained")

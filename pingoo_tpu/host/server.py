@@ -68,6 +68,11 @@ class Server:
                  else GeoipDB.load())
         captcha = CaptchaManager(self.captcha_jwks_path)
         lists = load_lists(config.lists)
+        # Exposed for the native-plane runner (host/native_plane.py):
+        # its ring sidecar shares this plan/lists/geoip so the C++ front
+        # door and the Python plane compute identical verdicts.
+        self.geoip = geoip
+        self.lists = lists
 
         # Probe the accelerator before table building touches jax at all;
         # a dead backend degrades to CPU XLA (or pure interpreter). With
@@ -89,6 +94,7 @@ class Server:
         plan = compile_ruleset_cached(
             list(config.rules), lists, cache_dir=self.cache_dir,
             routes=routes)
+        self.plan = plan
         bot_params = None
         if self.bot_score_params_path:
             from ..models.botscore import load_params

@@ -256,7 +256,7 @@ class RingSidecar:
 
     def __init__(self, ring, plan, lists, max_batch: int = 1024,
                  idle_sleep_s: float = 0.0002, pipeline_depth: int = 3,
-                 services: Optional[list] = None):
+                 services: Optional[list] = None, geoip=None):
         from .engine.verdict import make_lane_fn
 
         self.rings: list[Ring] = list(ring) if isinstance(
@@ -298,6 +298,15 @@ class RingSidecar:
                 if ridx is not None and by_index[ridx].host:
                     self._host_routes.append((order, by_index[ridx].program))
         self._tables = plan.device_tables()
+        # The C++ plane has no mmdb decoder: it enqueues slots with
+        # asn=0 / country="XX" (its unknown markers). The reference
+        # resolves geoip per request in the listener
+        # (http_listener.rs:143-157); here the sidecar enriches those
+        # rows from the host GeoipDB (host/geoip.py, cached) before
+        # encoding, so geo/asn rules see real values for natively
+        # fronted traffic too. None disables (geo rules then evaluate
+        # on XX/0, the reference's missing-database behavior).
+        self.geoip = geoip
         self.processed = 0
         self.truncated_rows = 0
         self.spilled_rows = 0  # overflow rows re-evaluated untruncated
@@ -340,6 +349,16 @@ class RingSidecar:
                     budget -= len(s)
             n = sum(len(s) for _, s in parts)
             if n:
+                if self.geoip is not None:
+                    # Enrich IN the per-ring slot arrays (dequeue_batch
+                    # copies, so this is safe) BEFORE merging: both the
+                    # device batch below and the overflow-spill
+                    # re-interpretation (_interpret_overflow_row reads
+                    # the per-ring part) must see the same geo values —
+                    # enriching only a merged copy would let >2048-byte
+                    # spill rows evaluate geo rules on the XX/0 markers.
+                    for _, s in parts:
+                        self._enrich_slots(s)
                 slots = parts[0][1] if len(parts) == 1 else np.concatenate(
                     [s for _, s in parts])
                 # Pad the batch axis to one fixed shape (a partial batch
@@ -366,6 +385,30 @@ class RingSidecar:
         while inflight:
             self._complete(*inflight.popleft())
         return self.processed
+
+    def _enrich_slots(self, slots: np.ndarray) -> None:
+        """Fill asn/country in place for rows the producer enqueued with
+        the unknown markers (asn 0 + country "XX"). GeoipDB caches both
+        hits and misses (host/geoip.py), so steady-state cost per row is
+        one dict probe; everything downstream (device batch encoding AND
+        overflow-spill re-interpretation) reads the enriched slots."""
+        import ipaddress
+
+        need = (slots["asn"] == 0) & (slots["country"] == b"XX")
+        if not need.any():
+            return
+        ips16 = slots["ip"].reshape(-1, 16)
+        for i in np.nonzero(need)[0]:
+            addr = ipaddress.ip_address(bytes(ips16[i]))
+            mapped = getattr(addr, "ipv4_mapped", None)
+            try:
+                rec = self.geoip.lookup(mapped or addr)
+            except Exception:
+                continue  # not found / loopback: keep the XX/0 markers
+            slots["asn"][i] = rec.asn
+            cc = rec.country.encode("ascii", "replace")[:2]
+            if len(cc) == 2:
+                slots["country"][i] = cc
 
     def _complete(self, parts, slots, raw_batch, dev, n: int) -> None:
         from .engine.verdict import host_rule_lanes, merge_lanes

@@ -1597,3 +1597,241 @@ class TestH2ConcurrentStreaming:
             loop.close()
             stack.stop()
             srv.shutdown()
+
+
+class TestSidecarGeoEnrichment:
+    """The C++ plane enqueues asn=0/country=XX (it has no mmdb decoder);
+    the sidecar must fill real geo columns before the verdict so geo/asn
+    rules fire for natively fronted traffic (reference resolves geoip in
+    the listener, http_listener.rs:143-157)."""
+
+    def test_geo_rule_fires_via_ring(self, tmp_path):
+        import ipaddress
+
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.expr import compile_expression
+        from pingoo_tpu.host.geoip import GeoipDB, MmdbReader, build_mmdb
+
+        mmdb = build_mmdb({
+            "203.0.113.0/24": {
+                "country": {"iso_code": "ZZ"},
+                "autonomous_system_number": 64999,
+            },
+        })
+        geoip = GeoipDB(MmdbReader(mmdb))
+        rules = [
+            RuleConfig(name="geo", actions=(Action.BLOCK,),
+                       expression=compile_expression(
+                           'client.country == "ZZ"')),
+            RuleConfig(name="asn", actions=(Action.BLOCK,),
+                       expression=compile_expression(
+                           "client.asn == 64999")),
+        ]
+        plan = compile_ruleset(rules, {})
+        ring = Ring(str(tmp_path / "ring"), capacity=64, create=True)
+        try:
+            ip_in = (b"\x00" * 10 + b"\xff\xff"
+                     + ipaddress.ip_address("203.0.113.7").packed)
+            ip_out = (b"\x00" * 10 + b"\xff\xff"
+                      + ipaddress.ip_address("198.51.100.9").packed)
+            t_hit = ring.enqueue(method=b"GET", host=b"h", path=b"/",
+                                 url=b"/", user_agent=b"ua", ip=ip_in,
+                                 port=2000)
+            t_miss = ring.enqueue(method=b"GET", host=b"h", path=b"/",
+                                  url=b"/", user_agent=b"ua", ip=ip_out,
+                                  port=2000)
+            sidecar = RingSidecar(ring, plan, {}, max_batch=8,
+                                  pipeline_depth=1, geoip=geoip)
+            sidecar.run(max_requests=2)
+            got = {}
+            while True:
+                v = ring.poll_verdict()
+                if v is None:
+                    break
+                got[v[0]] = v[1]
+            assert got[t_hit] & 3 == 1, got  # ZZ/64999 -> block
+            assert got[t_miss] & 3 == 0, got  # not in the mmdb -> none
+        finally:
+            ring.close()
+
+    def test_no_geoip_keeps_markers(self, tmp_path):
+        import ipaddress
+
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.expr import compile_expression
+
+        rules = [RuleConfig(name="geo", actions=(Action.BLOCK,),
+                            expression=compile_expression(
+                                'client.country == "ZZ"'))]
+        plan = compile_ruleset(rules, {})
+        ring = Ring(str(tmp_path / "ring"), capacity=64, create=True)
+        try:
+            ip = (b"\x00" * 10 + b"\xff\xff"
+                  + ipaddress.ip_address("203.0.113.7").packed)
+            t = ring.enqueue(method=b"GET", host=b"h", path=b"/", url=b"/",
+                             user_agent=b"ua", ip=ip, port=2000)
+            sidecar = RingSidecar(ring, plan, {}, max_batch=8,
+                                  pipeline_depth=1)  # geoip=None
+            sidecar.run(max_requests=1)
+            v = ring.poll_verdict()
+            assert v is not None and v[0] == t and v[1] & 3 == 0
+        finally:
+            ring.close()
+
+
+class TestNativePlaneRunner:
+    """Production wiring (host/native_plane.py): config in, C++ front
+    door + loopback Python plane + sidecar + services republisher out."""
+
+    def _write_config(self, tmp_path, port, up_port):
+        import textwrap
+
+        cfg = tmp_path / "pingoo.yml"
+        cfg.write_text(textwrap.dedent(f"""
+        listeners:
+          main:
+            address: "http://127.0.0.1:{port}"
+        services:
+          app:
+            http_proxy: ["http://127.0.0.1:{up_port}"]
+        rules:
+          block-env:
+            expression: http_request.path.starts_with("/.env")
+            actions: [{{action: block}}]
+          block-xss:
+            expression: http_request.url.contains("<script")
+            actions: [{{action: block}}]
+        """))
+        return cfg
+
+    def test_end_to_end(self, tmp_path, loop_runner):
+        import urllib.request
+
+        from pingoo_tpu.config import load_and_validate
+        from pingoo_tpu.host.native_plane import NativePlane
+
+        upstream = http.server.HTTPServer(("127.0.0.1", 0), _Upstream)
+        threading.Thread(target=upstream.serve_forever, daemon=True).start()
+        port = _free_port()
+        config = load_and_validate(str(self._write_config(
+            tmp_path, port, upstream.server_address[1])))
+        plane = NativePlane(
+            config, state_dir=str(tmp_path / "state"), use_device=False,
+            enable_docker=False,
+            geoip_paths=(str(tmp_path / "missing.mmdb"),),
+            captcha_jwks_path=str(tmp_path / "jwks.json"),
+            tls_dir=str(tmp_path / "tls"))
+        loop_runner.run(plane.start(), timeout=180)
+        try:
+            def get(path, expect):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}{path}")
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        return r.status, r.read()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read()
+
+            deadline = time.time() + 60
+            status, body = None, b""
+            while time.time() < deadline:
+                status, body = get("/hello", 200)
+                if status == 200:
+                    break
+                time.sleep(0.5)
+            assert status == 200 and body == b"up:/hello", (status, body)
+            status, _ = get("/.env", 403)
+            assert status == 403
+            status, _ = get("/p?x=<script>alert(1)</script>", 403)
+            assert status == 403
+            # Native metrics surface reachable on the public port.
+            status, body = get("/__pingoo/metrics", 200)
+            assert status == 200
+            stats = json.loads(body)
+            assert stats["blocked"] >= 2 and stats["verdicts"] >= 3
+            assert plane.procs and all(
+                p.poll() is None for p in plane.procs)
+        finally:
+            loop_runner.run(plane.stop(), timeout=60)
+        assert all(p.poll() is not None for p in plane.procs)
+
+
+class TestNativePlaneWiring:
+    def test_tcp_listeners_keep_public_address(self):
+        import dataclasses
+
+        from pingoo_tpu.config.schema import (Config, ListenerConfig,
+                                              ListenerProtocol,
+                                              ServiceConfig, Upstream)
+        from pingoo_tpu.host.native_plane import _loopback_rebase
+
+        up = Upstream(hostname="127.0.0.1", port=9, tls=False, ip="127.0.0.1")
+        config = Config(
+            listeners=(
+                ListenerConfig(name="web", host="0.0.0.0", port=8080,
+                               protocol=ListenerProtocol.HTTP,
+                               services=("app",)),
+                ListenerConfig(name="db", host="0.0.0.0", port=5432,
+                               protocol=ListenerProtocol.TCP,
+                               services=("dbsvc",)),
+            ),
+            services=(
+                ServiceConfig(name="app", http_proxy=(up,)),
+                ServiceConfig(name="dbsvc", tcp_proxy=(up,)),
+            ),
+            rules=(), lists=())
+        rebased, ports = _loopback_rebase(config)
+        by_name = {l.name: l for l in rebased.listeners}
+        assert by_name["web"].host == "127.0.0.1"
+        assert by_name["web"].port == ports["web"]
+        # TCP stays where the user bound it — the native plane does not
+        # front it, so rebasing would strand clients.
+        assert by_name["db"].host == "0.0.0.0"
+        assert by_name["db"].port == 5432
+        assert "db" not in ports
+
+    def test_tls_upstreams_route_via_python_plane(self, tmp_path):
+        from pingoo_tpu.config.schema import (Config, ListenerConfig,
+                                              ListenerProtocol,
+                                              ServiceConfig, Upstream)
+        from pingoo_tpu.host.native_plane import NativePlane
+
+        tls_up = Upstream(hostname="1.2.3.4", port=443, tls=True,
+                          ip="1.2.3.4")
+        plain_up = Upstream(hostname="127.0.0.1", port=9, tls=False,
+                            ip="127.0.0.1")
+        config = Config(
+            listeners=(ListenerConfig(
+                name="web", host="127.0.0.1", port=_free_port(),
+                protocol=ListenerProtocol.HTTP, services=("sec", "plain")),),
+            services=(ServiceConfig(name="sec", http_proxy=(tls_up,)),
+                      ServiceConfig(name="plain", http_proxy=(plain_up,))),
+            rules=(), lists=())
+        plane = NativePlane(config, state_dir=str(tmp_path / "st"),
+                            use_device=False)
+        plane._service_names = ["sec", "plain"]
+
+        class FakeRegistry:
+            def get_upstreams(self, name):
+                return {"sec": [tls_up], "plain": [plain_up]}[name]
+
+        plane.server.registry = FakeRegistry()
+        os.makedirs(plane.state_dir, exist_ok=True)
+        plane._write_services()
+        # Parse the table back into {service: [(ip, port)]} blocks.
+        table = {}
+        current = None
+        for line in open(plane.services_path).read().strip().splitlines():
+            parts = line.split()
+            if parts[0] == "service":
+                current = parts[2]
+                table[current] = []
+            elif parts[0] == "upstream":
+                table[current].append((parts[1], int(parts[2])))
+        # The TLS-only service targets the loopback Python plane, not an
+        # empty set (which would 502 natively).
+        loop_port = plane._loopback_ports["web"]
+        assert table["sec"] == [("127.0.0.1", loop_port)]
+        assert table["plain"] == [("127.0.0.1", 9)]
