@@ -136,8 +136,8 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
     if not native_ring.ensure_built():
         return {"e2e_note": "native toolchain unavailable"}
     ndir = native_ring.NATIVE_DIR
-    subprocess.run(["make", "-C", ndir, "httpd", "pong", "loadgen_http"],
-                   check=True, capture_output=True)
+    _run_tracked(["make", "-C", ndir, "httpd", "pong", "loadgen_http"],
+                 check=True, capture_output=True)
 
     tmp = tempfile.mkdtemp(prefix="pingoo-bench-")
     ring_path = os.path.join(tmp, "ring")
@@ -147,6 +147,7 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
     threading.Thread(target=sidecar.run, daemon=True).start()
     pong = subprocess.Popen([os.path.join(ndir, "pong"), "0"],
                             stdout=subprocess.PIPE)
+    _CHILDREN.append(pong)
     pport = json.loads(pong.stdout.readline())["listening"]
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -155,14 +156,15 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
     httpd = subprocess.Popen(
         [os.path.join(ndir, "httpd"), str(hport), ring_path, "127.0.0.1",
          str(pport)], stdout=subprocess.PIPE)
+    _CHILDREN.append(httpd)
     httpd.stdout.readline()
     time.sleep(0.3)
     try:
         lg_bin = os.path.join(ndir, "loadgen_http")
         # Warm the jitted lane program off the measurement run.
-        subprocess.run([lg_bin, str(hport), "8192", "1024", "100"],
-                       capture_output=True, timeout=300)
-        out = subprocess.run(
+        _run_tracked([lg_bin, str(hport), "8192", "1024", "100"],
+                     capture_output=True, timeout=300)
+        out = _run_tracked(
             [lg_bin, str(hport), str(n_requests), "4096", "100"],
             capture_output=True, text=True, timeout=300)
         res = json.loads(out.stdout.strip())
@@ -220,8 +222,8 @@ def bench_dataplane(n_requests: int = 200_000) -> dict:
     if not native_ring.ensure_built():
         return {"dataplane_note": "native toolchain unavailable"}
     ndir = native_ring.NATIVE_DIR
-    subprocess.run(["make", "-C", ndir, "httpd", "pong", "loadgen_http"],
-                   check=True, capture_output=True)
+    _run_tracked(["make", "-C", ndir, "httpd", "pong", "loadgen_http"],
+                 check=True, capture_output=True)
 
     # Defaults tuned for THIS 1-CPU host (nproc == 1): one worker and
     # c=128 measured fastest (14.1k req/s, p99 16 ms); more workers just
@@ -271,6 +273,7 @@ def bench_dataplane(n_requests: int = 200_000) -> dict:
     drain.start()
     pong = subprocess.Popen([os.path.join(ndir, "pong"), "0"],
                             stdout=subprocess.PIPE)
+    _CHILDREN.append(pong)
     pport = json.loads(pong.stdout.readline())["listening"]
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -287,18 +290,20 @@ def bench_dataplane(n_requests: int = 200_000) -> dict:
             [os.path.join(ndir, "httpd"), str(hport),
              os.path.join(tmp, f"ring{i}"), "127.0.0.1", str(pport)],
             stdout=subprocess.PIPE)
+        _CHILDREN.append(h)
         h.stdout.readline()
         httpds.append(h)
     time.sleep(0.2)
     try:
         lg_bin = os.path.join(ndir, "loadgen_http")
-        subprocess.run([lg_bin, str(hport), "8192", "256", "100"],
-                       capture_output=True, timeout=120)  # warm-up
+        _run_tracked([lg_bin, str(hport), "8192", "256", "100"],
+                     capture_output=True, timeout=120)  # warm-up
         per_lg = n_requests // loadgens
         conc = int(os.environ.get("BENCH_DP_CONC", "128")) // loadgens
         procs = [subprocess.Popen(
             [lg_bin, str(hport), str(per_lg), str(conc), "100"],
             stdout=subprocess.PIPE, text=True) for _ in range(loadgens)]
+        _CHILDREN.extend(procs)
         results = []
         for p in procs:
             out, _ = p.communicate(timeout=300)
@@ -336,13 +341,174 @@ def bench_dataplane(n_requests: int = 200_000) -> dict:
     }
 
 
-def main() -> None:
+def _probe_backend(retries: int = None, timeout_s: int = None):
+    """Initialize the jax backend in a SUBPROCESS with a bounded retry.
+
+    Round 3's bench called jax.devices() bare and died rc=1 when the
+    tunneled TPU transport was wedged, leaving the driver with
+    parsed=null (BENCH_r03.json). A wedged axon backend can also HANG
+    inside init rather than raise, so the probe must be a subprocess
+    with a timeout — an in-process try/except guards neither failure
+    mode. Returns (ok, info_string)."""
+    if retries is None:
+        retries = int(os.environ.get("BENCH_PROBE_RETRIES", "3"))
+    if timeout_s is None:
+        timeout_s = int(os.environ.get("BENCH_PROBE_TIMEOUT", "60"))
+    from __graft_entry__ import JAX_PLATFORM_SHIM
+
+    code = (JAX_PLATFORM_SHIM +
+            "d = jax.devices()\nprint(d[0].platform, len(d))\n")
+    last = ""
+    for attempt in range(retries):
+        try:
+            p = _run_tracked([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s)
+            if p.returncode == 0 and p.stdout.strip():
+                return True, p.stdout.strip()
+            last = (p.stderr or "").strip()[-300:] or f"rc={p.returncode}"
+        except subprocess.TimeoutExpired:
+            last = f"backend init timed out after {timeout_s}s"
+        except Exception as exc:
+            last = repr(exc)[:300]
+        if attempt < retries - 1:
+            time.sleep(5)
+    return False, last
+
+
+_CHILDREN: list = []  # every child process, so the watchdog can reap them
+
+# Exactly ONE result line ever reaches stdout, no matter which thread
+# (main, watchdog) wins: the driver parses the last line, and two racing
+# print() calls can interleave their write()s into an unparseable blob.
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
+def _emit_once(line: str) -> bool:
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return False
+        _EMITTED = True
+        print(line, flush=True)
+        return True
+
+
+def _run_tracked(argv, capture_output=False, text=None, timeout=None,
+                 check=False, **kw):
+    """Like subprocess.run, but the child is registered in _CHILDREN for
+    the watchdog: a watchdog os._exit during an in-flight run() would
+    otherwise orphan the child (probe shims, make, loadgen runs)."""
+    if capture_output:
+        kw["stdout"] = subprocess.PIPE
+        kw["stderr"] = subprocess.PIPE
+    p = subprocess.Popen(argv, text=text, **kw)
+    _CHILDREN.append(p)
+    try:
+        out, err = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        p.communicate()
+        raise
+    if check and p.returncode != 0:
+        raise subprocess.CalledProcessError(p.returncode, argv, out, err)
+    return subprocess.CompletedProcess(argv, p.returncode, out, err)
+
+
+def _emit_error_line(result: dict, error: str) -> None:
+    """The driver must ALWAYS get one parseable JSON line, even when the
+    device is unreachable or the run dies mid-way: emit whatever partial
+    results exist plus the error."""
+    out = {
+        "metric": "waf_requests_per_sec_per_chip_500rules",
+        "value": 0,
+        "unit": "req/s",
+        "vs_baseline": 0.0,
+    }
+    try:
+        out.update(dict(result))
+        out["error"] = error[:500]
+        line = json.dumps(out)
+    except Exception:
+        # The main thread may be mutating `result` mid-copy; a partial
+        # snapshot is not worth losing the line over.
+        line = json.dumps({
+            "metric": "waf_requests_per_sec_per_chip_500rules",
+            "value": 0, "unit": "req/s", "vs_baseline": 0.0,
+            "error": error[:500],
+        })
+    _emit_once(line)
+
+
+def main() -> int:
+    # NOTHING runs outside this guard: env parsing, the __graft_entry__
+    # import, the probe — any exception anywhere must still yield the
+    # one JSON line (round 3's parsed=null came from an unguarded
+    # crash).
+    result: dict = {}
+    try:
+        return _main_guarded(result)
+    except Exception as exc:
+        _emit_error_line(result, repr(exc))
+        return 1
+
+
+def _main_guarded(result: dict) -> int:
+    # Watchdog: if anything later (device transfer, e2e subprocess, ...)
+    # wedges past the deadline, print the partial-result error line and
+    # hard-exit — the driver records a parsed line instead of a timeout.
+    deadline_s = int(os.environ.get("BENCH_WATCHDOG_S", "2400"))
+    done = threading.Event()
+
+    def _watchdog():
+        if not done.wait(deadline_s):
+            if done.is_set() or _EMITTED:
+                return  # main finished right at the deadline: not a hang
+            try:
+                _emit_error_line(result,
+                                 f"bench watchdog fired after {deadline_s}s; "
+                                 f"partial results only")
+                for child in _CHILDREN:  # do not orphan native processes
+                    try:
+                        if child.poll() is None:
+                            child.kill()
+                    except Exception:
+                        pass
+            finally:
+                os._exit(2)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    ok, info = _probe_backend()
+    if not ok:
+        done.set()  # before any emit: the watchdog must never interleave
+        # its own line (or os._exit) with a half-written one
+        _emit_error_line(result, f"jax backend unavailable after bounded "
+                                 f"retries: {info}")
+        return 1
+    result["backend_probe"] = info
+    try:
+        _main_impl(result, done)
+    except Exception as exc:
+        done.set()
+        _emit_error_line(result, repr(exc))
+        return 1
+    finally:
+        done.set()
+    return 0
+
+
+def _main_impl(result: dict, done=None) -> None:
     # 2048 keeps the full-batch verdict inside the 2 ms latency budget on
     # a v5e-1 while giving up only ~5% throughput vs 4096.
     batch_size = int(os.environ.get("BENCH_BATCH", "2048"))
     num_rules = int(os.environ.get("BENCH_RULES", "500"))
     iters = int(os.environ.get("BENCH_ITERS", "200"))
 
+    from __graft_entry__ import apply_jax_platform_env
+
+    apply_jax_platform_env()
     import jax
     import jax.numpy as jnp
 
@@ -440,7 +606,7 @@ def main() -> None:
 
     per_batch_s = (full - (floor_a + floor_b) / 2) / iters
     rps = batch_size / per_batch_s
-    result = {
+    result.update({
         "metric": "waf_requests_per_sec_per_chip_500rules",
         "value": round(rps, 1),
         "unit": "req/s",
@@ -455,7 +621,7 @@ def main() -> None:
         "checksum": checksum,
         "build_s": round(build_s, 1),
         "compile_s": round(compile_s, 1),
-    }
+    })
     if os.environ.get("BENCH_SKIP_BLOCKLIST") != "1":
         try:
             result.update(bench_blocklist_1m())
@@ -471,7 +637,11 @@ def main() -> None:
             result.update(bench_dataplane())
         except Exception as exc:
             result["dataplane_error"] = repr(exc)[:200]
-    print(json.dumps(result))
+    if done is not None:
+        done.set()
+    # The emit-once gate, not print(): a watchdog that timed out a
+    # microsecond before done.set() must not interleave with this line.
+    _emit_once(json.dumps(result))
 
 
 if __name__ == "__main__":
