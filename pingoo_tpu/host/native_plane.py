@@ -98,12 +98,17 @@ class NativePlane:
 
     def __init__(self, config: Config, state_dir: str,
                  use_device: bool = True, workers: int = 1,
-                 httpd_bin: Optional[str] = None, **server_kwargs):
+                 httpd_bin: Optional[str] = None,
+                 upstream_ca: Optional[str] = None, **server_kwargs):
         from .. import native_ring
 
         self.config = config
         self.state_dir = state_dir
         self.workers = max(1, workers)
+        # Trust anchor for TLS upstream hops: system roots by default,
+        # an explicit bundle for private-CA deployments (and tests).
+        self.upstream_ca = upstream_ca or os.environ.get(
+            "PINGOO_UPSTREAM_CA") or None
         self.httpd_bin = httpd_bin or os.path.join(
             native_ring.NATIVE_DIR, "httpd")
         rebased, self._loopback_ports = _loopback_rebase(config)
@@ -213,6 +218,8 @@ class NativePlane:
                     argv += ["--tls-dir", tls_dir]
                     if os.path.isdir(alpn_dir):
                         argv += ["--alpn-dir", alpn_dir]
+                if self.upstream_ca:
+                    argv += ["--upstream-ca", self.upstream_ca]
                 proc = subprocess.Popen(argv, stdout=subprocess.PIPE)
                 self.procs.append(proc)  # before the bind check: a
                 # failed worker must still be reaped by stop()
@@ -250,11 +257,13 @@ class NativePlane:
 
     def _write_services(self) -> None:
         """Snapshot the registry into the native routing table (runs in
-        a worker thread: gethostbyname blocks). Targets the native
-        connector cannot speak to directly — static sites, TLS
+        a worker thread: gethostbyname blocks). Plain AND TLS upstreams
+        are published natively (the C++ connector dials TLS targets with
+        SNI + verification, httpd.cc up_tls_begin); targets the native
+        connector cannot speak to — static sites, h2:// prior-knowledge
         upstreams — route to the loopback Python plane, which serves /
-        proxies them with full policy; plain upstreams whose address
-        cannot resolve are skipped."""
+        proxies them with full policy; upstreams whose address cannot
+        resolve are skipped."""
         from ..native_ring import write_services_file
 
         table = []
@@ -266,9 +275,9 @@ class NativePlane:
                 via_python = True  # served by the Python plane
             else:
                 for u in self.server.registry.get_upstreams(name):
-                    if u.tls:
-                        # Native upstream hop is plaintext h1/h2; the
-                        # Python proxy carries the TLS hop instead.
+                    if u.h2:
+                        # h2:// prior-knowledge framing is a Python-
+                        # plane capability for now.
                         via_python = True
                         continue
                     addr = u.ip or u.hostname
@@ -281,7 +290,13 @@ class NativePlane:
                         # plane instead of publishing a dead service.
                         via_python = True
                         continue
-                    ups.append((addr, u.port))
+                    if u.tls:
+                        # Verify against the configured name when there
+                        # is one; a literal-address upstream pins the
+                        # address itself (IP SAN).
+                        ups.append((addr, u.port, u.hostname or addr))
+                    else:
+                        ups.append((addr, u.port))
             if via_python:
                 ups.append(self._loopback_target(name))
             table.append((name, ups))

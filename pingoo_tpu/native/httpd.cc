@@ -606,6 +606,16 @@ struct Parsed {
   std::vector<std::pair<std::string, std::string>> h2_headers;
 };
 
+// A concrete upstream address plus its transport policy: a `tls`
+// target gets a verified OpenSSL client connection (SNI + hostname
+// check against `sni`), matching the reference's pooled hyper-rustls
+// client (http_proxy_service.rs:54-71).
+struct UpTarget {
+  sockaddr_in sa{};
+  bool tls = false;
+  std::string sni;
+};
+
 // One multiplexed HTTP/2 request in flight on a connection.
 struct SockRef;
 
@@ -621,7 +631,13 @@ struct H2Stream {
   bool up_eof = false;
   bool up_pooled = false;
   uint64_t up_key = 0;
-  sockaddr_in up_target{};
+  UpTarget up_target{};
+  SSL* up_ssl = nullptr;        // non-null on TLS upstream links
+  bool up_tcp_ok = false;       // TCP connect completed
+  bool up_tls_hs = false;       // client handshake in progress
+  bool up_hs_want_write = false;  // handshake blocked on EPOLLOUT
+  bool up_rd_want_write = false;  // SSL_read wants the write event
+  bool up_wr_want_read = false;   // SSL_write wants the read event
   std::string upbuf;       // request bytes awaiting the upstream socket
   std::string up_replay;   // pooled-retry replay copy
   std::string resp_head_buf;
@@ -994,7 +1010,13 @@ struct Conn {
   bool upstream_connected = false;
   bool upstream_eof = false;
   uint64_t up_key = 0;          // pool key of the connected target
-  sockaddr_in up_target{};      // connected target (pooled-retry)
+  UpTarget up_target{};         // connected target (pooled-retry)
+  SSL* up_ssl = nullptr;        // non-null on TLS upstream links
+  bool up_tcp_ok = false;       // TCP connect completed
+  bool up_tls_hs = false;       // client handshake in progress
+  bool up_hs_want_write = false;  // handshake blocked on EPOLLOUT
+  bool up_rd_want_write = false;  // SSL_read wants the write event
+  bool up_wr_want_read = false;   // SSL_write wants the read event
   bool upstream_keep = false;   // response head allows connection reuse
   bool upstream_junk = false;   // upstream sent bytes past the response
   uint64_t enq_ms = 0;          // monotonic ms at ring enqueue (metrics)
@@ -1064,10 +1086,16 @@ const char k404[] =
 //   upstream 127.0.0.1 8082
 //   service 1 api
 //   upstream 127.0.0.1 9001
+//   upstream 10.0.0.9 8443 tls backend.example.com
+//
+// An `upstream <ip> <port> tls <server-name>` entry is proxied over a
+// verified TLS client connection (SNI + hostname check against
+// <server-name>), matching the reference's pooled hyper-rustls client
+// (http_proxy_service.rs:54-71).
 struct ServiceTable {
   std::string path;
   std::vector<std::string> names;
-  std::vector<std::vector<sockaddr_in>> upstreams;  // by service order
+  std::vector<std::vector<UpTarget>> upstreams;  // by service order
   bool loaded = false;
   time_t last_check_ = 0;
   time_t mtime_s_ = 0;
@@ -1082,11 +1110,11 @@ struct ServiceTable {
     FILE* f = fopen(path.c_str(), "r");
     if (f == nullptr) return loaded;
     std::vector<std::string> new_names;
-    std::vector<std::vector<sockaddr_in>> new_ups;
+    std::vector<std::vector<UpTarget>> new_ups;
     char line[512];
     bool ok = true;
     while (fgets(line, sizeof(line), f) != nullptr) {
-      char a[256], b[256];
+      char a[256], b[256], sni[256];
       int port = 0, order = 0;
       if (sscanf(line, "service %d %255s", &order, a) == 2) {
         if (order != static_cast<int>(new_names.size()) || order > 30) {
@@ -1097,19 +1125,46 @@ struct ServiceTable {
         }
         new_names.emplace_back(a);
         new_ups.emplace_back();
-      } else if (sscanf(line, "upstream %255s %d", b, &port) == 2) {
+      } else if (int consumed = 0;
+                 sscanf(line, "upstream %255s %d%n", b, &port,
+                        &consumed) == 2) {
         if (new_ups.empty() || port <= 0 || port > 65535) {
           ok = false;
           break;
         }
-        sockaddr_in sa{};
-        sa.sin_family = AF_INET;
-        sa.sin_port = htons(static_cast<uint16_t>(port));
-        if (inet_pton(AF_INET, b, &sa.sin_addr) != 1) {
+        UpTarget t;
+        t.sa.sin_family = AF_INET;
+        t.sa.sin_port = htons(static_cast<uint16_t>(port));
+        if (inet_pton(AF_INET, b, &t.sa.sin_addr) != 1) {
           ok = false;
           break;
         }
-        new_ups.back().push_back(sa);
+        const char* rest = line + consumed;
+        while (*rest == ' ' || *rest == '\t') rest++;
+        if (strncmp(rest, "tls", 3) == 0 &&
+            (rest[3] == ' ' || rest[3] == '\t')) {
+          int used = 0;
+          if (sscanf(rest, "tls %255s%n", sni, &used) == 1) {
+            const char* tail = rest + used;
+            while (*tail == ' ' || *tail == '\t') tail++;
+            if (*tail != '\0' && *tail != '\n' && *tail != '\r') {
+              ok = false;  // fields past the name (version skew, or an
+              // over-long truncated name): reject, keep last good table
+              break;
+            }
+            t.tls = true;
+            t.sni = sni;
+          } else {
+            // `tls` with no server name must NOT fail open to a
+            // plaintext hop: reject the table, keep the last good one.
+            ok = false;
+            break;
+          }
+        } else if (*rest != '\0' && *rest != '\n' && *rest != '\r') {
+          ok = false;  // unknown trailing fields: same fail-closed rule
+          break;
+        }
+        new_ups.back().push_back(std::move(t));
       }
       // other lines (header, comments, blank) are ignored
     }
@@ -1134,13 +1189,15 @@ class Server {
  public:
   Server(int ep, void* ring, const sockaddr_in& upstream,
          const sockaddr_in* captcha_upstream, CaptchaGate* gate,
-         TlsStore* tls, ServiceTable* services = nullptr)
+         TlsStore* tls, ServiceTable* services = nullptr,
+         SSL_CTX* up_ctx = nullptr)
       : ep_(ep),
         ring_(ring),
         upstream_(upstream),
         gate_(gate),
         tls_(tls),
-        services_(services) {
+        services_(services),
+        up_ctx_(up_ctx) {
     if (captcha_upstream) {
       captcha_upstream_ = *captcha_upstream;
       has_captcha_upstream_ = true;
@@ -1155,9 +1212,9 @@ class Server {
   // 31 = none matched) to a concrete upstream address. Without a
   // services table every request goes to the single argv upstream
   // (the pre-routing deployment shape).
-  Route pick_route_target(uint8_t route, sockaddr_in* out) {
+  Route pick_route_target(uint8_t route, UpTarget* out) {
     if (services_ == nullptr || !services_->loaded) {
-      *out = upstream_;
+      out->sa = upstream_;
       return Route::kOk;
     }
     if (route >= services_->upstreams.size()) return Route::kNoService;
@@ -1175,9 +1232,9 @@ class Server {
   // Fail-open target (ring full / verdict timeout): no route decision
   // exists, so fall back to the FIRST service — the same default the
   // argv upstream provides without a table.
-  bool default_target(sockaddr_in* out) {
+  bool default_target(UpTarget* out) {
     if (services_ == nullptr || !services_->loaded) {
-      *out = upstream_;
+      out->sa = upstream_;
       return true;
     }
     if (!services_->upstreams.empty() && !services_->upstreams[0].empty()) {
@@ -1187,7 +1244,7 @@ class Server {
   }
 
   void dispatch_route(Conn* c, uint8_t route) {
-    sockaddr_in target{};
+    UpTarget target;
     switch (pick_route_target(route, &target)) {
       case Route::kOk:
         start_proxy(c, target);
@@ -1204,7 +1261,7 @@ class Server {
   }
 
   void h2_dispatch_route(Conn* c, int32_t sid, uint8_t route) {
-    sockaddr_in target{};
+    UpTarget target;
     switch (pick_route_target(route, &target)) {
       case Route::kOk:
         h2_start_stream_proxy(c, sid, target);
@@ -1221,7 +1278,7 @@ class Server {
   }
 
   void fail_open_proxy(Conn* c) {
-    sockaddr_in target{};
+    UpTarget target;
     if (default_target(&target)) {
       start_proxy(c, target);
     } else {
@@ -1230,7 +1287,7 @@ class Server {
   }
 
   void h2_stream_fail_open(Conn* c, int32_t sid) {
-    sockaddr_in target{};
+    UpTarget target;
     if (default_target(&target)) {
       h2_start_stream_proxy(c, sid, target);
     } else {
@@ -1312,6 +1369,31 @@ class Server {
 
   void set_now(time_t t) { now_ = t; }
 
+  void queue_ssl_resume(Conn* c, int32_t sid) {
+    for (const auto& e : ssl_resume_)
+      if (e.first == c && e.second == sid) return;
+    ssl_resume_.emplace_back(c, sid);
+  }
+
+  // Deliver reads for data already decrypted inside SSL objects: epoll
+  // cannot signal it (nothing is on the fd), so update_*_events queues
+  // the link and the main loop drains the queue after each batch.
+  void process_ssl_resume() {
+    if (ssl_resume_.empty()) return;
+    std::vector<std::pair<Conn*, int32_t>> work;
+    work.swap(ssl_resume_);
+    for (const auto& e : work) {
+      Conn* c = e.first;
+      if (conns_.find(c) == conns_.end() || c->dead) continue;
+      if (e.second == 0) {
+        if (c->upstream_fd >= 0 && proxy_live(c))
+          on_upstream_event(c, EPOLLIN);
+      } else {
+        h2_stream_upstream_event(c, e.second, EPOLLIN);
+      }
+    }
+  }
+
   bool awaiting_verdicts() const { return !awaiting_.empty(); }
 
   // -- metrics ---------------------------------------------------------------
@@ -1328,6 +1410,7 @@ class Server {
     uint64_t fail_open = 0;       // ring-full + verdict-timeout proxies
     uint64_t no_service = 0;      // route bits said no service (404)
     uint64_t upstream_fail = 0;   // 502s
+    uint64_t upstream_tls_fail = 0;  // client handshake/verify failures
     uint64_t verdicts = 0;        // verdict bytes applied
     // log-scale verdict wait histogram (enqueue -> apply), upper bounds
     // in ms: 1, 2, 5, 10, 50, 100, +inf
@@ -1363,7 +1446,8 @@ class Server {
         buf, sizeof(buf),
         "{\"requests\": %llu, \"blocked\": %llu, \"captcha\": %llu, "
         "\"ua_rejected\": %llu, \"fail_open\": %llu, \"no_service\": %llu, "
-        "\"upstream_fail\": %llu, \"verdicts\": %llu, "
+        "\"upstream_fail\": %llu, \"upstream_tls_fail\": %llu, "
+        "\"verdicts\": %llu, "
         "\"verdict_wait_ms_hist\": {\"le1\": %llu, \"le2\": %llu, "
         "\"le5\": %llu, \"le10\": %llu, \"le50\": %llu, \"le100\": %llu, "
         "\"inf\": %llu}, \"ring_pending\": %llu, \"awaiting\": %zu, "
@@ -1375,6 +1459,7 @@ class Server {
         (unsigned long long)stats_.fail_open,
         (unsigned long long)stats_.no_service,
         (unsigned long long)stats_.upstream_fail,
+        (unsigned long long)stats_.upstream_tls_fail,
         (unsigned long long)stats_.verdicts,
         (unsigned long long)stats_.wait_hist[0],
         (unsigned long long)stats_.wait_hist[1],
@@ -1566,10 +1651,25 @@ class Server {
   void update_upstream_events(Conn* c) {
     if (c->upstream_fd < 0) return;
     uint32_t ev = 0;
-    // Same level-trigger discipline: stop reading an EOF'd upstream and
-    // pause reads while the client-side buffer is at its cap.
-    if (!c->upstream_eof && c->outbuf.size() < kMaxBuffered) ev = EPOLLIN;
-    if (!c->upbuf.empty() || !c->upstream_connected) ev |= EPOLLOUT;
+    if (c->up_tls_hs) {
+      // Arm exactly the wanted direction: EPOLLOUT is level-triggered
+      // "almost always ready", so arming it while the handshake wants
+      // bytes would spin the loop.
+      ev = c->up_hs_want_write ? EPOLLOUT : EPOLLIN;
+    } else {
+      // Same level-trigger discipline: stop reading an EOF'd upstream
+      // and pause reads while the client-side buffer is at its cap.
+      bool can_read = !c->upstream_eof && c->outbuf.size() < kMaxBuffered;
+      if (can_read) ev = EPOLLIN;
+      if (!c->upbuf.empty() || !c->upstream_connected) ev |= EPOLLOUT;
+      if (c->up_rd_want_write) ev |= EPOLLOUT;
+      if (c->up_wr_want_read) ev |= EPOLLIN;
+      // Records already decrypted inside the SSL object do not show on
+      // the fd, so epoll alone cannot resume a read paused for
+      // backpressure: queue an explicit resume once there is room.
+      if (can_read && c->up_ssl != nullptr && SSL_pending(c->up_ssl) > 0)
+        queue_ssl_resume(c, 0);
+    }
     epoll_event e{};
     e.events = ev;
     e.data.ptr = &c->upstream_ref;
@@ -1592,16 +1692,134 @@ class Server {
   }
 
   void close_upstream(Conn* c) {
+    if (c->up_ssl != nullptr) {
+      SSL_shutdown(c->up_ssl);  // best-effort close_notify (nonblocking)
+      SSL_free(c->up_ssl);
+      ERR_clear_error();
+      c->up_ssl = nullptr;
+    }
     if (c->upstream_fd >= 0) {
       epoll_ctl(ep_, EPOLL_CTL_DEL, c->upstream_fd, nullptr);
       close(c->upstream_fd);
       c->upstream_fd = -1;
     }
+    reset_up_link(c);
+  }
+
+  void reset_up_link(Conn* c) {
     c->upstream_connected = false;
     c->upstream_eof = false;
+    c->up_tcp_ok = false;
+    c->up_tls_hs = false;
+    c->up_hs_want_write = false;
+    c->up_rd_want_write = false;
+    c->up_wr_want_read = false;
+  }
+
+  // -- upstream TLS client ---------------------------------------------------
+  // The connector's client side of the reference's pooled hyper-rustls
+  // client (http_proxy_service.rs:54-71): verified-by-default TLS with
+  // SNI + hostname (or IP-SAN) checks against the table's server name.
+
+  static constexpr ssize_t kIoAgain = -1;  // would block (want flags set)
+  static constexpr ssize_t kIoErr = -2;    // fatal transport error
+
+  bool up_tls_begin(const UpTarget& t, int fd, SSL** out) {
+    if (up_ctx_ == nullptr) return false;
+    SSL* ssl = SSL_new(up_ctx_);
+    if (ssl == nullptr) return false;
+    SSL_set_fd(ssl, fd);
+    SSL_set_connect_state(ssl);
+    const char* name = t.sni.c_str();
+    in_addr probe{};
+    bool name_ok;
+    if (inet_pton(AF_INET, name, &probe) == 1) {
+      // Literal-address target: verify against an IP SAN, no SNI
+      // (RFC 6066 §3 forbids literal addresses in server_name).
+      name_ok = X509_VERIFY_PARAM_set1_ip_asc(SSL_get0_param(ssl), name) == 1;
+    } else {
+      name_ok = SSL_set1_host(ssl, name) == 1 &&
+                SSL_set_tlsext_host_name_shim(ssl, name) == 1;
+    }
+    if (!name_ok) {
+      // Proceeding would handshake with chain-but-no-name verification
+      // — a silent downgrade; fail the hop instead (502).
+      SSL_free(ssl);
+      ERR_clear_error();
+      return false;
+    }
+    *out = ssl;
+    return true;
+  }
+
+  // Drive the client handshake: 1 done, 0 in progress, -1 fatal (which
+  // includes certificate verification failures; SSL_VERIFY_PEER makes
+  // OpenSSL abort the handshake on an untrusted or name-mismatched
+  // chain).
+  static int up_tls_step(SSL* ssl, bool* want_write) {
+    ERR_clear_error();
+    int r = SSL_do_handshake(ssl);
+    if (r == 1) return 1;
+    int e = SSL_get_error(ssl, r);
+    if (e == SSL_ERROR_WANT_READ) {
+      *want_write = false;
+      return 0;
+    }
+    if (e == SSL_ERROR_WANT_WRITE) {
+      *want_write = true;
+      return 0;
+    }
+    return -1;
+  }
+
+  // send/recv with the same EAGAIN discipline whether the link is
+  // plaintext or TLS. Cross-direction wants (renegotiation-free TLS 1.3
+  // still hits them on KeyUpdate) are surfaced through the flags so the
+  // event mask can arm the other direction.
+  static ssize_t up_send_raw(int fd, SSL* ssl, const void* p, size_t n,
+                             bool* wr_want_read) {
+    if (ssl == nullptr) {
+      ssize_t w = send(fd, p, n, MSG_NOSIGNAL);
+      if (w >= 0) return w;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return kIoAgain;
+      return kIoErr;
+    }
+    ERR_clear_error();
+    int w = SSL_write(ssl, p, static_cast<int>(n));
+    if (w > 0) return w;
+    int e = SSL_get_error(ssl, w);
+    if (e == SSL_ERROR_WANT_WRITE) return kIoAgain;
+    if (e == SSL_ERROR_WANT_READ) {
+      *wr_want_read = true;
+      return kIoAgain;
+    }
+    return kIoErr;
+  }
+
+  static ssize_t up_recv_raw(int fd, SSL* ssl, void* p, size_t n,
+                             bool* rd_want_write) {
+    if (ssl == nullptr) {
+      ssize_t r = read(fd, p, n);
+      if (r >= 0) return r;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return kIoAgain;
+      return kIoErr;
+    }
+    ERR_clear_error();
+    int r = SSL_read(ssl, p, static_cast<int>(n));
+    if (r > 0) return r;
+    int e = SSL_get_error(ssl, r);
+    if (e == SSL_ERROR_ZERO_RETURN) return 0;  // clean close_notify
+    if (e == SSL_ERROR_WANT_READ) return kIoAgain;
+    if (e == SSL_ERROR_WANT_WRITE) {
+      *rd_want_write = true;
+      return kIoAgain;
+    }
+    if (e == SSL_ERROR_SYSCALL && r == 0) return 0;  // EOF sans alert
+    return kIoErr;
   }
 
   // A pooled upstream died before sending ANY response bytes: replay
+
   // the request once on a fresh connection (false when not applicable).
   bool try_pooled_retry(Conn* c) {
     if (!c->upstream_pooled || c->up_replay.empty()) return false;
@@ -1610,16 +1828,15 @@ class Server {
     close_upstream(c);
     int ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     if (ufd < 0 ||
-        (connect(ufd, reinterpret_cast<const sockaddr*>(&c->up_target),
-                 sizeof(c->up_target)) != 0 &&
+        (connect(ufd, reinterpret_cast<const sockaddr*>(&c->up_target.sa),
+                 sizeof(c->up_target.sa)) != 0 &&
          errno != EINPROGRESS)) {
       if (ufd >= 0) close(ufd);
       return false;
     }
     c->upstream_fd = ufd;
     c->upstream_pooled = false;  // one retry only
-    c->upstream_connected = false;
-    c->upstream_eof = false;
+    reset_up_link(c);  // a TLS target re-handshakes on the fresh socket
     c->upbuf = c->up_replay;
     epoll_event ue{};
     ue.events = EPOLLOUT | EPOLLIN;
@@ -1655,23 +1872,68 @@ class Server {
   // probe on pop (a server that closed the idle conn is detected before
   // any request bytes are risked) and expired by the sweep.
 
-  static uint64_t target_key(const sockaddr_in& t) {
-    return (static_cast<uint64_t>(t.sin_addr.s_addr) << 16) | t.sin_port;
+  struct PooledUpstream {
+    int fd;
+    SSL* ssl;  // non-null: an established TLS client session
+    std::string sni;  // the name the session was verified for
+    time_t since;
+  };
+  static constexpr size_t kPoolPerTarget = 256;
+  static constexpr time_t kPoolIdleS = 30;
+
+  static uint64_t target_key(const UpTarget& t) {
+    uint64_t key =
+        (static_cast<uint64_t>(t.sa.sin_addr.s_addr) << 16) | t.sa.sin_port;
+    if (t.tls) {
+      key |= 1ULL << 63;
+      key ^= std::hash<std::string>{}(t.sni) & 0x7FFF000000000000ULL;
+    }
+    return key;
   }
 
-  int pop_pooled(uint64_t key) {
-    auto it = upstream_pool_.find(key);
-    if (it == upstream_pool_.end()) return -1;
+  bool pop_pooled(const UpTarget& t, PooledUpstream* out) {
+    auto it = upstream_pool_.find(target_key(t));
+    if (it == upstream_pool_.end()) return false;
     auto& vec = it->second;
     while (!vec.empty()) {
-      PooledUpstream pc = vec.back();  // LIFO: most recently used first
-      vec.pop_back();
+      // The 64-bit key folds the SNI lossily; a hash alias must never
+      // hand out a session verified for a different name, so entries
+      // are matched exactly (LIFO over the matching entries).
+      size_t pick = vec.size();
+      for (size_t i = vec.size(); i-- > 0;) {
+        if (vec[i].sni == t.sni) {
+          pick = i;
+          break;
+        }
+      }
+      if (pick == vec.size()) return false;
+      PooledUpstream pc = vec[pick];
+      vec.erase(vec.begin() + pick);
+      if (pc.ssl != nullptr) {
+        // SSL_peek processes buffered records (quietly consuming
+        // TLS 1.3 session tickets): app data means a poisoned
+        // connection, WANT_READ means idle-and-alive.
+        char probe;
+        ERR_clear_error();
+        int r = SSL_peek(pc.ssl, &probe, 1);
+        if (r <= 0 && SSL_get_error(pc.ssl, r) == SSL_ERROR_WANT_READ) {
+          *out = pc;
+          return true;
+        }
+        SSL_free(pc.ssl);
+        ERR_clear_error();
+        close(pc.fd);
+        continue;
+      }
       char probe;
       ssize_t r = recv(pc.fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
-      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return pc.fd;
+      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        *out = pc;
+        return true;
+      }
       close(pc.fd);  // closed by the server, or stray bytes: unusable
     }
-    return -1;
+    return false;
   }
 
   void release_upstream(Conn* c) {
@@ -1681,10 +1943,11 @@ class Server {
       return;
     }
     epoll_ctl(ep_, EPOLL_CTL_DEL, c->upstream_fd, nullptr);
-    vec.push_back(PooledUpstream{c->upstream_fd, now_});
+    vec.push_back(PooledUpstream{c->upstream_fd, c->up_ssl,
+                                c->up_target.sni, now_});
     c->upstream_fd = -1;
-    c->upstream_connected = false;
-    c->upstream_eof = false;
+    c->up_ssl = nullptr;
+    reset_up_link(c);
   }
 
   void sweep_pool() {
@@ -1693,6 +1956,11 @@ class Server {
       size_t keep = 0;
       for (size_t i = 0; i < vec.size(); ++i) {
         if (now_ - vec[i].since > kPoolIdleS) {
+          if (vec[i].ssl != nullptr) {
+            SSL_shutdown(vec[i].ssl);
+            SSL_free(vec[i].ssl);
+            ERR_clear_error();
+          }
           close(vec[i].fd);
         } else {
           vec[keep++] = vec[i];
@@ -1702,15 +1970,22 @@ class Server {
     }
   }
 
-  void start_proxy(Conn* c, const sockaddr_in& target) {
+  void start_proxy(Conn* c, const UpTarget& target) {
     uint64_t key = target_key(target);
-    int ufd = pop_pooled(key);
-    bool pooled = ufd >= 0;
+    if (target.tls && up_ctx_ == nullptr) {
+      stats_.upstream_fail++;
+      close_upstream(c);
+      respond_close(c, k502);
+      return;
+    }
+    PooledUpstream pc{-1, nullptr, std::string(), 0};
+    bool pooled = pop_pooled(target, &pc);
+    int ufd = pc.fd;
     if (!pooled) {
       ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
       if (ufd < 0 ||
-          (connect(ufd, reinterpret_cast<const sockaddr*>(&target),
-                   sizeof(target)) != 0 &&
+          (connect(ufd, reinterpret_cast<const sockaddr*>(&target.sa),
+                   sizeof(target.sa)) != 0 &&
            errno != EINPROGRESS)) {
         if (ufd >= 0) close(ufd);
         respond_502(c);
@@ -1721,13 +1996,15 @@ class Server {
     c->up_key = key;
     c->up_target = target;
     c->upstream_pooled = pooled;
-    c->upstream_connected = pooled;
+    reset_up_link(c);
+    c->up_ssl = pooled ? pc.ssl : nullptr;
+    c->upstream_connected = pooled;  // pooled TLS links are post-handshake
+    c->up_tcp_ok = pooled;
     c->upstream_keep = false;
     c->upstream_junk = false;
     c->up_shut = false;
     c->resp_head_buf.clear();
     c->resp_head_done = false;
-    c->upstream_eof = false;
     c->last_active = now_;
 
     c->state = ConnState::kProxying;
@@ -1786,6 +2063,7 @@ class Server {
     // (matches the Python plane, which waits for BOTH pumps).
     if (c->client_eof && c->upbuf.empty() && !c->up_shut &&
         c->upstream_fd >= 0) {
+      if (c->up_ssl != nullptr) SSL_shutdown(c->up_ssl);
       shutdown(c->upstream_fd, SHUT_WR);
       c->up_shut = true;
     }
@@ -2004,7 +2282,11 @@ class Server {
         respond_close(c, kCaptcha);
         return;
       case Policy::kCaptchaUpstream:
-        start_proxy(c, captcha_upstream_);
+        {
+          UpTarget t;
+          t.sa = captcha_upstream_;
+          start_proxy(c, t);
+        }
         return;
       case Policy::kFailOpenProxy:
         stats_.fail_open++;
@@ -2223,7 +2505,11 @@ class Server {
           h2_respond_redirect(c, sid);
           break;
         case Policy::kCaptchaUpstream:
-          h2_start_stream_proxy(c, sid, captcha_upstream_);
+          {
+            UpTarget t;
+            t.sa = captcha_upstream_;
+            h2_start_stream_proxy(c, sid, t);
+          }
           break;
         case Policy::kFailOpenProxy:
           stats_.fail_open++;
@@ -2239,6 +2525,17 @@ class Server {
   // -- per-stream upstream proxying (concurrent h2) --------------------------
 
   void h2_close_stream_upstream(Conn* c, H2Stream& st) {
+    if (st.up_ssl != nullptr) {
+      SSL_shutdown(st.up_ssl);
+      SSL_free(st.up_ssl);
+      ERR_clear_error();
+      st.up_ssl = nullptr;
+    }
+    st.up_tcp_ok = false;
+    st.up_tls_hs = false;
+    st.up_hs_want_write = false;
+    st.up_rd_want_write = false;
+    st.up_wr_want_read = false;
     if (st.up_fd >= 0) {
       epoll_ctl(ep_, EPOLL_CTL_DEL, st.up_fd, nullptr);
       close(st.up_fd);
@@ -2276,8 +2573,10 @@ class Server {
                     upstream_pool_[st.up_key].size() < kPoolPerTarget;
     if (can_pool) {
       epoll_ctl(ep_, EPOLL_CTL_DEL, st.up_fd, nullptr);
-      upstream_pool_[st.up_key].push_back(PooledUpstream{st.up_fd, now_});
+      upstream_pool_[st.up_key].push_back(
+          PooledUpstream{st.up_fd, st.up_ssl, st.up_target.sni, now_});
       st.up_fd = -1;
+      st.up_ssl = nullptr;
       c->h2_upstreams--;
       if (st.up_ref != nullptr) {
         st.up_ref->h2_sid = -1;
@@ -2294,14 +2593,22 @@ class Server {
   void h2_update_stream_events(Conn* c, H2Stream& st) {
     if (st.up_fd < 0 || st.up_ref == nullptr) return;
     uint32_t ev = 0;
-    // Read from the upstream only while BOTH buffers have room: the
-    // per-stream pending cap bounds de-framed bytes awaiting nghttp2,
-    // and the connection outbuf cap bounds bytes a non-reading client
-    // has already been framed (h2_flush re-arms when it drains).
-    if (!st.up_eof && st.pending.size() < kH2PendingCap &&
-        c->outbuf.size() < kMaxBuffered)
-      ev = EPOLLIN;
-    if (!st.upbuf.empty() || !st.up_connected) ev |= EPOLLOUT;
+    if (st.up_tls_hs) {
+      ev = st.up_hs_want_write ? EPOLLOUT : EPOLLIN;
+    } else {
+      // Read from the upstream only while BOTH buffers have room: the
+      // per-stream pending cap bounds de-framed bytes awaiting nghttp2,
+      // and the connection outbuf cap bounds bytes a non-reading client
+      // has already been framed (h2_flush re-arms when it drains).
+      bool can_read = !st.up_eof && st.pending.size() < kH2PendingCap &&
+                      c->outbuf.size() < kMaxBuffered;
+      if (can_read) ev = EPOLLIN;
+      if (!st.upbuf.empty() || !st.up_connected) ev |= EPOLLOUT;
+      if (st.up_rd_want_write) ev |= EPOLLOUT;
+      if (st.up_wr_want_read) ev |= EPOLLIN;
+      if (can_read && st.up_ssl != nullptr && SSL_pending(st.up_ssl) > 0)
+        queue_ssl_resume(c, st.up_ref->h2_sid);
+    }
     epoll_event e{};
     e.events = ev;
     e.data.ptr = st.up_ref;
@@ -2309,7 +2616,7 @@ class Server {
   }
 
   void h2_start_stream_proxy(Conn* c, int32_t sid,
-                             const sockaddr_in& target) {
+                             const UpTarget& target) {
     auto it = c->h2_streams.find(sid);
     if (it == c->h2_streams.end()) return;
     H2Stream& st = it->second;
@@ -2323,13 +2630,19 @@ class Server {
       return;
     }
     uint64_t key = target_key(target);
-    int ufd = pop_pooled(key);
-    bool pooled = ufd >= 0;
+    if (target.tls && up_ctx_ == nullptr) {
+      stats_.upstream_fail++;
+      h2_respond_simple(c, sid, 502, "Bad Gateway");
+      return;
+    }
+    PooledUpstream pc{-1, nullptr, std::string(), 0};
+    bool pooled = pop_pooled(target, &pc);
+    int ufd = pc.fd;
     if (!pooled) {
       ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
       if (ufd < 0 ||
-          (connect(ufd, reinterpret_cast<const sockaddr*>(&target),
-                   sizeof(target)) != 0 &&
+          (connect(ufd, reinterpret_cast<const sockaddr*>(&target.sa),
+                   sizeof(target.sa)) != 0 &&
            errno != EINPROGRESS)) {
         if (ufd >= 0) close(ufd);
         stats_.upstream_fail++;
@@ -2341,7 +2654,13 @@ class Server {
     st.up_key = key;
     st.up_target = target;
     st.up_pooled = pooled;
+    st.up_ssl = pooled ? pc.ssl : nullptr;
     st.up_connected = pooled;
+    st.up_tcp_ok = pooled;
+    st.up_tls_hs = false;
+    st.up_hs_want_write = false;
+    st.up_rd_want_write = false;
+    st.up_wr_want_read = false;
     st.up_eof = false;
     st.up_keep = false;
     st.up_junk = false;
@@ -2371,15 +2690,15 @@ class Server {
     h2_close_stream_upstream(c, st);
     int ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
     if (ufd < 0 ||
-        (connect(ufd, reinterpret_cast<const sockaddr*>(&st.up_target),
-                 sizeof(st.up_target)) != 0 &&
+        (connect(ufd, reinterpret_cast<const sockaddr*>(&st.up_target.sa),
+                 sizeof(st.up_target.sa)) != 0 &&
          errno != EINPROGRESS)) {
       if (ufd >= 0) close(ufd);
       return false;
     }
     st.up_fd = ufd;
     st.up_pooled = false;  // one retry only
-    st.up_connected = false;
+    st.up_connected = false;  // close already reset the TLS link state
     st.up_eof = false;
     st.upbuf = st.up_replay;
     st.up_ref = new SockRef{c, true, sid};
@@ -2598,29 +2917,64 @@ class Server {
     H2Stream& st = it->second;
     if (st.up_fd < 0) return;
     c->last_active = now_;
-    if (!st.up_connected && (events & (EPOLLOUT | EPOLLERR))) {
-      int err = 0;
-      socklen_t elen = sizeof(err);
-      getsockopt(st.up_fd, SOL_SOCKET, SO_ERROR, &err, &elen);
-      if (err != 0) {
-        if (!h2_try_stream_retry(c, sid, st)) {
+    if (!st.up_connected) {
+      if (!st.up_tcp_ok && (events & (EPOLLOUT | EPOLLERR))) {
+        int err = 0;
+        socklen_t elen = sizeof(err);
+        getsockopt(st.up_fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+        if (err != 0) {
+          if (!h2_try_stream_retry(c, sid, st)) {
+            h2_close_stream_upstream(c, st);
+            stats_.upstream_fail++;
+            h2_respond_simple(c, sid, 502, "Bad Gateway");
+            h2_process_next(c);
+          }
+          h2_flush(c);
+          return;
+        }
+        st.up_tcp_ok = true;
+        if (st.up_target.tls) {
+          if (!up_tls_begin(st.up_target, st.up_fd, &st.up_ssl)) {
+            h2_close_stream_upstream(c, st);
+            stats_.upstream_fail++;
+            h2_respond_simple(c, sid, 502, "Bad Gateway");
+            h2_process_next(c);
+            h2_flush(c);
+            return;
+          }
+          st.up_tls_hs = true;
+        } else {
+          st.up_connected = true;
+        }
+      }
+      if (st.up_tls_hs) {
+        int hs = up_tls_step(st.up_ssl, &st.up_hs_want_write);
+        if (hs < 0) {
+          stats_.upstream_tls_fail++;
           h2_close_stream_upstream(c, st);
           stats_.upstream_fail++;
           h2_respond_simple(c, sid, 502, "Bad Gateway");
           h2_process_next(c);
+          h2_flush(c);
+          return;
         }
-        h2_flush(c);
-        return;
+        if (hs == 0) {
+          h2_update_stream_events(c, st);
+          return;
+        }
+        st.up_tls_hs = false;
+        st.up_connected = true;
       }
-      st.up_connected = true;
+      if (!st.up_connected) return;  // TCP connect still pending
     }
-    if (events & EPOLLOUT) {
+    if ((events & EPOLLOUT) || st.up_wr_want_read) {
       while (!st.upbuf.empty() && st.up_connected) {
-        ssize_t w = send(st.up_fd, st.upbuf.data(), st.upbuf.size(),
-                         MSG_NOSIGNAL);
+        st.up_wr_want_read = false;
+        ssize_t w = up_send_raw(st.up_fd, st.up_ssl, st.upbuf.data(),
+                                st.upbuf.size(), &st.up_wr_want_read);
         if (w > 0) {
           st.upbuf.erase(0, static_cast<size_t>(w));
-        } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        } else if (w == kIoAgain) {
           break;
         } else {
           if (!h2_try_stream_retry(c, sid, st)) {
@@ -2638,18 +2992,20 @@ class Server {
         }
       }
     }
-    if (events & EPOLLIN) {
+    if ((events & EPOLLIN) || st.up_rd_want_write) {
       char buf[16384];
       while (st.up_fd >= 0) {
         if (st.pending.size() > kH2PendingCap) break;  // backpressure
-        ssize_t r = read(st.up_fd, buf, sizeof(buf));
+        st.up_rd_want_write = false;
+        ssize_t r = up_recv_raw(st.up_fd, st.up_ssl, buf, sizeof(buf),
+                                &st.up_rd_want_write);
         if (r > 0) {
           if (!h2_stream_upstream_data(c, sid, st, buf,
                                        static_cast<size_t>(r))) {
             h2_flush(c);
             return;  // stream aborted/serviced: st may be gone
           }
-        } else if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        } else if (r == kIoAgain) {
           break;
         } else {
           st.up_eof = true;
@@ -2905,11 +3261,12 @@ class Server {
 
   void flush_upstream(Conn* c) {
     while (!c->upbuf.empty() && c->upstream_fd >= 0 && c->upstream_connected) {
-      ssize_t w = send(c->upstream_fd, c->upbuf.data(), c->upbuf.size(),
-                       MSG_NOSIGNAL);
+      c->up_wr_want_read = false;
+      ssize_t w = up_send_raw(c->upstream_fd, c->up_ssl, c->upbuf.data(),
+                              c->upbuf.size(), &c->up_wr_want_read);
       if (w > 0) {
         c->upbuf.erase(0, static_cast<size_t>(w));
-      } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      } else if (w == kIoAgain) {
         break;
       } else {
         // Upstream write failure mid-request: 502 if nothing sent yet,
@@ -2928,31 +3285,61 @@ class Server {
 
   void on_upstream_event(Conn* c, uint32_t events) {
     c->last_active = now_;
-    if (!c->upstream_connected && (events & (EPOLLOUT | EPOLLERR))) {
-      int err = 0;
-      socklen_t len = sizeof(err);
-      getsockopt(c->upstream_fd, SOL_SOCKET, SO_ERROR, &err, &len);
-      if (err != 0) {
-        close_upstream(c);
-        respond_502(c);
-        return;
+    if (!c->upstream_connected) {
+      if (!c->up_tcp_ok && (events & (EPOLLOUT | EPOLLERR))) {
+        int err = 0;
+        socklen_t len = sizeof(err);
+        getsockopt(c->upstream_fd, SOL_SOCKET, SO_ERROR, &err, &len);
+        if (err != 0) {
+          close_upstream(c);
+          respond_502(c);
+          return;
+        }
+        c->up_tcp_ok = true;
+        if (c->up_target.tls) {
+          if (!up_tls_begin(c->up_target, c->upstream_fd, &c->up_ssl)) {
+            close_upstream(c);
+            respond_502(c);
+            return;
+          }
+          c->up_tls_hs = true;
+        } else {
+          c->upstream_connected = true;
+        }
       }
-      c->upstream_connected = true;
+      if (c->up_tls_hs) {
+        int hs = up_tls_step(c->up_ssl, &c->up_hs_want_write);
+        if (hs < 0) {
+          stats_.upstream_tls_fail++;
+          close_upstream(c);
+          respond_502(c);
+          return;
+        }
+        if (hs == 0) {
+          update_upstream_events(c);
+          return;
+        }
+        c->up_tls_hs = false;
+        c->upstream_connected = true;
+      }
+      if (!c->upstream_connected) return;  // TCP connect still pending
     }
-    if (events & EPOLLOUT) flush_upstream(c);
+    if (events & EPOLLOUT || c->up_wr_want_read) flush_upstream(c);
     if (c->dead || !proxy_live(c)) return;
-    if (events & EPOLLIN) {
+    if ((events & EPOLLIN) || c->up_rd_want_write) {
       char buf[16384];
       for (;;) {
         if (c->outbuf.size() > kMaxBuffered) break;  // backpressure
-        ssize_t r = read(c->upstream_fd, buf, sizeof(buf));
+        c->up_rd_want_write = false;
+        ssize_t r = up_recv_raw(c->upstream_fd, c->up_ssl, buf, sizeof(buf),
+                                &c->up_rd_want_write);
         if (r > 0) {
           on_upstream_data(c, buf, static_cast<size_t>(r));
           if (c->dead || !proxy_live(c)) return;
         } else if (r == 0) {
           c->upstream_eof = true;
           break;
-        } else if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        } else if (r == kIoAgain) {
           break;
         } else {
           c->upstream_eof = true;
@@ -3070,6 +3457,7 @@ class Server {
     if (c->state == ConnState::kTunnel) {
       if (c->client_eof && c->upbuf.empty() && !c->up_shut &&
           c->upstream_fd >= 0) {
+        if (c->up_ssl != nullptr) SSL_shutdown(c->up_ssl);
         shutdown(c->upstream_fd, SHUT_WR);
         c->up_shut = true;
       }
@@ -3217,13 +3605,11 @@ class Server {
   CaptchaGate* gate_;
   TlsStore* tls_;
   ServiceTable* services_ = nullptr;
+  SSL_CTX* up_ctx_ = nullptr;  // upstream TLS client context
+  // Links whose SSL object holds decrypted-but-undelivered bytes (no fd
+  // readiness will fire for them); drained after each event batch.
+  std::vector<std::pair<Conn*, int32_t>> ssl_resume_;
   uint32_t rng_ = 0x9e3779b9;  // xorshift32 state for upstream choice
-  struct PooledUpstream {
-    int fd;
-    time_t since;
-  };
-  static constexpr size_t kPoolPerTarget = 256;
-  static constexpr time_t kPoolIdleS = 30;
   std::unordered_map<uint64_t, std::vector<PooledUpstream>> upstream_pool_;
   Stats stats_;
   std::unordered_set<Conn*> conns_;
@@ -3340,7 +3726,7 @@ int main(int argc, char** argv) {
                  "usage: %s <listen-port> <ring-file> <upstream-host> "
                  "<upstream-port> [--captcha-upstream host:port] "
                  "[--jwks path] [--tls-dir dir] [--alpn-dir dir] "
-                 "[--services path] [--bind addr]\n",
+                 "[--services path] [--bind addr] [--upstream-ca pem]\n",
                  argv[0]);
     return 2;
   }
@@ -3355,6 +3741,7 @@ int main(int argc, char** argv) {
   const char* alpn_dir = nullptr;
   const char* services_path = nullptr;
   const char* bind_addr = nullptr;
+  const char* upstream_ca = nullptr;
   sockaddr_in captcha_upstream{};
   bool has_captcha = false;
   for (int i = 5; i + 1 < argc; i += 2) {
@@ -3374,6 +3761,8 @@ int main(int argc, char** argv) {
       services_path = argv[i + 1];
     } else if (strcmp(argv[i], "--bind") == 0) {
       bind_addr = argv[i + 1];
+    } else if (strcmp(argv[i], "--upstream-ca") == 0) {
+      upstream_ca = argv[i + 1];
     }
   }
 
@@ -3441,6 +3830,31 @@ int main(int argc, char** argv) {
     services.reload();  // absent file is fine: table loads when written
   }
 
+  // Upstream TLS client context: verification is mandatory (the
+  // reference's hyper-rustls client has no insecure mode,
+  // http_proxy_service.rs:54-71) against either the system roots or an
+  // explicit --upstream-ca bundle (private-CA deployments, tests).
+  SSL_CTX* up_ctx = SSL_CTX_new(TLS_client_method());
+  if (up_ctx != nullptr) {
+    SSL_CTX_set_min_proto_version_shim(up_ctx, TLS1_2_VERSION);
+    SSL_CTX_set_mode_shim(up_ctx, SSL_MODE_ENABLE_PARTIAL_WRITE |
+                                      SSL_MODE_ACCEPT_MOVING_WRITE_BUFFER);
+    SSL_CTX_set_verify(up_ctx, SSL_VERIFY_PEER, nullptr);
+    int roots_ok;
+    if (upstream_ca != nullptr) {
+      roots_ok = SSL_CTX_load_verify_locations(up_ctx, upstream_ca, nullptr);
+    } else {
+      roots_ok = SSL_CTX_set_default_verify_paths(up_ctx);
+    }
+    if (!roots_ok) {
+      std::fprintf(stderr, "cannot load upstream trust roots%s%s\n",
+                   upstream_ca ? " from " : "", upstream_ca ? upstream_ca : "");
+      return 1;
+    }
+    static const unsigned char kAlpn[] = "\x08http/1.1";
+    SSL_CTX_set_alpn_protos(up_ctx, kAlpn, sizeof(kAlpn) - 1);
+  }
+
   int lfd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
   int one = 1;
   setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
@@ -3470,7 +3884,7 @@ int main(int argc, char** argv) {
 
   Server server(ep, ring, upstream, has_captcha ? &captcha_upstream : nullptr,
                 &gate, tls_dir ? &tls_store : nullptr,
-                services_path ? &services : nullptr);
+                services_path ? &services : nullptr, up_ctx);
   g_server = &server;
   // SIGTERM starts a graceful drain: stop accepting, finish in-flight
   // requests, exit when idle or after the 20 s cap (the reference's
@@ -3527,6 +3941,7 @@ int main(int argc, char** argv) {
       SockRef* ref = static_cast<SockRef*>(events[i].data.ptr);
       server.handle(ref, events[i].events);
     }
+    server.process_ssl_resume();
     server.flush_doomed();
     if (draining) {
       size_t live = server.drain_tick();

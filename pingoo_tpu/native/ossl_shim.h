@@ -22,9 +22,11 @@ typedef struct evp_pkey_st EVP_PKEY;
 typedef struct evp_md_ctx_st EVP_MD_CTX;
 typedef struct evp_md_st EVP_MD;
 typedef struct engine_st ENGINE;
+typedef struct x509_verify_param_st X509_VERIFY_PARAM;
 
 // ---- libssl ----
 const SSL_METHOD* TLS_server_method(void);
+const SSL_METHOD* TLS_client_method(void);
 SSL_CTX* SSL_CTX_new(const SSL_METHOD* method);
 void SSL_CTX_free(SSL_CTX* ctx);
 int SSL_CTX_use_certificate_chain_file(SSL_CTX* ctx, const char* file);
@@ -39,10 +41,30 @@ void SSL_CTX_set_alpn_select_cb(
               const unsigned char*, unsigned int, void*),
     void* arg);
 
+// Client-side (upstream connector) surface: verification policy,
+// hostname/IP checks, SNI, ALPN offer, buffered-data probes.
+int SSL_CTX_set_default_verify_paths(SSL_CTX* ctx);
+int SSL_CTX_load_verify_locations(SSL_CTX* ctx, const char* CAfile,
+                                  const char* CApath);
+void SSL_CTX_set_verify(SSL_CTX* ctx, int mode,
+                        int (*verify_callback)(int, void*));
+int SSL_CTX_set_alpn_protos(SSL_CTX* ctx, const unsigned char* protos,
+                            unsigned int protos_len);
+
 SSL* SSL_new(SSL_CTX* ctx);
 void SSL_free(SSL* ssl);
 int SSL_set_fd(SSL* ssl, int fd);
 void SSL_set_accept_state(SSL* ssl);
+void SSL_set_connect_state(SSL* ssl);
+int SSL_set1_host(SSL* ssl, const char* hostname);
+long SSL_ctrl(SSL* ssl, int cmd, long larg, void* parg);
+long SSL_get_verify_result(const SSL* ssl);
+int SSL_peek(SSL* ssl, void* buf, int num);
+int SSL_pending(const SSL* ssl);
+int SSL_has_pending(const SSL* ssl);
+X509_VERIFY_PARAM* SSL_get0_param(SSL* ssl);
+int X509_VERIFY_PARAM_set1_ip_asc(X509_VERIFY_PARAM* param,
+                                  const char* ipasc);
 int SSL_do_handshake(SSL* ssl);
 int SSL_read(SSL* ssl, void* buf, int num);
 int SSL_write(SSL* ssl, const void* buf, int num);
@@ -66,6 +88,13 @@ void ERR_clear_error(void);
 #define SSL_ERROR_SYSCALL 5
 #define SSL_ERROR_ZERO_RETURN 6
 #define SSL_CTRL_SET_MIN_PROTO_VERSION 123
+#define SSL_CTRL_SET_TLSEXT_HOSTNAME 55
+#define SSL_CTRL_MODE 33
+#define SSL_MODE_ENABLE_PARTIAL_WRITE 0x1L
+#define SSL_MODE_ACCEPT_MOVING_WRITE_BUFFER 0x2L
+#define SSL_VERIFY_NONE 0
+#define SSL_VERIFY_PEER 1
+#define X509_V_OK 0
 #define TLS1_2_VERSION 0x0303
 #define TLS1_3_VERSION 0x0304
 #define TLSEXT_NAMETYPE_host_name 0
@@ -79,6 +108,15 @@ void ERR_clear_error(void);
 
 static inline long SSL_CTX_set_min_proto_version_shim(SSL_CTX* ctx, int ver) {
   return SSL_CTX_ctrl(ctx, SSL_CTRL_SET_MIN_PROTO_VERSION, ver, nullptr);
+}
+
+static inline long SSL_set_tlsext_host_name_shim(SSL* ssl, const char* name) {
+  return SSL_ctrl(ssl, SSL_CTRL_SET_TLSEXT_HOSTNAME, TLSEXT_NAMETYPE_host_name,
+                  const_cast<char*>(name));
+}
+
+static inline long SSL_CTX_set_mode_shim(SSL_CTX* ctx, long mode) {
+  return SSL_CTX_ctrl(ctx, SSL_CTRL_MODE, mode, nullptr);
 }
 
 // ---- libcrypto ----

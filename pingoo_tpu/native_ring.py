@@ -224,10 +224,14 @@ def slots_to_arrays(slots: np.ndarray) -> dict:
 
 def write_services_file(path: str, services: list) -> None:
     """Publish the native plane's routing table: `services` is the
-    listener's ordered [(name, [(ip, port), ...])] — typically registry
-    snapshots (host/discovery.ServiceRegistry.get_upstreams). Written
-    atomically (tmp + rename) so the C++ reader (httpd.cc ServiceTable)
-    never observes a partial table; it hot-reloads on mtime change."""
+    listener's ordered [(name, [upstream, ...])] — typically registry
+    snapshots (host/discovery.ServiceRegistry.get_upstreams). Each
+    upstream is `(ip, port)` for plaintext or `(ip, port, server_name)`
+    for a verified TLS hop (the C++ connector dials it with SNI +
+    hostname checks against server_name, reference
+    http_proxy_service.rs:54-71). Written atomically (tmp + rename) so
+    the C++ reader (httpd.cc ServiceTable) never observes a partial
+    table; it hot-reloads on mtime change."""
     if len(services) > 31:
         raise ValueError(
             f"native routing supports at most 31 services (5-bit route "
@@ -235,8 +239,18 @@ def write_services_file(path: str, services: list) -> None:
     lines = ["pingoo-services v1"]
     for order, (name, ups) in enumerate(services):
         lines.append(f"service {order} {name}")
-        for ip, port in ups:
-            lines.append(f"upstream {ip} {port}")
+        for up in ups:
+            if len(up) == 2:
+                lines.append(f"upstream {up[0]} {up[1]}")
+            else:
+                ip, port, sni = up
+                if (not sni or len(sni) > 255
+                        or any(ch.isspace() for ch in sni)):
+                    # 255 = the C++ reader's %255s scan width; a longer
+                    # name would be silently truncated into a hop that
+                    # can never pass hostname verification.
+                    raise ValueError(f"bad tls server name {sni!r}")
+                lines.append(f"upstream {ip} {port} tls {sni}")
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write("\n".join(lines) + "\n")
