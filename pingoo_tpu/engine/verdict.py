@@ -390,23 +390,33 @@ def make_verdict_fn(plan: RulesetPlan):
 LANE_NONE = np.int32(2**30)  # "no rule": sorts after every real index
 
 
-def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None):
+def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
+                 service_groups: list[list[str]] | None = None):
     """Jitted device ACTION-LANE reduction: (tables, arrays) ->
-    [4, B] i32 rows (first_act_idx, first_act_kind, first_block_idx,
-    route), indices in ORIGINAL rule-index space.
+    [3 + max(G, 1), B] i32 rows (first_act_idx, first_act_kind,
+    first_block_idx, route lane(s)), indices in ORIGINAL rule-index
+    space.
 
     This is the transfer-thin form of the verdict for the ring sidecar:
     instead of shipping the [B, R_dev] match matrix off the device
     (half a megabyte per 1k batch — which dominates when the chip sits
     behind a network tunnel), the first-match reduction the action
-    semantics need runs on device and only four [B] lanes return.
+    semantics need runs on device and only a few int32 lanes return.
     Host-interpreted rules merge by index afterwards (merge_lanes).
 
-    `services` (listener service names, in order) adds the ROUTE lane:
-    the first service order whose route pseudo-column matched (the
-    reference's service-selection loop, http_listener.rs:266-270), or
-    LANE_NONE. Services whose route predicate fell back to host
-    interpretation are merged by the sidecar afterwards."""
+    `services` (one listener's service names, in order) adds the ROUTE
+    lane: the first service order whose route pseudo-column matched
+    (the reference's service-selection loop, http_listener.rs:266-270),
+    or LANE_NONE. `service_groups` generalizes to G DISTINCT listener
+    service orders (the reference binds a service list PER listener,
+    config.rs:241-253): one route lane per group, all computed from the
+    same [B, C] match matrix in one pass — the sidecar picks each row's
+    lane by the ring it came from. Services whose route predicate fell
+    back to host interpretation are merged by the sidecar afterwards."""
+    if service_groups is not None and services is not None:
+        raise ValueError("pass services or service_groups, not both")
+    groups = (service_groups if service_groups is not None
+              else ([services] if services else []))
     device_rules = [r for r in plan.rules if not r.host]
     orig_idx = np.array([r.index for r in device_rules], dtype=np.int32)
     first_kind = np.array(
@@ -415,21 +425,26 @@ def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None):
     has_act = first_kind != 0
     has_block = np.array([Action.BLOCK in r.actions for r in device_rules],
                          dtype=bool)
-    dev_route: list[tuple[int, int]] = []  # (service order, matched column)
-    if services:
-        col_of_rule = {r.index: j for j, r in enumerate(device_rules)}
-        for order, name in enumerate(services):
+    col_of_rule = {r.index: j for j, r in enumerate(device_rules)}
+    # Per group: [(service order, matched column), ...]
+    group_routes: list[list[tuple[int, int]]] = []
+    for grp in groups:
+        dev_route: list[tuple[int, int]] = []
+        for order, name in enumerate(grp):
             ridx = plan.route_index.get(name)
             if ridx is not None and ridx in col_of_rule:
                 dev_route.append((order, col_of_rule[ridx]))
+        group_routes.append(dev_route)
 
     @jax.jit
     def lanes(tables, arrays):
         matched = _matched_cols(plan, tables, arrays)  # [B, C]
         B = arrays["asn"].shape[0]
         none = jnp.full((B,), LANE_NONE, dtype=jnp.int32)
+        n_route = max(len(groups), 1)
         if matched.shape[1] == 0:
-            return jnp.stack([none, jnp.zeros((B,), jnp.int32), none, none])
+            return jnp.stack([none, jnp.zeros((B,), jnp.int32), none]
+                             + [none] * n_route)
         idx = jnp.asarray(orig_idx)[None, :]
         act_idx = jnp.where(matched & jnp.asarray(has_act)[None, :], idx,
                             LANE_NONE)
@@ -440,16 +455,24 @@ def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None):
         blk_idx = jnp.where(matched & jnp.asarray(has_block)[None, :], idx,
                             LANE_NONE)
         first_block_idx = jnp.min(blk_idx, axis=1)
-        if dev_route:
-            cols = jnp.asarray([c for _, c in dev_route], dtype=jnp.int32)
-            orders = jnp.asarray([o for o, _ in dev_route], dtype=jnp.int32)
-            rm = jnp.take(matched, cols, axis=1)  # [B, S_dev]
-            route = jnp.min(jnp.where(rm, orders[None, :], LANE_NONE),
-                            axis=1).astype(jnp.int32)
-        else:
-            route = none
-        # One stacked [4, B] array = ONE device->host transfer.
-        return jnp.stack([first_act_idx, kind, first_block_idx, route])
+        route_lanes = []
+        for dev_route in group_routes:
+            if dev_route:
+                cols = jnp.asarray([c for _, c in dev_route],
+                                   dtype=jnp.int32)
+                orders = jnp.asarray([o for o, _ in dev_route],
+                                     dtype=jnp.int32)
+                rm = jnp.take(matched, cols, axis=1)  # [B, S_dev]
+                route_lanes.append(
+                    jnp.min(jnp.where(rm, orders[None, :], LANE_NONE),
+                            axis=1).astype(jnp.int32))
+            else:
+                route_lanes.append(none)
+        if not route_lanes:
+            route_lanes.append(none)
+        # One stacked [3 + G, B] array = ONE device->host transfer.
+        return jnp.stack([first_act_idx, kind, first_block_idx]
+                         + route_lanes)
 
     return lanes
 

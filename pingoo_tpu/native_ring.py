@@ -270,7 +270,8 @@ class RingSidecar:
 
     def __init__(self, ring, plan, lists, max_batch: int = 1024,
                  idle_sleep_s: float = 0.0002, pipeline_depth: int = 3,
-                 services: Optional[list] = None, geoip=None):
+                 services: Optional[list] = None, geoip=None,
+                 ring_services: Optional[list] = None):
         from .engine.verdict import make_lane_fn
 
         self.rings: list[Ring] = list(ring) if isinstance(
@@ -293,24 +294,59 @@ class RingSidecar:
         # adds the ROUTE lane so the C++ plane can dispatch each request
         # to the right service's upstream set (verdict byte bits 3-7).
         self.services = list(services) if services else None
-        if self.services and len(self.services) > 31:
-            # The verdict byte's route field is 5 bits: orders 0-30 plus
-            # the no-match sentinel 31. More services would alias the
-            # sentinel onto a real service and invert no-match into
-            # proxy-to-last-service.
-            raise ValueError(
-                f"native routing supports at most 31 services, "
-                f"got {len(self.services)}")
-        self._lane_fn = make_lane_fn(plan, services=self.services)
+        # `ring_services` (aligned with `rings`; entries may be None)
+        # gives each worker ring its OWN service order — the reference
+        # binds a service list per listener (config.rs:241-253), and the
+        # native plane runs one ring per (listener, worker). The lane fn
+        # computes one route lane per DISTINCT order; each row reads the
+        # lane of the ring it arrived on.
+        if ring_services is not None:
+            if services is not None:
+                raise ValueError("pass services or ring_services, not both")
+            if len(ring_services) != len(self.rings):
+                raise ValueError(
+                    f"ring_services has {len(ring_services)} entries for "
+                    f"{len(self.rings)} rings")
+            per_ring = [list(s) if s else None for s in ring_services]
+        else:
+            per_ring = [self.services] * len(self.rings)
+        self._groups: list[list] = []
+        self._ring_group: list[Optional[int]] = []
+        for svc in per_ring:
+            if svc is None:
+                self._ring_group.append(None)
+                continue
+            for gi, g in enumerate(self._groups):
+                if g == svc:
+                    break
+            else:
+                gi = len(self._groups)
+                self._groups.append(svc)
+            self._ring_group.append(gi)
+        for g in self._groups:
+            if len(g) > 31:
+                # The verdict byte's route field is 5 bits: orders 0-30
+                # plus the no-match sentinel 31. More services would
+                # alias the sentinel onto a real service and invert
+                # no-match into proxy-to-last-service.
+                raise ValueError(
+                    f"native routing supports at most 31 services, "
+                    f"got {len(g)}")
+        self._ring_group_of = {id(r): gi for r, gi in
+                               zip(self.rings, self._ring_group)}
+        self._lane_fn = make_lane_fn(
+            plan, service_groups=self._groups or None)
         # Services whose route predicate fell back to host interpretation
-        # are merged into the device route lane per batch.
-        self._host_routes: list[tuple[int, object]] = []
-        if self.services:
-            by_index = {r.index: r for r in plan.rules}
-            for order, name in enumerate(self.services):
+        # are merged into the device route lane per batch (per group).
+        self._host_routes: list[list[tuple[int, object]]] = []
+        by_index = {r.index: r for r in plan.rules}
+        for g in self._groups:
+            hr = []
+            for order, name in enumerate(g):
                 ridx = plan.route_index.get(name)
                 if ridx is not None and by_index[ridx].host:
-                    self._host_routes.append((order, by_index[ridx].program))
+                    hr.append((order, by_index[ridx].program))
+            self._host_routes.append(hr)
         self._tables = plan.device_tables()
         # The C++ plane has no mmdb decoder: it enqueues slots with
         # asn=0 / country="XX" (its unknown markers). The reference
