@@ -482,12 +482,25 @@ def _main_guarded(result: dict) -> int:
 
     ok, info = _probe_backend()
     if not ok:
-        done.set()  # before any emit: the watchdog must never interleave
-        # its own line (or os._exit) with a half-written one
-        _emit_error_line(result, f"jax backend unavailable after bounded "
-                                 f"retries: {info}")
-        return 1
-    result["backend_probe"] = info
+        # VERDICT r4 item 4: the artifact must still carry a NUMBER.
+        # The accelerator transport is unreachable (this environment's
+        # tunneled chip has been observed wedged for whole rounds), so
+        # run the SAME pipeline on the CPU XLA backend, clearly labeled:
+        # `backend: "cpu-diagnostic"` + the preflight failure. The
+        # number is a diagnostic floor (host CPU, one core pool), NOT
+        # the chip capability — consumers must branch on `backend`.
+        result["backend"] = "cpu-diagnostic"
+        result["backend_probe_error"] = info[:300]
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        # CPU runs the verdict ~2 orders slower: shrink the timed loops
+        # so the diagnostic completes well inside the watchdog.
+        os.environ.setdefault("BENCH_ITERS", "10")
+        os.environ.setdefault("BENCH_SKIP_BLOCKLIST", "1")
+        os.environ.setdefault("BENCH_SKIP_E2E", "1")
+        os.environ.setdefault("BENCH_SKIP_DATAPLANE", "1")
+    else:
+        result["backend"] = "device"
+        result["backend_probe"] = info
     try:
         _main_impl(result, done)
     except Exception as exc:

@@ -224,8 +224,13 @@ class GeoipDB:
     CACHE_TTL_S = 3600.0
 
     def __init__(self, reader: MmdbReader):
+        import threading
+
         self.reader = reader
         self._cache: dict = {}
+        # Shared between the asyncio listener thread and the ring-
+        # sidecar thread; guards the promote/evict cache mutations.
+        self._lock = threading.Lock()
 
     @staticmethod
     def load(paths=GEOIP_DATABASE_PATHS) -> Optional["GeoipDB"]:
@@ -249,7 +254,18 @@ class GeoipDB:
         if addr.is_loopback or addr.is_multicast:
             raise AddressNotFound(str(ip))
         now = time.monotonic()
-        hit = self._cache.get(addr)
+        # One GeoipDB is shared between the asyncio listener thread and
+        # the ring-sidecar thread (native_plane wiring): the promote /
+        # evict mutations below need the lock (the mmdb tree walk runs
+        # outside it).
+        with self._lock:
+            hit = self._cache.get(addr)
+            if hit is not None and hit[1] > now:
+                # LRU promotion: re-insert at the dict tail so
+                # sustained floods of unique addresses evict their own
+                # stale misses before they evict live entries.
+                del self._cache[addr]
+                self._cache[addr] = hit
         if hit is not None and hit[1] > now:
             if hit[0] is None:  # cached miss
                 raise AddressNotFound(str(ip))
@@ -260,15 +276,32 @@ class GeoipDB:
             # addresses are the common case on hot serving paths (the
             # ring sidecar enriches every request), and re-walking the
             # mmdb tree per request would defeat the cache entirely.
-            if len(self._cache) >= self.CACHE_MAX:
-                self._cache.clear()
-            self._cache[addr] = (None, now + self.CACHE_TTL_S)
+            with self._lock:
+                if len(self._cache) >= self.CACHE_MAX:
+                    self._evict(now)
+                self._cache[addr] = (None, now + self.CACHE_TTL_S)
             raise AddressNotFound(str(ip))
         record = record_from_raw(raw)
-        if len(self._cache) >= self.CACHE_MAX:
-            self._cache.clear()  # simple wholesale eviction
-        self._cache[addr] = (record, now + self.CACHE_TTL_S)
+        with self._lock:
+            if len(self._cache) >= self.CACHE_MAX:
+                self._evict(now)
+            self._cache[addr] = (record, now + self.CACHE_TTL_S)
         return record
+
+    def _evict(self, now: float) -> None:
+        """Bounded partial eviction (expired first, then the oldest
+        eighth) — wholesale clear() would let a flood of unique absent
+        IPs repeatedly wipe every live positive entry (moka, the
+        reference's cache, evicts incrementally for the same reason)."""
+        expired = [k for k, v in self._cache.items() if v[1] <= now]
+        for k in expired:
+            del self._cache[k]
+        if len(self._cache) >= self.CACHE_MAX:
+            import itertools
+
+            drop = max(1, self.CACHE_MAX // 8)
+            for k in list(itertools.islice(iter(self._cache), drop)):
+                del self._cache[k]
 
 
 # -- writer (test fixtures) --------------------------------------------------

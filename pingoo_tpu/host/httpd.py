@@ -157,6 +157,7 @@ class HttpListener:
         tls_context=None,
         acme_challenges: Optional[dict] = None,
         trust_xff: bool = False,
+        xff_token: Optional[str] = None,
         route_indices: Optional[list] = None,
     ):
         self.name = name
@@ -173,8 +174,16 @@ class HttpListener:
         # When this listener runs as the control plane BEHIND the native
         # data plane (which injects x-forwarded-for), the captcha client
         # id must bind to the REAL client address, not the proxy's.
-        # Only enable behind a trusted front — XFF is client-forgeable.
+        # XFF is client-forgeable, so trust is TOKEN-BOUND when
+        # xff_token is set: only requests carrying the native plane's
+        # per-boot x-pingoo-internal token are trusted — any other
+        # local process dialing the loopback port cannot spoof client
+        # identity for captcha binding or IP rules. A bare
+        # trust_xff=True (no token) trusts unconditionally; only for
+        # closed test rigs. When xff_token is set it alone decides
+        # (handle_request branches on it before consulting trust_xff).
         self.trust_xff = trust_xff
+        self.xff_token = xff_token
         # Per-service columns of the batched verdict carrying the route
         # predicates (plan.route_index); None entries (or no list) fall
         # back to per-request interpretation of service.route.
@@ -469,7 +478,25 @@ class HttpListener:
     async def handle_request(self, req: Request, peer) -> Response:
         self.stats.requests += 1
         client_ip, client_port = str(peer[0]), int(peer[1])
-        if self.trust_xff:
+        trusted = self.trust_xff
+        if self.xff_token is not None:
+            import hmac as _hmac
+
+            token = None
+            for name, value in req.headers:
+                if name.lower() == "x-pingoo-internal":
+                    token = value
+                    break
+            # bytes compare: compare_digest raises TypeError on
+            # non-ASCII str input, and the header is attacker-supplied.
+            trusted = token is not None and _hmac.compare_digest(
+                token.encode("latin-1", "replace"),
+                self.xff_token.encode("latin-1", "replace"))
+        # The token header never travels further (rules context,
+        # upstream hops): strip it regardless of validity.
+        req.headers = [(n, v) for n, v in req.headers
+                       if n.lower() != "x-pingoo-internal"]
+        if trusted:
             for name, value in req.headers:
                 if name.lower() == "x-forwarded-for":
                     first = value.split(",")[0].strip()

@@ -53,44 +53,33 @@ REPUBLISH_INTERVAL_S = 2.0  # reference discovery tick, service_registry.rs:86
 DRAIN_CAP_S = 20.0  # reference graceful-shutdown cap, listeners/mod.rs:28
 
 
-def _free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
-
-
-def _loopback_rebase(config: Config) -> tuple[Config, dict[str, int]]:
-    """Copy the config with every listener moved to a loopback ephemeral
-    port; returns (rebased config, original-listener-name -> new port).
-    The native plane takes over the PUBLIC addresses."""
+def _loopback_rebase(config: Config) -> Config:
+    """Copy the config with every HTTP listener moved to a loopback
+    EPHEMERAL port (port 0 — the kernel assigns at bind time, so there
+    is no pick-then-rebind race; the real ports are read back from the
+    bound listeners after Server.start()). The native plane takes over
+    the PUBLIC addresses."""
     import dataclasses
 
     from ..config.schema import ListenerProtocol
 
-    ports: dict[str, int] = {}
     listeners = []
     for listener in config.listeners:
         if not listener.protocol.is_http:
-            # TCP proxying stays on the Python plane AT ITS PUBLIC
-            # address — the native front door only fronts HTTP(S), and
-            # silently rebasing a tcp listener to loopback would strand
-            # its clients.
-            listeners.append(listener)
+            # TCP(+TLS) listeners are fronted by the C++ plane in
+            # tcp-proxy mode (round 5) — drop them from the Python
+            # plane entirely (a loopback tcp stand-in would be a second
+            # bind for no traffic; there is no fail-open for tcp).
             continue
-        port = _free_port()
-        ports[listener.name] = port
         proto = listener.protocol
         # The Python plane sits behind the native proxy on loopback; TLS
         # terminates at the native edge, so the inner hop is plaintext.
         if proto == ListenerProtocol.HTTPS:
             proto = ListenerProtocol.HTTP
         listeners.append(dataclasses.replace(
-            listener, host="127.0.0.1", port=port, protocol=proto))
-    rebased = dataclasses.replace(config, listeners=type(config.listeners)(
+            listener, host="127.0.0.1", port=0, protocol=proto))
+    return dataclasses.replace(config, listeners=type(config.listeners)(
         listeners))
-    return rebased, ports
 
 
 class NativePlane:
@@ -111,16 +100,32 @@ class NativePlane:
             "PINGOO_UPSTREAM_CA") or None
         self.httpd_bin = httpd_bin or os.path.join(
             native_ring.NATIVE_DIR, "httpd")
-        rebased, self._loopback_ports = _loopback_rebase(config)
-        self.server = Server(rebased, use_device=use_device,
-                             **server_kwargs)
+        # Per-boot token binding x-forwarded-for trust to THIS data
+        # plane: the C++ workers send it on loopback control-plane hops
+        # and the Python listeners trust XFF only when it matches.
+        import secrets
+
+        self._internal_token = secrets.token_hex(16)
+        self._token_path = os.path.join(state_dir, "internal.token")
+        tls_alpn = bool(config.tls.acme is not None
+                        and config.tls.acme.domains)
+        self.server = Server(_loopback_rebase(config),
+                             use_device=use_device,
+                             xff_token=self._internal_token,
+                             tls_alpn=tls_alpn, **server_kwargs)
+        self._loopback_ports: dict[str, int] = {}
         self.sidecar = None
         self._sidecar_thread = None
         self.rings = []
         self.procs: list[subprocess.Popen] = []
         self._republish_task = None
-        self._service_names: list[str] = []
-        self.services_path = os.path.join(state_dir, "services.tbl")
+        # Per HTTP listener: its ordered http-service names and its own
+        # routing-table file (the reference binds a service list PER
+        # listener, config.rs:241-253 — each listener's verdict route
+        # field indexes ITS table, so listeners may front different
+        # service sets).
+        self._listener_services: dict[str, list[str]] = {}
+        self.services_paths: dict[str, str] = {}
 
     async def start(self) -> None:
         import threading
@@ -136,24 +141,19 @@ class NativePlane:
             subprocess.run, ["make", "-C", native_ring.NATIVE_DIR, "httpd"],
             check=True, capture_output=True)
         os.makedirs(self.state_dir, exist_ok=True)
-
-        # Deployment env for the LOOPBACK plane, set here (not in
-        # __init__) so merely constructing a NativePlane cannot leak
-        # these into an unrelated internet-facing Server in the same
-        # process. Server.start() reads both.
-        # - TRUST_XFF: captcha client ids must bind the real client
-        #   address the native gate injects via x-forwarded-for.
-        # - TLS_ALPN: the native TLS transport fronts the public ports,
-        #   so ACME must validate via tls-alpn-01 (http-01 would hit
-        #   the native verdict/route path, not the challenge handler).
-        os.environ["PINGOO_TRUST_XFF"] = "1"
-        if self.config.tls.acme is not None and self.config.tls.acme.domains:
-            os.environ["PINGOO_TLS_ALPN"] = "1"
+        # 0600 + file (not argv): /proc/<pid>/cmdline is world-readable.
+        fd = os.open(self._token_path,
+                     os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(self._internal_token)
 
         await self.server.start()
+        # The rebased listeners bound port 0; read the kernel-assigned
+        # ports back (no pick-then-rebind TOCTOU).
+        self._loopback_ports = {l.name: l.bound_port
+                                for l in self.server.http_listeners}
 
-        if any(l.protocol.is_tls and l.protocol.is_http
-               for l in self.config.listeners):
+        if any(l.protocol.is_tls for l in self.config.listeners):
             # The rebased config has no TLS listener, so Server skipped
             # TlsManager — but the NATIVE edge terminates TLS and needs
             # the store populated (first boot: the self-signed `*`
@@ -167,32 +167,29 @@ class NativePlane:
         if not http_listeners:
             raise RuntimeError("native plane needs at least one http(s) "
                                "listener")
-        # One global service order: the route verdict's 5-bit field
-        # indexes it (native_ring.write_services_file order).
-        orders = {tuple(l.services) for l in http_listeners}
-        if len(orders) > 1:
-            raise RuntimeError(
-                "native plane requires every HTTP listener to share one "
-                f"service order; got {sorted(orders)} — run the Python "
-                "plane for per-listener service sets")
-        names = [n for n in http_listeners[0].services
-                 if self._is_http_service(n)]
-        self._service_names = names
+        for listener in http_listeners:
+            self._listener_services[listener.name] = [
+                n for n in listener.services if self._is_http_service(n)]
+            self.services_paths[listener.name] = os.path.join(
+                self.state_dir, f"services_{listener.name}.tbl")
 
         # One ring PER (listener, worker): the verdict queue is MPMC, so
         # two httpd processes sharing a ring would steal each other's
         # tickets (each discards tickets it does not own, and the victim
         # requests fail open at the verdict deadline).
         ring_paths: dict[tuple[str, int], str] = {}
+        ring_services: list = []  # aligned with self.rings
         for listener in http_listeners:
             for w in range(self.workers):
                 path = os.path.join(self.state_dir,
                                     f"ring_{listener.name}_{w}")
                 ring_paths[(listener.name, w)] = path
                 self.rings.append(Ring(path, capacity=16384, create=True))
+                ring_services.append(
+                    self._listener_services[listener.name] or None)
         self.sidecar = RingSidecar(
             self.rings, self.server.plan, self.server.lists,
-            max_batch=1024, services=names or None,
+            max_batch=1024, ring_services=ring_services,
             geoip=self.server.geoip)
         self._sidecar_thread = threading.Thread(
             target=self.sidecar.run, daemon=True)
@@ -211,8 +208,9 @@ class NativePlane:
                     "127.0.0.1", str(fail_open_port),
                     "--captcha-upstream", f"127.0.0.1:{fail_open_port}",
                     "--jwks", self.server.captcha_jwks_path,
-                    "--services", self.services_path,
+                    "--services", self.services_paths[listener.name],
                     "--bind", listener.host,
+                    "--internal-token-file", self._token_path,
                 ]
                 if listener.protocol.is_tls:
                     argv += ["--tls-dir", tls_dir]
@@ -237,6 +235,11 @@ class NativePlane:
                     raise RuntimeError(
                         f"native httpd failed to bind "
                         f"{listener.host}:{listener.port}: {line!r}")
+                # Keep draining the pipe for the child's lifetime: a
+                # chatty worker against a full, never-read pipe would
+                # block inside the data plane.
+                threading.Thread(target=self._pump_child_output,
+                                 args=(proc,), daemon=True).start()
             log.info("native listener up", extra={"fields": {
                 "listener": listener.name,
                 "address": f"{listener.host}:{listener.port}",
@@ -244,63 +247,152 @@ class NativePlane:
                 "workers": self.workers,
                 "fail_open": f"127.0.0.1:{fail_open_port}",
             }})
+
+        # TCP(+TLS) listeners: same binary in --tcp-proxy mode — accept
+        # (+TLS terminate), pick a random upstream from the table
+        # (3 tries / 3 s, tcp_proxy_service.rs:30-84), splice bytes.
+        tcp_listeners = [l for l in self.config.listeners
+                         if not l.protocol.is_http]
+        for listener in tcp_listeners:
+            # exactly one service per tcp listener (config validation)
+            self._listener_services[listener.name] = list(listener.services)
+            self.services_paths[listener.name] = os.path.join(
+                self.state_dir, f"services_{listener.name}.tbl")
+        if tcp_listeners:
+            await asyncio.to_thread(self._write_services)
+        for listener in tcp_listeners:
+            ring_path = os.path.join(self.state_dir,
+                                     f"ring_{listener.name}_tcp")
+            # The ring argv is mandatory but unused in tcp mode (no
+            # verdicts on raw streams — the reference evaluates rules
+            # only on HTTP listeners).
+            self.rings.append(Ring(ring_path, capacity=64, create=True))
+            for w in range(self.workers):
+                argv = [
+                    self.httpd_bin, str(listener.port), ring_path,
+                    "127.0.0.1", "9",  # unused: table routes instead
+                    "--services", self.services_paths[listener.name],
+                    "--bind", listener.host,
+                    "--tcp-proxy",
+                ]
+                if listener.protocol.is_tls:
+                    argv += ["--tls-dir", tls_dir]
+                    if os.path.isdir(alpn_dir):
+                        argv += ["--alpn-dir", alpn_dir]
+                proc = subprocess.Popen(argv, stdout=subprocess.PIPE)
+                self.procs.append(proc)
+                try:
+                    line = await asyncio.wait_for(
+                        asyncio.to_thread(proc.stdout.readline), timeout=60)
+                except asyncio.TimeoutError:
+                    raise RuntimeError(
+                        f"native tcp httpd stalled before binding "
+                        f"{listener.host}:{listener.port}")
+                if b"listening" not in line:
+                    raise RuntimeError(
+                        f"native tcp httpd failed to bind "
+                        f"{listener.host}:{listener.port}: {line!r}")
+                threading.Thread(target=self._pump_child_output,
+                                 args=(proc,), daemon=True).start()
+            log.info("native tcp listener up", extra={"fields": {
+                "listener": listener.name,
+                "address": f"{listener.host}:{listener.port}",
+                "tls": listener.protocol.is_tls,
+                "workers": self.workers,
+            }})
         self._republish_task = asyncio.create_task(self._republish_loop())
+
+    @staticmethod
+    def _pump_child_output(proc) -> None:
+        for raw in proc.stdout:
+            line = raw.decode("utf-8", "replace").rstrip()
+            if line:
+                log.info("native httpd", extra={"fields": {
+                    "pid": proc.pid, "line": line}})
 
     def _is_http_service(self, name: str) -> bool:
         svc = next(s for s in self.config.services if s.name == name)
         return svc.tcp_proxy is None
 
-    def _loopback_target(self, name: str) -> tuple[str, int]:
+    def _loopback_target(self, name: str) -> tuple:
+        # Only HTTP listeners have loopback rebinds — a service that
+        # ALSO appears in an earlier tcp listener must not index
+        # _loopback_ports with the tcp listener's name (KeyError).
+        from ..native_ring import INTERNAL
+
         listener = next(l for l in self.config.listeners
-                        if name in l.services)
-        return ("127.0.0.1", self._loopback_ports[listener.name])
+                        if l.protocol.is_http and name in l.services)
+        return ("127.0.0.1", self._loopback_ports[listener.name], INTERNAL)
+
+    def _service_upstreams(self, name: str) -> list:
+        """One service's publishable upstream entries. Plain AND TLS
+        upstreams are published natively (the C++ connector dials TLS
+        targets with SNI + verification, httpd.cc up_tls_begin);
+        targets the native connector cannot speak to — static sites,
+        h2:// prior-knowledge upstreams — route to the loopback Python
+        plane, which serves / proxies them with full policy; upstreams
+        whose address cannot resolve are skipped."""
+        svc = next(s for s in self.config.services if s.name == name)
+        ups: list = []
+        via_python = False
+        if svc.tcp_proxy is not None:
+            # Raw TCP: no Python-plane fallback exists (and none is
+            # needed — there is no verdict path to fail open from).
+            # Unresolvable upstreams are simply skipped this tick; the
+            # registry keeps them discovered (DNS/Docker) like any
+            # other service (service_registry.rs:86).
+            for u in self.server.registry.get_upstreams(name):
+                addr = u.ip or u.hostname
+                try:
+                    addr = socket.gethostbyname(addr)
+                except OSError:
+                    continue
+                ups.append((addr, u.port))
+            return ups
+        if svc.static is not None:
+            via_python = True  # served by the Python plane
+        else:
+            for u in self.server.registry.get_upstreams(name):
+                if u.h2:
+                    # h2:// prior-knowledge framing is a Python-
+                    # plane capability for now.
+                    via_python = True
+                    continue
+                addr = u.ip or u.hostname
+                try:
+                    addr = socket.gethostbyname(addr)
+                except OSError:
+                    # Unresolvable here (or IPv6-only —
+                    # gethostbyname is v4): the Python proxy can
+                    # still reach it, so route via the loopback
+                    # plane instead of publishing a dead service.
+                    via_python = True
+                    continue
+                if u.tls:
+                    # Verify against the configured name when there
+                    # is one; a literal-address upstream pins the
+                    # address itself (IP SAN).
+                    ups.append((addr, u.port, u.hostname or addr))
+                else:
+                    ups.append((addr, u.port))
+        if via_python:
+            ups.append(self._loopback_target(name))
+        return ups
 
     def _write_services(self) -> None:
-        """Snapshot the registry into the native routing table (runs in
-        a worker thread: gethostbyname blocks). Plain AND TLS upstreams
-        are published natively (the C++ connector dials TLS targets with
-        SNI + verification, httpd.cc up_tls_begin); targets the native
-        connector cannot speak to — static sites, h2:// prior-knowledge
-        upstreams — route to the loopback Python plane, which serves /
-        proxies them with full policy; upstreams whose address cannot
-        resolve are skipped."""
+        """Snapshot the registry into each listener's OWN routing table
+        (runs in a worker thread: gethostbyname blocks). A listener's
+        verdict route field indexes the order of ITS service list, so
+        every table is written in that listener's order (reference:
+        per-listener service binding, config.rs:241-253)."""
         from ..native_ring import write_services_file
 
-        table = []
-        for name in self._service_names:
-            svc = next(s for s in self.config.services if s.name == name)
-            ups = []
-            via_python = False
-            if svc.static is not None:
-                via_python = True  # served by the Python plane
-            else:
-                for u in self.server.registry.get_upstreams(name):
-                    if u.h2:
-                        # h2:// prior-knowledge framing is a Python-
-                        # plane capability for now.
-                        via_python = True
-                        continue
-                    addr = u.ip or u.hostname
-                    try:
-                        addr = socket.gethostbyname(addr)
-                    except OSError:
-                        # Unresolvable here (or IPv6-only —
-                        # gethostbyname is v4): the Python proxy can
-                        # still reach it, so route via the loopback
-                        # plane instead of publishing a dead service.
-                        via_python = True
-                        continue
-                    if u.tls:
-                        # Verify against the configured name when there
-                        # is one; a literal-address upstream pins the
-                        # address itself (IP SAN).
-                        ups.append((addr, u.port, u.hostname or addr))
-                    else:
-                        ups.append((addr, u.port))
-            if via_python:
-                ups.append(self._loopback_target(name))
-            table.append((name, ups))
-        write_services_file(self.services_path, table)
+        resolved = {name: self._service_upstreams(name)
+                    for names in self._listener_services.values()
+                    for name in names}
+        for lname, names in self._listener_services.items():
+            write_services_file(self.services_paths[lname],
+                                [(n, resolved[n]) for n in names])
 
     async def _republish_loop(self) -> None:
         last = None
@@ -311,7 +403,8 @@ class NativePlane:
                     (n, tuple(
                         (u.ip or u.hostname, u.port, u.tls)
                         for u in self.server.registry.get_upstreams(n)))
-                    for n in self._service_names
+                    for names in self._listener_services.values()
+                    for n in names
                 ]
                 if snapshot != last:
                     await asyncio.to_thread(self._write_services)

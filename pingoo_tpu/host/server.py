@@ -41,6 +41,8 @@ class Server:
         enable_docker: bool = True,
         cache_dir: Optional[str] = None,
         bot_score_params_path: Optional[str] = None,
+        xff_token: Optional[str] = None,
+        tls_alpn: bool = False,
     ):
         self.config = config
         self.use_device = use_device
@@ -50,6 +52,17 @@ class Server:
         self.enable_docker = enable_docker
         self.cache_dir = cache_dir
         self.bot_score_params_path = bot_score_params_path
+        # Deployment flags the native-plane runner passes EXPLICITLY
+        # (they used to travel via process-global env vars, which let
+        # any co-resident Server instance inherit them):
+        # - xff_token: per-boot token; the listeners trust
+        #   x-forwarded-for ONLY on requests carrying it (the C++ data
+        #   plane sends it on loopback control-plane hops).
+        # - tls_alpn: the native TLS transport fronts the public ports,
+        #   so ACME validates via tls-alpn-01 (http-01 would hit the
+        #   native verdict path, not the challenge handler).
+        self.xff_token = xff_token
+        self.tls_alpn = tls_alpn
         self.registry: Optional[ServiceRegistry] = None
         self.verdict: Optional[VerdictService] = None
         self.http_listeners: list[HttpListener] = []
@@ -114,14 +127,14 @@ class Server:
             from .acme import AcmeManager
 
             # Challenge type is an EXPLICIT deployment choice:
-            # PINGOO_TLS_ALPN=1 means the native TLS transport fronts
+            # tls_alpn=True means the native TLS transport fronts
             # port 443 and answers acme-tls/1 from <tls_dir>/alpn
             # (tls-alpn-01, the reference's only challenge type,
             # acme.rs:180-242). Without it, the Python-only deployment
             # uses http-01 — inferring the mode from directory existence
             # would silently break issuance either way.
             alpn_dir = None
-            if os.environ.get("PINGOO_TLS_ALPN") == "1":
+            if self.tls_alpn:
                 alpn_dir = os.path.join(self.tls_dir, "alpn")
                 os.makedirs(alpn_dir, exist_ok=True)
             self.acme = AcmeManager(
@@ -131,12 +144,6 @@ class Server:
             acme_challenges = self.acme.challenges
             await self.acme.start_in_background()
 
-        # Deployment flag: set when this listener runs as the control
-        # plane behind the native data plane (which strips and re-injects
-        # x-forwarded-for) — the captcha client id must then bind the
-        # REAL client address or issued cookies never verify at the
-        # native gate. Never set it on an internet-facing listener.
-        trust_xff = os.environ.get("PINGOO_TRUST_XFF") == "1"
 
         services_by_name = {s.name: s for s in config.services}
         for listener_cfg in config.listeners:
@@ -158,7 +165,7 @@ class Server:
                     tls_context=(tls_manager.server_context()
                                  if listener_cfg.protocol.is_tls else None),
                     acme_challenges=acme_challenges,
-                    trust_xff=trust_xff,
+                    xff_token=self.xff_token,
                     # Columns are looked up by the BUILT services' names:
                     # build_http_services may drop non-http entries, so a
                     # positional zip against the config list could hand a

@@ -92,6 +92,21 @@ constexpr size_t kMaxBuffered = 1 << 20;  // per-direction backlog cap
 constexpr time_t kIdleTimeoutS = 30;
 constexpr time_t kVerdictTimeoutS = 3;   // then fail open
 constexpr time_t kTunnelIdleS = 300;     // upgraded (WebSocket) tunnels
+// TCP proxy mode (reference tcp_proxy_service.rs:30-84): 3 connect
+// tries, 3 s timeout each. The reference sleeps 5 ms between tries;
+// this plane re-dials immediately on a failed connect (a fresh random
+// upstream each time), which only tightens the retry window.
+constexpr int kTcpConnectRetriesDefault = 3;
+constexpr time_t kTcpConnectTimeoutS = 3;
+
+inline int tcp_connect_retries() {
+  static int v = [] {
+    const char* e = getenv("PINGOO_TCP_RETRIES");
+    int n = e != nullptr ? atoi(e) : 0;
+    return n > 0 ? n : kTcpConnectRetriesDefault;
+  }();
+  return v;
+}
 constexpr size_t kMaxReplay = 64 * 1024;  // pooled-retry replay budget
 // nghttp2 data-provider sentinel: no DATA available now; the session
 // parks the stream until nghttp2_session_resume_data.
@@ -613,6 +628,8 @@ struct Parsed {
 struct UpTarget {
   sockaddr_in sa{};
   bool tls = false;
+  bool internal = false;  // the loopback control plane: identity headers
+                          // (x-pingoo-internal) may be sent to it
   std::string sni;
 };
 
@@ -629,6 +646,7 @@ struct H2Stream {
   int up_fd = -1;
   bool up_connected = false;
   bool up_eof = false;
+  bool up_trunc = false;        // upstream ended with an ERROR, not clean EOF
   bool up_pooled = false;
   uint64_t up_key = 0;
   UpTarget up_target{};
@@ -791,7 +809,8 @@ bool drop_request_header(const std::string& lname, bool chunked);
 // verdicted request — the enforced scope), add forwarding headers
 // (reference http_proxy_service.rs:114-190).
 std::string rewrite_request_head(const Parsed& p, const std::string& client_ip,
-                                 bool tls) {
+                                 bool tls,
+                                 const std::string& internal_token) {
   const std::string& head = p.raw_head;
   size_t line_end = head.find("\r\n");
   std::string out = head.substr(0, line_end + 2);
@@ -825,6 +844,12 @@ std::string rewrite_request_head(const Parsed& p, const std::string& client_ip,
   out += std::string("x-forwarded-proto: ") + (tls ? "https" : "http") + "\r\n";
   if (!p.host.empty()) out += "x-forwarded-host: " + p.host + "\r\n";
   out += "pingoo-client-ip: " + client_ip + "\r\n";
+  // Hops to the loopback control plane carry the per-boot internal
+  // token so the Python listener can bind x-forwarded-for trust to
+  // THIS proxy rather than to anything that can dial 127.0.0.1
+  // (spoofed client identity would defeat captcha binding + IP rules).
+  if (!internal_token.empty())
+    out += "x-pingoo-internal: " + internal_token + "\r\n";
   out += "\r\n";
   return out;
 }
@@ -845,6 +870,7 @@ bool drop_request_header(const std::string& lname, bool chunked) {
   // (reference strips and re-sets the same set,
   // http_proxy_service.rs:114-190).
   if (lname.compare(0, 7, "pingoo-") == 0) return true;
+  if (lname == "x-pingoo-internal") return true;
   return lname == "x-forwarded-for" || lname == "x-forwarded-proto" ||
          lname == "x-forwarded-host";
 }
@@ -1009,6 +1035,9 @@ struct Conn {
   bool dead = false;
   bool upstream_connected = false;
   bool upstream_eof = false;
+  bool up_trunc = false;        // upstream ended with an ERROR, not clean EOF
+  int tcp_attempts = 0;         // tcp-proxy mode: connect tries so far
+  time_t tcp_connect_at = 0;    // tcp-proxy mode: when this try started
   uint64_t up_key = 0;          // pool key of the connected target
   UpTarget up_target{};         // connected target (pooled-retry)
   SSL* up_ssl = nullptr;        // non-null on TLS upstream links
@@ -1160,6 +1189,16 @@ struct ServiceTable {
             ok = false;
             break;
           }
+        } else if (strncmp(rest, "internal", 8) == 0 &&
+                   (rest[8] == '\0' || rest[8] == '\n' || rest[8] == '\r' ||
+                    rest[8] == ' ' || rest[8] == '\t')) {
+          const char* tail = rest + 8;
+          while (*tail == ' ' || *tail == '\t') tail++;
+          if (*tail != '\0' && *tail != '\n' && *tail != '\r') {
+            ok = false;  // fields past the marker: version skew
+            break;
+          }
+          t.internal = true;  // loopback control-plane target
         } else if (*rest != '\0' && *rest != '\n' && *rest != '\r') {
           ok = false;  // unknown trailing fields: same fail-closed rule
           break;
@@ -1190,14 +1229,17 @@ class Server {
   Server(int ep, void* ring, const sockaddr_in& upstream,
          const sockaddr_in* captcha_upstream, CaptchaGate* gate,
          TlsStore* tls, ServiceTable* services = nullptr,
-         SSL_CTX* up_ctx = nullptr)
+         SSL_CTX* up_ctx = nullptr, std::string internal_token = "",
+         bool tcp_mode = false)
       : ep_(ep),
         ring_(ring),
         upstream_(upstream),
         gate_(gate),
         tls_(tls),
         services_(services),
-        up_ctx_(up_ctx) {
+        up_ctx_(up_ctx),
+        internal_token_(std::move(internal_token)),
+        tcp_mode_(tcp_mode) {
     if (captcha_upstream) {
       captcha_upstream_ = *captcha_upstream;
       has_captcha_upstream_ = true;
@@ -1215,6 +1257,7 @@ class Server {
   Route pick_route_target(uint8_t route, UpTarget* out) {
     if (services_ == nullptr || !services_->loaded) {
       out->sa = upstream_;
+      out->internal = true;  // the argv upstream is the loopback plane
       return Route::kOk;
     }
     if (route >= services_->upstreams.size()) return Route::kNoService;
@@ -1235,6 +1278,7 @@ class Server {
   bool default_target(UpTarget* out) {
     if (services_ == nullptr || !services_->loaded) {
       out->sa = upstream_;
+      out->internal = true;  // the argv upstream is the loopback plane
       return true;
     }
     if (!services_->upstreams.empty() && !services_->upstreams[0].empty()) {
@@ -1322,6 +1366,79 @@ class Server {
     ce.events = EPOLLIN;
     ce.data.ptr = &c->client_ref;
     epoll_ctl(ep_, EPOLL_CTL_ADD, cfd, &ce);
+    if (tcp_mode_ && c->ssl == nullptr) start_tcp_proxy(c);
+    // tcp+tls: the handshake completes first (SNI cert store +
+    // acme-tls/1 interception run exactly as for https — reference
+    // accept_tls_connection serves both listener kinds,
+    // listeners/mod.rs:112-154), then on_handshake starts the pump.
+  }
+
+  // -- raw TCP(+TLS) fronting (reference tcp_listener.rs:39-70 +
+  //    tcp_proxy_service.rs:30-84): accept -> pick a random upstream
+  //    (3 tries, 3 s connect timeout) -> bidirectional byte splice.
+  //    Reuses the kTunnel state machine (the WebSocket splice path).
+
+  void start_tcp_proxy(Conn* c) {
+    UpTarget target;
+    if (!default_target(&target)) {
+      // Empty table (discovery warm-up / all upstreams gone): park and
+      // let the retry ladder ride through the outage instead of
+      // dropping the client on first sight.
+      tcp_proxy_fail(c);
+      return;
+    }
+    if (target.tls && up_ctx_ == nullptr) {
+      stats_.upstream_fail++;
+      mark_close(c);
+      return;
+    }
+    int ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
+    if (ufd < 0 ||
+        (connect(ufd, reinterpret_cast<const sockaddr*>(&target.sa),
+                 sizeof(target.sa)) != 0 &&
+         errno != EINPROGRESS)) {
+      if (ufd >= 0) close(ufd);
+      tcp_proxy_fail(c);
+      return;
+    }
+    c->upstream_fd = ufd;
+    c->up_key = 0;
+    c->up_target = target;
+    c->upstream_pooled = false;
+    reset_up_link(c);
+    c->tcp_connect_at = now_;
+    c->state = ConnState::kTunnel;
+    epoll_event ue{};
+    ue.events = EPOLLOUT | EPOLLIN;
+    ue.data.ptr = &c->upstream_ref;
+    epoll_ctl(ep_, EPOLL_CTL_ADD, ufd, &ue);
+    update_client_events(c);
+  }
+
+  void tcp_proxy_fail(Conn* c) {
+    // Retry CONNECT only — once bytes may have flowed, a re-dial would
+    // splice two different upstream streams together.
+    bool mid_stream = c->upstream_connected;
+    close_upstream(c);
+    if (!mid_stream && ++c->tcp_attempts < tcp_connect_retries()) {
+      if (c->tcp_attempts == 1) {
+        // First failure: immediate re-dial (fresh random member).
+        start_tcp_proxy(c);
+      } else {
+        // Later failures: PARK (state kTunnel, no upstream fd); the
+        // per-second sweep re-dials, so retries span real upstream
+        // recovery time (container restart, discovery refresh) instead
+        // of burning all tries in one ECONNREFUSED microsecond — the
+        // reference sleeps between tries and re-snapshots upstreams
+        // for the same reason (tcp_proxy_service.rs:86-112).
+        c->state = ConnState::kTunnel;
+        c->tcp_connect_at = now_;
+        update_client_events(c);
+      }
+      return;
+    }
+    stats_.upstream_fail++;
+    mark_close(c);
   }
 
   Conn* conn_for_ssl(SSL* ssl) {
@@ -1530,6 +1647,15 @@ class Server {
           if (idle > kProxyIdleTimeoutS) mark_close(c);
           break;
         case ConnState::kTunnel:
+          if (tcp_mode_ && !c->upstream_connected && c->upstream_fd < 0) {
+            start_tcp_proxy(c);  // parked retry: re-dial this sweep
+            break;
+          }
+          if (tcp_mode_ && !c->upstream_connected && c->upstream_fd >= 0 &&
+              now_ - c->tcp_connect_at > kTcpConnectTimeoutS) {
+            tcp_proxy_fail(c);  // reference: 3 s connect timeout/try
+            break;
+          }
           // WebSockets idle legitimately (pings may be minutes apart).
           if (idle > kTunnelIdleS) mark_close(c);
           break;
@@ -1709,6 +1835,7 @@ class Server {
   void reset_up_link(Conn* c) {
     c->upstream_connected = false;
     c->upstream_eof = false;
+    c->up_trunc = false;
     c->up_tcp_ok = false;
     c->up_tls_hs = false;
     c->up_hs_want_write = false;
@@ -1814,7 +1941,11 @@ class Server {
       *rd_want_write = true;
       return kIoAgain;
     }
-    if (e == SSL_ERROR_SYSCALL && r == 0) return 0;  // EOF sans alert
+    // SSL_ERROR_SYSCALL with ret==0 is a TCP FIN without close_notify:
+    // an unauthenticated party able to inject a FIN could otherwise
+    // truncate a response and have it forwarded as a complete one.
+    // Treat it as an error so it 502s / aborts instead (rustls surfaces
+    // the same condition as UnexpectedEof).
     return kIoErr;
   }
 
@@ -1850,6 +1981,13 @@ class Server {
   // failed upstream down FIRST so a retry/new proxy never races an fd
   // still registered in epoll.
   void respond_502(Conn* c) {
+    if (tcp_mode_) {
+      // No HTTP on this plane: connect-phase failures retry, mid-
+      // stream failures drop the connection (the reference's
+      // copy_bidirectional just ends on error).
+      tcp_proxy_fail(c);
+      return;
+    }
     if (try_pooled_retry(c)) return;
     stats_.upstream_fail++;
     close_upstream(c);
@@ -2009,7 +2147,9 @@ class Server {
 
     c->state = ConnState::kProxying;
     // Rewritten head + whatever request-body bytes are buffered.
-    c->upbuf = rewrite_request_head(c->req, c->peer_ip, c->ssl != nullptr);
+    c->upbuf = rewrite_request_head(
+        c->req, c->peer_ip, c->ssl != nullptr,
+        target.internal ? internal_token_ : std::string());
     pump_request_body(c);
     // A POOLED connection can die between the liveness probe and our
     // write (server idle-timeout race). Keep the sent bytes around so
@@ -2285,6 +2425,7 @@ class Server {
         {
           UpTarget t;
           t.sa = captcha_upstream_;
+          t.internal = true;
           start_proxy(c, t);
         }
         return;
@@ -2508,6 +2649,7 @@ class Server {
           {
             UpTarget t;
             t.sa = captcha_upstream_;
+          t.internal = true;
             h2_start_stream_proxy(c, sid, t);
           }
           break;
@@ -2662,6 +2804,7 @@ class Server {
     st.up_rd_want_write = false;
     st.up_wr_want_read = false;
     st.up_eof = false;
+    st.up_trunc = false;
     st.up_keep = false;
     st.up_junk = false;
     st.resp_head_buf.clear();
@@ -2700,6 +2843,7 @@ class Server {
     st.up_pooled = false;  // one retry only
     st.up_connected = false;  // close already reset the TLS link state
     st.up_eof = false;
+    st.up_trunc = false;
     st.upbuf = st.up_replay;
     st.up_ref = new SockRef{c, true, sid};
     c->h2_upstreams++;
@@ -2892,7 +3036,8 @@ class Server {
       return;
     }
     bool done = st.resp_body.done ||
-                (st.resp_body.mode == BodyFramer::kUntilEof && st.up_eof);
+                (st.resp_body.mode == BodyFramer::kUntilEof && st.up_eof &&
+                 !st.up_trunc);
     if (done && !st.data_eof) {
       st.data_eof = true;
       if (st.resp_body.mode == BodyFramer::kUntilEof)
@@ -2902,9 +3047,12 @@ class Server {
       return;
     }
     if (st.up_eof && !st.resp_body.done && !st.data_eof &&
-        st.resp_body.mode != BodyFramer::kUntilEof) {
-      // Truncated CL/chunked response: reset the stream so the client
-      // sees the failure instead of a certified-short body.
+        (st.resp_body.mode != BodyFramer::kUntilEof || st.up_trunc)) {
+      // Truncated CL/chunked response — or an EOF-delimited body ended
+      // by a transport ERROR (TLS: FIN without close_notify, which an
+      // attacker can inject) rather than a clean close: reset the
+      // stream so the client sees the failure instead of a
+      // certified-complete short body (rustls: UnexpectedEof).
       h2_close_stream_upstream(c, st);
       h2_abort_stream(c, sid);
       h2_process_next(c);
@@ -3009,7 +3157,8 @@ class Server {
           break;
         } else {
           st.up_eof = true;
-          break;
+          if (r == kIoErr) st.up_trunc = true;  // FIN sans close_notify /
+          break;                                // transport error
         }
       }
     }
@@ -3063,6 +3212,8 @@ class Server {
     out += std::string("x-forwarded-proto: ") +
            (c->ssl != nullptr ? "https" : "http") + "\r\n";
     if (!p.host.empty()) out += "x-forwarded-host: " + p.host + "\r\n";
+    if (st.up_target.internal && !internal_token_.empty())
+      out += "x-pingoo-internal: " + internal_token_ + "\r\n";
     out += "pingoo-client-ip: " + std::string(c->peer_ip) + "\r\n\r\n";
     out += st.body;
     return out;
@@ -3343,6 +3494,7 @@ class Server {
           break;
         } else {
           c->upstream_eof = true;
+          c->up_trunc = true;  // FIN sans close_notify / transport error
           break;
         }
       }
@@ -3476,11 +3628,14 @@ class Server {
     }
     bool body_done = c->resp_body.done ||
                      (c->resp_body.mode == BodyFramer::kUntilEof &&
-                      c->upstream_eof);
+                      c->upstream_eof && !c->up_trunc);
     if (!body_done) {
       if (c->upstream_eof && !c->resp_body.done &&
-          c->resp_body.mode != BodyFramer::kUntilEof) {
-        // Truncated upstream response: relay what we have, then close.
+          (c->resp_body.mode != BodyFramer::kUntilEof || c->up_trunc)) {
+        // Truncated upstream response (explicit framing cut short, or
+        // an EOF-delimited TLS body ended by FIN without close_notify):
+        // relay what we have, then close — never pool, and for
+        // explicitly framed bodies the client sees the short read.
         c->close_after_response = true;
         body_done = true;
       } else {
@@ -3516,6 +3671,10 @@ class Server {
         // tls-alpn-01: the validation server only needs the handshake
         // (RFC 8737 §3); close once it completes.
         mark_close(c);
+        return;
+      }
+      if (tcp_mode_) {
+        start_tcp_proxy(c);
         return;
       }
       c->state = ConnState::kReadingHead;
@@ -3606,6 +3765,8 @@ class Server {
   TlsStore* tls_;
   ServiceTable* services_ = nullptr;
   SSL_CTX* up_ctx_ = nullptr;  // upstream TLS client context
+  std::string internal_token_;  // per-boot control-plane trust token
+  bool tcp_mode_ = false;  // raw TCP(+TLS) fronting: no HTTP, no verdicts
   // Links whose SSL object holds decrypted-but-undelivered bytes (no fd
   // readiness will fire for them); drained after each event batch.
   std::vector<std::pair<Conn*, int32_t>> ssl_resume_;
@@ -3726,7 +3887,8 @@ int main(int argc, char** argv) {
                  "usage: %s <listen-port> <ring-file> <upstream-host> "
                  "<upstream-port> [--captcha-upstream host:port] "
                  "[--jwks path] [--tls-dir dir] [--alpn-dir dir] "
-                 "[--services path] [--bind addr] [--upstream-ca pem]\n",
+                 "[--services path] [--bind addr] [--upstream-ca pem] "
+                 "[--internal-token-file path] [--tcp-proxy]\n",
                  argv[0]);
     return 2;
   }
@@ -3742,9 +3904,17 @@ int main(int argc, char** argv) {
   const char* services_path = nullptr;
   const char* bind_addr = nullptr;
   const char* upstream_ca = nullptr;
+  const char* internal_token_file = nullptr;
+  bool tcp_mode = false;
   sockaddr_in captcha_upstream{};
   bool has_captcha = false;
-  for (int i = 5; i + 1 < argc; i += 2) {
+  for (int i = 5; i < argc; i += 2) {
+    if (strcmp(argv[i], "--tcp-proxy") == 0) {
+      tcp_mode = true;
+      i -= 1;  // flag takes no operand
+      continue;
+    }
+    if (i + 1 >= argc) break;  // every remaining option takes a value
     if (strcmp(argv[i], "--captcha-upstream") == 0) {
       if (!parse_hostport(argv[i + 1], &captcha_upstream)) {
         std::fprintf(stderr, "bad --captcha-upstream\n");
@@ -3763,7 +3933,27 @@ int main(int argc, char** argv) {
       bind_addr = argv[i + 1];
     } else if (strcmp(argv[i], "--upstream-ca") == 0) {
       upstream_ca = argv[i + 1];
+    } else if (strcmp(argv[i], "--internal-token-file") == 0) {
+      internal_token_file = argv[i + 1];
     }
+  }
+  // Per-boot token authenticating this proxy to the loopback control
+  // plane (file, not argv: /proc/<pid>/cmdline is world-readable).
+  std::string internal_token;
+  if (internal_token_file != nullptr) {
+    FILE* tf = fopen(internal_token_file, "r");
+    if (tf == nullptr) {
+      std::fprintf(stderr, "cannot read --internal-token-file %s\n",
+                   internal_token_file);
+      return 2;
+    }
+    char tok[256] = {0};
+    size_t tn = fread(tok, 1, sizeof(tok) - 1, tf);
+    fclose(tf);
+    while (tn > 0 && (tok[tn - 1] == '\n' || tok[tn - 1] == '\r' ||
+                      tok[tn - 1] == ' '))
+      tok[--tn] = '\0';
+    internal_token.assign(tok, tn);
   }
 
   addrinfo hints{};
@@ -3884,7 +4074,8 @@ int main(int argc, char** argv) {
 
   Server server(ep, ring, upstream, has_captcha ? &captcha_upstream : nullptr,
                 &gate, tls_dir ? &tls_store : nullptr,
-                services_path ? &services : nullptr, up_ctx);
+                services_path ? &services : nullptr, up_ctx,
+                internal_token, tcp_mode);
   g_server = &server;
   // SIGTERM starts a graceful drain: stop accepting, finish in-flight
   // requests, exit when idle or after the 20 s cap (the reference's

@@ -222,16 +222,24 @@ def slots_to_arrays(slots: np.ndarray) -> dict:
     return arrays
 
 
+# Marks a services-table upstream as the loopback control plane: the
+# C++ connector sends its per-boot internal token on hops to it, which
+# is what lets the Python listener trust the injected x-forwarded-for.
+INTERNAL = "internal"
+
+
 def write_services_file(path: str, services: list) -> None:
     """Publish the native plane's routing table: `services` is the
     listener's ordered [(name, [upstream, ...])] — typically registry
     snapshots (host/discovery.ServiceRegistry.get_upstreams). Each
-    upstream is `(ip, port)` for plaintext or `(ip, port, server_name)`
+    upstream is `(ip, port)` for plaintext, `(ip, port, server_name)`
     for a verified TLS hop (the C++ connector dials it with SNI +
     hostname checks against server_name, reference
-    http_proxy_service.rs:54-71). Written atomically (tmp + rename) so
-    the C++ reader (httpd.cc ServiceTable) never observes a partial
-    table; it hot-reloads on mtime change."""
+    http_proxy_service.rs:54-71), or `(ip, port, INTERNAL)` for the
+    loopback control plane (token-authenticated identity headers).
+    Written atomically (tmp + rename) so the C++ reader (httpd.cc
+    ServiceTable) never observes a partial table; it hot-reloads on
+    mtime change."""
     if len(services) > 31:
         raise ValueError(
             f"native routing supports at most 31 services (5-bit route "
@@ -242,6 +250,8 @@ def write_services_file(path: str, services: list) -> None:
         for up in ups:
             if len(up) == 2:
                 lines.append(f"upstream {up[0]} {up[1]}")
+            elif up[2] is INTERNAL:
+                lines.append(f"upstream {up[0]} {up[1]} internal")
             else:
                 ip, port, sni = up
                 if (not sni or len(sni) > 255
@@ -479,21 +489,39 @@ class RingSidecar:
         # (engine/service.py).
         self.truncated_rows += int(
             ((slots["flags"] & SLOT_FLAG_TRUNCATED) != 0).sum())
+        # Per-row route: each ring's rows read THEIR listener group's
+        # route lane (make_lane_fn stacks one lane per distinct service
+        # order at rows 3..3+G; the reference binds a service list per
+        # listener, config.rs:241-253). Rows from rings with no service
+        # group keep route 0 — their consumer never reads bits 3-7.
         route = None
-        if self.services is not None:
-            route = np.asarray(dev_lanes[3], dtype=np.int64).copy()
-            if self._host_routes:
+        if self._groups:
+            route = np.zeros(n, dtype=np.int64)
+            group_rows: list[list] = [[] for _ in self._groups]
+            off = 0
+            for ring, part in parts:
+                gi = self._ring_group_of.get(id(ring))
+                m = len(part)
+                if gi is not None:
+                    route[off:off + m] = np.asarray(
+                        dev_lanes[3 + gi][off:off + m], dtype=np.int64)
+                    group_rows[gi].append(np.arange(off, off + m))
+                off += m
+            contexts = None
+            for gi, chunks in enumerate(group_rows):
+                if not self._host_routes[gi] or not chunks:
+                    continue
+                rows = np.concatenate(chunks)
                 from .engine.batch import batch_to_contexts
                 from .expr import execute_as_bool
 
-                contexts = None
-                for order, prog in self._host_routes:
-                    better = route > order
-                    if not better.any():
+                for order, prog in self._host_routes[gi]:
+                    better = rows[route[rows] > order]
+                    if not len(better):
                         continue
                     if contexts is None:
                         contexts = batch_to_contexts(raw_batch, self.lists)
-                    for i in np.nonzero(better)[0]:
+                    for i in better:
                         try:
                             hit = prog is None or execute_as_bool(
                                 prog, contexts[i])
@@ -511,16 +539,18 @@ class RingSidecar:
         # truncated_rows above.
         off = 0
         for ring, part in parts:
+            gi = self._ring_group_of.get(id(ring))
+            svcs = self._groups[gi] if gi is not None else None
             spilled = np.nonzero(part["spill_idx"] != SPILL_NONE)[0]
             for j in spilled:
                 idx = int(part["spill_idx"][j])
                 full = ring.spill_read(idx)
                 if full is not None:
                     unv, vblk, rt = self._interpret_overflow_row(
-                        part[j], full[0], full[1])
+                        part[j], full[0], full[1], svcs)
                     unverified[off + j] = unv
                     verified_block[off + j] = vblk
-                    if route is not None:
+                    if route is not None and gi is not None:
                         route[off + j] = rt
                     self.spilled_rows += 1
                 ring.spill_release(idx)
@@ -551,11 +581,13 @@ class RingSidecar:
             off += m
         self.processed += n
 
-    def _interpret_overflow_row(self, slot, url: bytes,
-                                path: bytes) -> tuple[int, bool, int]:
+    def _interpret_overflow_row(self, slot, url: bytes, path: bytes,
+                                services=None) -> tuple[int, bool, int]:
         """(unverified, verified_block, route) for one overflow row via
         the host interpreter over the UNTRUNCATED url/path (the parity
-        oracle), reproducing the reference's full-string matching."""
+        oracle), reproducing the reference's full-string matching.
+        `services` is the row's ring's service order (its listener's
+        group) — routes evaluate against THAT order."""
         import ipaddress
 
         from .engine.batch import RequestTuple, tuple_to_context
@@ -582,7 +614,7 @@ class RingSidecar:
         row = interpret_rules_row(self.plan, ctx)[None, :]
         unv, vblk = action_lanes(self.plan, row)
         rt = int(LANE_NONE)
-        for order, name in enumerate(self.services or []):
+        for order, name in enumerate(services or []):
             ridx = self.plan.route_index.get(name)
             if ridx is None or row[0, ridx]:
                 rt = order

@@ -316,3 +316,63 @@ class TestTcpProxy:
                 upstream.close()
 
         loop_runner.run(flow())
+
+
+class TestXffTokenTrust:
+    """x-forwarded-for trust is TOKEN-BOUND (VERDICT r4 item 5): the
+    loopback control plane honors spoofable identity headers only on
+    requests carrying the native plane's per-boot x-pingoo-internal
+    token — a co-resident process dialing 127.0.0.1 directly cannot
+    spoof client identity for IP rules or captcha binding."""
+
+    @pytest.fixture(scope="class")
+    def listener(self, loop_runner):
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.engine.service import VerdictService
+        from pingoo_tpu.expr import compile_expression
+        from pingoo_tpu.host.captcha import CaptchaManager
+        from pingoo_tpu.host.httpd import HttpListener
+
+        rules = [RuleConfig(
+            name="ipblock", actions=(Action.BLOCK,),
+            expression=compile_expression('client.ip == "9.9.9.9"'))]
+        plan = compile_ruleset(rules, {})
+
+        async def boot(tmpdir):
+            svc = VerdictService(plan, {}, use_device=False,
+                                 max_wait_us=100)
+            lst = HttpListener(
+                "ctl", "127.0.0.1", 0, [], svc, {}, plan.rules,
+                CaptchaManager(jwks_path=f"{tmpdir}/jwks.json"),
+                xff_token="sekrit-token")
+            await svc.start()
+            await lst.bind()
+            asyncio.ensure_future(lst.serve_forever())
+            return lst
+
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as tmpdir:
+            lst = loop_runner.run(boot(tmpdir))
+            yield lst
+
+    def _get(self, loop_runner, port, headers):
+        return loop_runner.run(http_get(port, "/x", headers=headers))
+
+    def test_spoofed_xff_without_token_ignored(self, loop_runner, listener):
+        status, _, _ = self._get(loop_runner, listener.bound_port,
+                                 {"x-forwarded-for": "9.9.9.9"})
+        assert status == 404  # rule did NOT match: peer ip was used
+
+    def test_wrong_token_not_trusted(self, loop_runner, listener):
+        status, _, _ = self._get(loop_runner, listener.bound_port,
+                                 {"x-forwarded-for": "9.9.9.9",
+                                  "x-pingoo-internal": "wrong"})
+        assert status == 404
+
+    def test_valid_token_binds_client_ip(self, loop_runner, listener):
+        status, _, _ = self._get(loop_runner, listener.bound_port,
+                                 {"x-forwarded-for": "9.9.9.9",
+                                  "x-pingoo-internal": "sekrit-token"})
+        assert status == 403  # trusted XFF hit the ip rule

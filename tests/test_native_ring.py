@@ -251,3 +251,158 @@ class TestSpillOverflow:
             sidecar.stop()
             t.join(timeout=10)
             ring.close()
+
+
+class TestSidecarRouting:
+    """Sidecar-level service routing: verdict byte bits 3-7 must carry
+    the first matching service's order in the REQUEST'S OWN listener
+    order (reference selection loop http_listener.rs:266-270; per-
+    listener service lists config.rs:241-253). These run _complete
+    directly through the drain loop — the unit coverage the round-4
+    regression (per-group _host_routes vs flat unpack) lacked."""
+
+    @staticmethod
+    def _plan(routes):
+        from pingoo_tpu.compiler import compile_ruleset
+        from pingoo_tpu.config.schema import Action, RuleConfig
+        from pingoo_tpu.expr import compile_expression
+
+        rules = [RuleConfig(
+            name="blk", actions=(Action.BLOCK,),
+            expression=compile_expression(
+                'http_request.path.starts_with("/evil")'))]
+        return compile_ruleset(rules, {}, routes=routes)
+
+    @staticmethod
+    def _drain(rings, expect_counts, timeout=30):
+        import time
+
+        got = [dict() for _ in rings]
+        deadline = time.time() + timeout
+        while time.time() < deadline and any(
+                len(g) < c for g, c in zip(got, expect_counts)):
+            for g, ring in zip(got, rings):
+                v = ring.poll_verdict()
+                if v is not None:
+                    g[v[0]] = v[1]
+            time.sleep(0.01)
+        return got
+
+    def test_route_lane_with_host_fallback_route(self, tmp_path):
+        """services= mode: device route + host-interpreted route + catch-
+        all, with first-match order across all three."""
+        import threading
+
+        from pingoo_tpu.expr import compile_expression
+
+        routes = [
+            ("api", compile_expression(
+                'http_request.path.starts_with("/api")')),
+            # '+' concat is outside the device subset -> host fallback
+            ("hostsvc", compile_expression(
+                'http_request.host + "" == "hosted.test"')),
+            ("web", None),  # no expression -> match-all
+        ]
+        plan = self._plan(routes)
+        assert plan.stats["host_routes"] == 1  # hostsvc fell back
+        ring = Ring(str(tmp_path / "r"), capacity=64, create=True)
+        sidecar = RingSidecar(ring, plan, {}, max_batch=16,
+                              services=["api", "hostsvc", "web"])
+        t = threading.Thread(target=sidecar.run, daemon=True)
+        t.start()
+        try:
+            t_api = ring.enqueue(path=b"/api/v1", url=b"/api/v1",
+                                 host=b"x.test", user_agent=b"ua")
+            t_host = ring.enqueue(path=b"/p", url=b"/p",
+                                  host=b"hosted.test", user_agent=b"ua")
+            t_web = ring.enqueue(path=b"/p", url=b"/p",
+                                 host=b"x.test", user_agent=b"ua")
+            t_evil = ring.enqueue(path=b"/evil", url=b"/evil",
+                                  host=b"x.test", user_agent=b"ua")
+            (got,) = self._drain([ring], [4])
+            assert (got[t_api] >> 3) & 31 == 0, got
+            assert (got[t_host] >> 3) & 31 == 1, got
+            assert (got[t_web] >> 3) & 31 == 2, got
+            # blocked AND routed (native plane needs both bits)
+            assert got[t_evil] & 3 == 1 and (got[t_evil] >> 3) & 31 == 2
+        finally:
+            sidecar.stop()
+            t.join(timeout=10)
+            ring.close()
+
+    def test_ring_services_per_listener_orders(self, tmp_path):
+        """ring_services= mode: two rings with DIFFERENT service orders
+        route the same request against their OWN listener's table."""
+        import threading
+
+        from pingoo_tpu.expr import compile_expression
+
+        routes = [
+            ("api", compile_expression(
+                'http_request.path.starts_with("/api")')),
+            ("web", None),
+        ]
+        plan = self._plan(routes)
+        rings = [Ring(str(tmp_path / f"r{i}"), capacity=64, create=True)
+                 for i in range(3)]
+        # ring0: [api, web]; ring1: [web] only; ring2: no routing
+        sidecar = RingSidecar(
+            rings, plan, {}, max_batch=16,
+            ring_services=[["api", "web"], ["web"], None])
+        t = threading.Thread(target=sidecar.run, daemon=True)
+        t.start()
+        try:
+            tk0 = rings[0].enqueue(path=b"/api/x", url=b"/api/x",
+                                   host=b"h", user_agent=b"ua")
+            tk1 = rings[1].enqueue(path=b"/api/x", url=b"/api/x",
+                                   host=b"h", user_agent=b"ua")
+            tk2 = rings[2].enqueue(path=b"/api/x", url=b"/api/x",
+                                   host=b"h", user_agent=b"ua")
+            g0, g1, g2 = self._drain(rings, [1, 1, 1])
+            assert (g0[tk0] >> 3) & 31 == 0  # api is order 0 on ring0
+            assert (g1[tk1] >> 3) & 31 == 0  # web is order 0 on ring1
+            assert (g2[tk2] >> 3) & 31 == 0  # no group: bits unset
+            # same path, ring1 has no api service: routed to web, not
+            # ring0's api order — the per-listener property itself.
+        finally:
+            sidecar.stop()
+            t.join(timeout=10)
+            for ring in rings:
+                ring.close()
+
+    def test_overflow_row_routes_in_ring_group_order(self, tmp_path):
+        """A spilled (>2048B) row must route via the host oracle against
+        ITS ring's service order, not a global one."""
+        import threading
+
+        from pingoo_tpu.expr import compile_expression
+
+        routes = [
+            ("deep", compile_expression(
+                'http_request.url.contains("NEEDLE")')),
+            ("other", compile_expression(
+                'http_request.host == "other.test"')),
+        ]
+        plan = self._plan(routes)
+        rings = [Ring(str(tmp_path / f"r{i}"), capacity=64, create=True)
+                 for i in range(2)]
+        sidecar = RingSidecar(
+            rings, plan, {}, max_batch=16,
+            ring_services=[["deep", "other"], ["other", "deep"]])
+        t = threading.Thread(target=sidecar.run, daemon=True)
+        t.start()
+        try:
+            deep = b"/" + b"a" * 3000 + b"NEEDLE"
+            tk0 = rings[0].enqueue(path=deep, url=deep, host=b"h",
+                                   user_agent=b"ua")
+            tk1 = rings[1].enqueue(path=deep, url=deep, host=b"h",
+                                   user_agent=b"ua")
+            g0, g1 = self._drain(rings, [1, 1])
+            assert (g0[tk0] >> 3) & 31 == 0  # deep at order 0 on ring0
+            assert (g1[tk1] >> 3) & 31 == 1  # deep at order 1 on ring1
+            assert sidecar.spilled_rows == 2
+        finally:
+            sidecar.stop()
+            t.join(timeout=10)
+            for ring in rings:
+                ring.close()
