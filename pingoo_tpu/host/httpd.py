@@ -479,23 +479,25 @@ class HttpListener:
         self.stats.requests += 1
         client_ip, client_port = str(peer[0]), int(peer[1])
         trusted = self.trust_xff
+        token = None
+        for name, value in req.headers:
+            if name.lower() == "x-pingoo-internal":
+                token = value
+                break
         if self.xff_token is not None:
             import hmac as _hmac
 
-            token = None
-            for name, value in req.headers:
-                if name.lower() == "x-pingoo-internal":
-                    token = value
-                    break
             # bytes compare: compare_digest raises TypeError on
             # non-ASCII str input, and the header is attacker-supplied.
             trusted = token is not None and _hmac.compare_digest(
                 token.encode("latin-1", "replace"),
                 self.xff_token.encode("latin-1", "replace"))
-        # The token header never travels further (rules context,
-        # upstream hops): strip it regardless of validity.
-        req.headers = [(n, v) for n, v in req.headers
-                       if n.lower() != "x-pingoo-internal"]
+        if token is not None:
+            # The token header never travels further (rules context,
+            # upstream hops): strip it regardless of validity. Skipped
+            # entirely on the common no-token request.
+            req.headers = [(n, v) for n, v in req.headers
+                           if n.lower() != "x-pingoo-internal"]
         if trusted:
             for name, value in req.headers:
                 if name.lower() == "x-forwarded-for":

@@ -29,9 +29,9 @@ This process runs:
     C++ plane hot-reloads on mtime change;
   * child lifecycle: SIGTERM to each httpd starts its graceful drain.
 
-Constraint: every HTTP listener must carry the same service ORDER (the
-verdict byte's 5-bit route field indexes one global ordering); configs
-that violate this are rejected at startup rather than mis-routed.
+Each HTTP listener gets its OWN routing table + route lane (the
+reference binds a service list per listener, config.rs:241-253);
+TCP(+TLS) listeners are fronted by the same binary in --tcp-proxy mode.
 """
 
 from __future__ import annotations
@@ -314,27 +314,28 @@ class NativePlane:
         svc = next(s for s in self.config.services if s.name == name)
         return svc.tcp_proxy is None
 
-    def _loopback_target(self, name: str) -> tuple:
-        # Only HTTP listeners have loopback rebinds — a service that
-        # ALSO appears in an earlier tcp listener must not index
-        # _loopback_ports with the tcp listener's name (KeyError).
+    def _loopback_target(self, lname: str) -> tuple:
+        """The loopback control-plane hop for LISTENER lname — the
+        fallback must land on the listener's OWN rebased Python
+        listener (its route set), never another listener's."""
         from ..native_ring import INTERNAL
 
-        listener = next(l for l in self.config.listeners
-                        if l.protocol.is_http and name in l.services)
-        return ("127.0.0.1", self._loopback_ports[listener.name], INTERNAL)
+        return ("127.0.0.1", self._loopback_ports[lname], INTERNAL)
 
-    def _service_upstreams(self, name: str) -> list:
-        """One service's publishable upstream entries. Plain AND TLS
-        upstreams are published natively (the C++ connector dials TLS
-        targets with SNI + verification, httpd.cc up_tls_begin);
-        targets the native connector cannot speak to — static sites,
-        h2:// prior-knowledge upstreams — route to the loopback Python
-        plane, which serves / proxies them with full policy; upstreams
-        whose address cannot resolve are skipped."""
+    def _service_upstreams(self, name: str) -> tuple:
+        """One service's publishable (upstreams, static_root,
+        needs_loopback). Plain, TLS and h2 upstreams are published
+        natively; static services publish their root for in-binary
+        serving of <=500KB files with the loopback Python plane as the
+        streaming fallback for bigger ones; upstreams whose address
+        cannot resolve are skipped (the loopback plane can still proxy
+        them). The loopback entry itself is appended PER LISTENER by
+        _write_services — each listener's fallback must be its own
+        rebased Python listener."""
         svc = next(s for s in self.config.services if s.name == name)
         ups: list = []
         via_python = False
+        static_root = None
         if svc.tcp_proxy is not None:
             # Raw TCP: no Python-plane fallback exists (and none is
             # needed — there is no verdict path to fail open from).
@@ -348,16 +349,19 @@ class NativePlane:
                 except OSError:
                     continue
                 ups.append((addr, u.port))
-            return ups
+            return ups, None, False
         if svc.static is not None:
-            via_python = True  # served by the Python plane
+            root = svc.static.root
+            if root and len(root) <= 383 and not any(
+                    ch.isspace() for ch in root):
+                static_root = root
+            # the loopback plane streams >500KB files (and serves
+            # everything when the root cannot be published)
+            via_python = True
         else:
+            from ..native_ring import H2
+
             for u in self.server.registry.get_upstreams(name):
-                if u.h2:
-                    # h2:// prior-knowledge framing is a Python-
-                    # plane capability for now.
-                    via_python = True
-                    continue
                 addr = u.ip or u.hostname
                 try:
                     addr = socket.gethostbyname(addr)
@@ -368,16 +372,19 @@ class NativePlane:
                     # plane instead of publishing a dead service.
                     via_python = True
                     continue
-                if u.tls:
+                if u.h2:
+                    # h2:// prior-knowledge: the C++ connector frames
+                    # requests over an nghttp2 client session (round 5;
+                    # TLS upstreams negotiate h2 via ALPN instead).
+                    ups.append((addr, u.port, H2))
+                elif u.tls:
                     # Verify against the configured name when there
                     # is one; a literal-address upstream pins the
                     # address itself (IP SAN).
                     ups.append((addr, u.port, u.hostname or addr))
                 else:
                     ups.append((addr, u.port))
-        if via_python:
-            ups.append(self._loopback_target(name))
-        return ups
+        return ups, static_root, via_python
 
     def _write_services(self) -> None:
         """Snapshot the registry into each listener's OWN routing table
@@ -391,8 +398,13 @@ class NativePlane:
                     for names in self._listener_services.values()
                     for name in names}
         for lname, names in self._listener_services.items():
-            write_services_file(self.services_paths[lname],
-                                [(n, resolved[n]) for n in names])
+            table = []
+            for n in names:
+                ups, static_root, needs_loopback = resolved[n]
+                if needs_loopback and lname in self._loopback_ports:
+                    ups = ups + [self._loopback_target(lname)]
+                table.append((n, ups, static_root))
+            write_services_file(self.services_paths[lname], table)
 
     async def _republish_loop(self) -> None:
         last = None

@@ -1,0 +1,106 @@
+// Canned-verdict drain: the NATIVE stand-in for the TPU sidecar in the
+// dataplane bench (bench.py bench_dataplane; VERDICT r3 item 5 / r4
+// item 6). Dequeues request batches from N worker rings, decides
+// block/none with a memmem scan over the url bytes (matching
+// loadgen_http's attack markers), and posts verdicts back batched —
+// the same transport path as native_ring.RingSidecar with the device
+// verdict replaced by a content check, so `dataplane_req_per_s`
+// measures the C++ plane + ring, not a Python drain thread sharing the
+// core.
+//
+// usage: drain <ring-file> [<ring-file> ...]
+// Prints "draining <n>" once attached; exits on SIGTERM/SIGINT after a
+// final JSON stats line on stdout.
+
+#include <fcntl.h>
+#include <signal.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+#include <vector>
+
+#include "pingoo_ring.h"
+
+static volatile sig_atomic_t g_stop = 0;
+static void on_sig(int) { g_stop = 1; }
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <ring-file> [...]\n", argv[0]);
+    return 2;
+  }
+  signal(SIGTERM, on_sig);
+  signal(SIGINT, on_sig);
+
+  std::vector<void*> rings;
+  uint32_t cap_max = 0;
+  for (int i = 1; i < argc; ++i) {
+    int fd = open(argv[i], O_RDWR);
+    if (fd < 0) {
+      std::fprintf(stderr, "cannot open %s\n", argv[i]);
+      return 1;
+    }
+    struct stat st;
+    fstat(fd, &st);
+    void* mem = mmap(nullptr, static_cast<size_t>(st.st_size),
+                     PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+    close(fd);
+    uint32_t cap = 0;
+    if (mem == MAP_FAILED || pingoo_ring_attach(mem, &cap) != 0) {
+      std::fprintf(stderr, "bad ring %s\n", argv[i]);
+      return 1;
+    }
+    if (cap > cap_max) cap_max = cap;
+    rings.push_back(mem);
+  }
+  std::printf("draining %zu\n", rings.size());
+  std::fflush(stdout);
+
+  std::vector<PingooRequestSlot> slots(cap_max);
+  std::vector<uint64_t> tickets(cap_max);
+  std::vector<uint8_t> actions(cap_max);
+  static const char* kMarkers[] = {"<script", "eval("};
+  unsigned long long drained = 0, blocked = 0;
+
+  while (!g_stop) {
+    uint32_t total = 0;
+    for (void* ring : rings) {
+      uint32_t n = pingoo_ring_dequeue_requests(ring, slots.data(), 2048);
+      if (n == 0) continue;
+      total += n;
+      for (uint32_t j = 0; j < n; ++j) {
+        const PingooRequestSlot& s = slots[j];
+        tickets[j] = s.ticket;
+        uint8_t act = 0;
+        for (const char* m : kMarkers) {
+          if (memmem(s.url, s.url_len, m, strlen(m)) != nullptr) {
+            act = 1;
+            break;
+          }
+        }
+        actions[j] = act;
+        blocked += act;
+      }
+      uint32_t done = 0;
+      while (done < n && !g_stop) {
+        done += pingoo_ring_post_verdicts(ring, tickets.data() + done,
+                                          actions.data() + done, n - done);
+        if (done < n) {
+          struct timespec ts {0, 200000};  // 200 us: verdict ring full
+          nanosleep(&ts, nullptr);
+        }
+      }
+      drained += n;
+    }
+    if (total == 0) {
+      struct timespec ts {0, 200000};
+      nanosleep(&ts, nullptr);
+    }
+  }
+  std::printf("{\"drained\": %llu, \"blocked\": %llu}\n", drained, blocked);
+  return 0;
+}

@@ -79,6 +79,7 @@
 #include <vector>
 
 #include "nghttp2_shim.h"
+#include "up_h2_link.h"
 #include "ossl_shim.h"
 #include "pingoo_ring.h"
 
@@ -628,6 +629,7 @@ struct Parsed {
 struct UpTarget {
   sockaddr_in sa{};
   bool tls = false;
+  bool h2 = false;        // cleartext prior-knowledge h2 upstream (h2://)
   bool internal = false;  // the loopback control plane: identity headers
                           // (x-pingoo-internal) may be sent to it
   std::string sni;
@@ -647,6 +649,10 @@ struct H2Stream {
   bool up_connected = false;
   bool up_eof = false;
   bool up_trunc = false;        // upstream ended with an ERROR, not clean EOF
+  UpH2Link* up_h2 = nullptr;    // non-null: upstream link speaks h2
+  std::string up_head;          // synthesized h1 head (until ALPN decides)
+  std::string up_body;          // buffered request body for an h2 link
+  bool up_proto_pending = false;
   bool up_pooled = false;
   uint64_t up_key = 0;
   UpTarget up_target{};
@@ -1038,6 +1044,12 @@ struct Conn {
   bool up_trunc = false;        // upstream ended with an ERROR, not clean EOF
   int tcp_attempts = 0;         // tcp-proxy mode: connect tries so far
   time_t tcp_connect_at = 0;    // tcp-proxy mode: when this try started
+  bool down_shut = false;       // write side toward the CLIENT shut
+                                // (tcp mode: upstream FIN propagated)
+  UpH2Link* up_h2 = nullptr;    // non-null: upstream link speaks h2
+  std::string up_head;          // rewritten h1 head (kept until the
+                                // upstream protocol is decided by ALPN)
+  bool up_proto_pending = false;  // TLS target: h1-vs-h2 awaits ALPN
   uint64_t up_key = 0;          // pool key of the connected target
   UpTarget up_target{};         // connected target (pooled-retry)
   SSL* up_ssl = nullptr;        // non-null on TLS upstream links
@@ -1125,6 +1137,7 @@ struct ServiceTable {
   std::string path;
   std::vector<std::string> names;
   std::vector<std::vector<UpTarget>> upstreams;  // by service order
+  std::vector<std::string> static_roots;  // "" = not a static service
   bool loaded = false;
   time_t last_check_ = 0;
   time_t mtime_s_ = 0;
@@ -1140,6 +1153,7 @@ struct ServiceTable {
     if (f == nullptr) return loaded;
     std::vector<std::string> new_names;
     std::vector<std::vector<UpTarget>> new_ups;
+    std::vector<std::string> new_static;
     char line[512];
     bool ok = true;
     while (fgets(line, sizeof(line), f) != nullptr) {
@@ -1154,6 +1168,18 @@ struct ServiceTable {
         }
         new_names.emplace_back(a);
         new_ups.emplace_back();
+        new_static.emplace_back();
+      } else if (char sroot[384];
+                 sscanf(line, "static %383s", sroot) == 1) {
+        // Static site root for the CURRENT service (reference
+        // http_static_site_service.rs): files <= 500 KB are served
+        // from this binary; bigger ones proxy to the service's
+        // upstream list (the streaming control plane).
+        if (new_static.empty()) {
+          ok = false;
+          break;
+        }
+        new_static.back() = sroot;
       } else if (int consumed = 0;
                  sscanf(line, "upstream %255s %d%n", b, &port,
                         &consumed) == 2) {
@@ -1189,6 +1215,16 @@ struct ServiceTable {
             ok = false;
             break;
           }
+        } else if (strncmp(rest, "h2", 2) == 0 &&
+                   (rest[2] == '\0' || rest[2] == '\n' || rest[2] == '\r' ||
+                    rest[2] == ' ' || rest[2] == '\t')) {
+          const char* tail = rest + 2;
+          while (*tail == ' ' || *tail == '\t') tail++;
+          if (*tail != '\0' && *tail != '\n' && *tail != '\r') {
+            ok = false;  // fields past the marker: version skew
+            break;
+          }
+          t.h2 = true;  // cleartext prior-knowledge h2 target
         } else if (strncmp(rest, "internal", 8) == 0 &&
                    (rest[8] == '\0' || rest[8] == '\n' || rest[8] == '\r' ||
                     rest[8] == ' ' || rest[8] == '\t')) {
@@ -1211,6 +1247,7 @@ struct ServiceTable {
     if (!ok || new_names.empty()) return loaded;  // keep last good table
     names = std::move(new_names);
     upstreams = std::move(new_ups);
+    static_roots = std::move(new_static);
     loaded = true;
     mtime_s_ = st.st_mtime;
     mtime_ns_ = st.st_mtim.tv_nsec;
@@ -1287,7 +1324,277 @@ class Server {
     return false;
   }
 
+  // -- native static site serving -------------------------------------------
+  // Reference http_static_site_service.rs:83-257: GET/HEAD only (405),
+  // traversal guard (404), dir -> index.html, extensionless -> .html
+  // prettify, ETag = SHA256(path, size, mtime_ns) with If-None-Match
+  // -> 304, <= 500 KB files cached (500 entries); larger files proxy
+  // to the service's upstream list (the control plane streams them —
+  // the one delta from the reference, which streams in-binary).
+
+  struct StaticFile {
+    uint64_t size = 0;
+    uint64_t mtime_ns = 0;
+    std::string data;
+  };
+  static constexpr uint64_t kStaticCacheFileLimit = 500000;  // 500 KB
+  static constexpr size_t kStaticCacheEntries = 500;
+
+  static const char* mime_for(const std::string& path) {
+    size_t dot = path.rfind('.');
+    std::string ext = dot == std::string::npos ? "" : path.substr(dot + 1);
+    for (auto& ch : ext) ch = static_cast<char>(tolower(ch));
+    if (ext == "html" || ext == "htm") return "text/html";
+    if (ext == "css") return "text/css";
+    if (ext == "js" || ext == "mjs") return "text/javascript";
+    if (ext == "json") return "application/json";
+    if (ext == "png") return "image/png";
+    if (ext == "jpg" || ext == "jpeg") return "image/jpeg";
+    if (ext == "gif") return "image/gif";
+    if (ext == "svg") return "image/svg+xml";
+    if (ext == "webp") return "image/webp";
+    if (ext == "ico") return "image/vnd.microsoft.icon";
+    if (ext == "txt") return "text/plain";
+    if (ext == "xml") return "application/xml";
+    if (ext == "pdf") return "application/pdf";
+    if (ext == "wasm") return "application/wasm";
+    if (ext == "woff2") return "font/woff2";
+    if (ext == "woff") return "font/woff";
+    if (ext == "mp4") return "video/mp4";
+    return "application/octet-stream";
+  }
+
+  struct StaticResult {
+    int status = 0;         // 200 / 304 / 404 / 405 / 500
+    bool oversized = false;  // caller proxies to the upstream list
+    std::string body;
+    std::string headers;     // extra response header lines
+  };
+
+  StaticResult static_lookup(const std::string& root,
+                             const std::string& method,
+                             const std::string& target,
+                             const std::string& if_none_match) {
+    StaticResult out;
+    if (method != "GET" && method != "HEAD") {
+      out.status = 405;
+      out.body = "Method Not Allowed";
+      out.headers = "content-type: text/plain\r\n";
+      return out;
+    }
+    std::string path = target.substr(0, target.find('?'));
+    // trim leading/trailing '/' like the reference, then guard
+    size_t b = path.find_first_not_of('/');
+    size_t e = path.find_last_not_of('/');
+    path = b == std::string::npos ? "" : path.substr(b, e - b + 1);
+    if (path.find("/..") != std::string::npos ||
+        path.find("../") != std::string::npos || path == ".." ||
+        path.find("//") != std::string::npos) {
+      out.status = 404;
+      out.body = "Not Found";
+      out.headers = "content-type: text/plain\r\n";
+      return out;
+    }
+    std::string full = root + "/" + path;
+    struct stat st;
+    if (stat(full.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) {
+      full += path.empty() ? "index.html" : "/index.html";
+      if (stat(full.c_str(), &st) != 0 || S_ISDIR(st.st_mode)) {
+        out.status = 404;
+        out.body = "Not Found";
+        out.headers = "content-type: text/plain\r\n";
+        return out;
+      }
+    } else if (stat(full.c_str(), &st) != 0) {
+      // prettify: extensionless /page -> /page.html
+      size_t slash = full.rfind('/');
+      if (full.find('.', slash + 1) != std::string::npos) {
+        out.status = 404;
+        out.body = "Not Found";
+        out.headers = "content-type: text/plain\r\n";
+        return out;
+      }
+      full += ".html";
+      if (stat(full.c_str(), &st) != 0 || S_ISDIR(st.st_mode)) {
+        out.status = 404;
+        out.body = "Not Found";
+        out.headers = "content-type: text/plain\r\n";
+        return out;
+      }
+    }
+    uint64_t size = static_cast<uint64_t>(st.st_size);
+    uint64_t mtime_ns = static_cast<uint64_t>(st.st_mtim.tv_sec) *
+                            1000000000ull +
+                        static_cast<uint64_t>(st.st_mtim.tv_nsec);
+    // ETag = sha256(path, size_le, mtime_le) (reference :150-160)
+    unsigned char md[32];
+    unsigned int mdlen = 0;
+    std::string etag_src = full;
+    etag_src.append(reinterpret_cast<const char*>(&size), 8);
+    etag_src.append(reinterpret_cast<const char*>(&mtime_ns), 8);
+    EVP_Digest(etag_src.data(), etag_src.size(), md, &mdlen, EVP_sha256(),
+               nullptr);
+    static const char hexd[] = "0123456789abcdef";
+    std::string etag = "\"";
+    for (unsigned i = 0; i < mdlen; ++i) {
+      etag += hexd[md[i] >> 4];
+      etag += hexd[md[i] & 15];
+    }
+    etag += "\"";
+    std::string base_headers = std::string("content-type: ") +
+                               mime_for(full) + "\r\n" +
+                               "cache-control: public, max-age=0, "
+                               "must-revalidate\r\n" +
+                               "etag: " + etag + "\r\n";
+    // If-None-Match (W/ prefix + quotes stripped, reference :161-183)
+    std::string inm = if_none_match;
+    size_t s0 = inm.find_first_not_of(" \t");
+    if (s0 != std::string::npos) inm = inm.substr(s0);
+    if (inm.compare(0, 2, "W/") == 0) inm = inm.substr(2);
+    while (!inm.empty() && (inm.front() == '"')) inm.erase(0, 1);
+    while (!inm.empty() && (inm.back() == '"' || inm.back() == ' '))
+      inm.pop_back();
+    if (!inm.empty() && etag == "\"" + inm + "\"") {
+      out.status = 304;
+      out.headers = base_headers;
+      return out;
+    }
+    if (size > kStaticCacheFileLimit) {
+      out.oversized = true;  // control plane streams it
+      return out;
+    }
+    auto it = file_cache_.find(full);
+    if (it != file_cache_.end() && it->second.size == size &&
+        it->second.mtime_ns == mtime_ns) {
+      out.status = 200;
+      out.body = it->second.data;
+      out.headers = base_headers;
+      return out;
+    }
+    FILE* f = fopen(full.c_str(), "rb");
+    if (f == nullptr) {
+      out.status = 500;
+      out.body = "Internal Server Error";
+      out.headers = "content-type: text/plain\r\n";
+      return out;
+    }
+    std::string data;
+    data.resize(size);
+    size_t got = fread(data.data(), 1, size, f);
+    fclose(f);
+    data.resize(got);
+    if (file_cache_.size() >= kStaticCacheEntries)
+      file_cache_.erase(file_cache_.begin());
+    file_cache_[full] = StaticFile{size, mtime_ns, data};
+    out.status = 200;
+    out.body = std::move(data);
+    out.headers = base_headers;
+    return out;
+  }
+
+  // Generic keep-alive-aware h1 response for natively served content.
+  void respond_h1(Conn* c, int status, const char* reason,
+                  const std::string& extra_headers, const std::string& body,
+                  bool head_only) {
+    bool keep = c->req.keep_alive && c->req_body.done;
+    c->outbuf += "HTTP/1.1 " + std::to_string(status) + " " + reason +
+                 "\r\nserver: pingoo\r\ncontent-length: " +
+                 std::to_string(body.size()) + "\r\n" + extra_headers +
+                 (keep ? "connection: keep-alive\r\n\r\n"
+                       : "connection: close\r\n\r\n");
+    if (!head_only) c->outbuf += body;
+    if (!flush_out(c)) {
+      mark_close(c);
+      return;
+    }
+    if (!keep) {
+      c->state = ConnState::kClosing;
+      if (c->outbuf.empty()) mark_close(c);
+      else update_client_events(c);
+      return;
+    }
+    begin_request_cycle(c);
+  }
+
+  static const char* reason_for(int status) {
+    switch (status) {
+      case 200: return "OK";
+      case 304: return "Not Modified";
+      case 404: return "Not Found";
+      case 405: return "Method Not Allowed";
+      default: return "Internal Server Error";
+    }
+  }
+
+  // True when the request was fully answered natively; false -> the
+  // caller proxies to the service's upstream list (oversized file).
+  bool try_static_h1(Conn* c, const std::string& root) {
+    std::string inm;
+    const std::string& head = c->req.raw_head;
+    size_t pos = head.find("\r\n");
+    pos = pos == std::string::npos ? head.size() : pos + 2;
+    while (pos < head.size()) {
+      size_t eol = head.find("\r\n", pos);
+      if (eol == std::string::npos || eol == pos) break;
+      size_t colon = head.find(':', pos);
+      if (colon != std::string::npos && colon < eol) {
+        std::string nm = lower(head.substr(pos, colon - pos));
+        if (nm == "if-none-match") {
+          size_t vs = colon + 1;
+          while (vs < eol && head[vs] == ' ') vs++;
+          inm = head.substr(vs, eol - vs);
+          break;
+        }
+      }
+      pos = eol + 2;
+    }
+    StaticResult r = static_lookup(root, c->req.method, c->req.target, inm);
+    if (r.oversized) return false;
+    respond_h1(c, r.status, reason_for(r.status), r.headers, r.body,
+               c->req.method == "HEAD" || r.status == 304);
+    return true;
+  }
+
+  bool try_static_h2(Conn* c, int32_t sid, H2Stream& st,
+                     const std::string& root) {
+    std::string inm;
+    for (const auto& kv : st.p.h2_headers) {
+      if (kv.first == "if-none-match") {
+        inm = kv.second;
+        break;
+      }
+    }
+    StaticResult r = static_lookup(root, st.p.method, st.p.target, inm);
+    if (r.oversized) return false;
+    std::vector<std::pair<std::string, std::string>> headers;
+    size_t pos = 0;
+    while (pos < r.headers.size()) {
+      size_t eol = r.headers.find("\r\n", pos);
+      if (eol == std::string::npos) break;
+      size_t colon = r.headers.find(':', pos);
+      if (colon != std::string::npos && colon < eol) {
+        size_t vs = colon + 1;
+        while (vs < eol && r.headers[vs] == ' ') vs++;
+        headers.emplace_back(r.headers.substr(pos, colon - pos),
+                             r.headers.substr(vs, eol - vs));
+      }
+      pos = eol + 2;
+    }
+    bool head_only = st.p.method == "HEAD" || r.status == 304;
+    h2_submit(c, sid, r.status, headers,
+              head_only ? std::string() : r.body,
+              head_only ? static_cast<long long>(r.body.size()) : -1);
+    h2_process_next(c);
+    return true;
+  }
+
   void dispatch_route(Conn* c, uint8_t route) {
+    if (services_ != nullptr && services_->loaded &&
+        route < services_->static_roots.size() &&
+        !services_->static_roots[route].empty()) {
+      if (try_static_h1(c, services_->static_roots[route])) return;
+      // oversized file: fall through to the service's upstream list
+    }
     UpTarget target;
     switch (pick_route_target(route, &target)) {
       case Route::kOk:
@@ -1305,6 +1612,15 @@ class Server {
   }
 
   void h2_dispatch_route(Conn* c, int32_t sid, uint8_t route) {
+    if (services_ != nullptr && services_->loaded &&
+        route < services_->static_roots.size() &&
+        !services_->static_roots[route].empty()) {
+      auto it = c->h2_streams.find(sid);
+      if (it != c->h2_streams.end() &&
+          try_static_h2(c, sid, it->second,
+                        services_->static_roots[route]))
+        return;
+    }
     UpTarget target;
     switch (pick_route_target(route, &target)) {
       case Route::kOk:
@@ -1818,6 +2134,10 @@ class Server {
   }
 
   void close_upstream(Conn* c) {
+    if (c->up_h2 != nullptr) {
+      delete c->up_h2;
+      c->up_h2 = nullptr;
+    }
     if (c->up_ssl != nullptr) {
       SSL_shutdown(c->up_ssl);  // best-effort close_notify (nonblocking)
       SSL_free(c->up_ssl);
@@ -1833,6 +2153,8 @@ class Server {
   }
 
   void reset_up_link(Conn* c) {
+    c->up_proto_pending = false;
+    c->up_head.clear();
     c->upstream_connected = false;
     c->upstream_eof = false;
     c->up_trunc = false;
@@ -1851,7 +2173,8 @@ class Server {
   static constexpr ssize_t kIoAgain = -1;  // would block (want flags set)
   static constexpr ssize_t kIoErr = -2;    // fatal transport error
 
-  bool up_tls_begin(const UpTarget& t, int fd, SSL** out) {
+  bool up_tls_begin(const UpTarget& t, int fd, SSL** out,
+                    bool offer_h2 = true) {
     if (up_ctx_ == nullptr) return false;
     SSL* ssl = SSL_new(up_ctx_);
     if (ssl == nullptr) return false;
@@ -1874,6 +2197,15 @@ class Server {
       SSL_free(ssl);
       ERR_clear_error();
       return false;
+    }
+    if (!tcp_mode_ && offer_h2) {
+      // Offer h2 like the reference's hyper-rustls client
+      // (http_proxy_service.rs:54-71); the upstream picks. tcp mode
+      // splices raw bytes, where ALPN is not ours to negotiate, and
+      // upgrade (WebSocket) requests must stay h1 — a 101 tunnel
+      // cannot ride an h2 hop, so the caller pins h1 for those.
+      static const unsigned char kAlpn[] = "\x02h2\x08http/1.1";
+      SSL_set_alpn_protos(ssl, kAlpn, sizeof(kAlpn) - 1);
     }
     *out = ssl;
     return true;
@@ -2015,6 +2347,7 @@ class Server {
     SSL* ssl;  // non-null: an established TLS client session
     std::string sni;  // the name the session was verified for
     time_t since;
+    UpH2Link* h2link = nullptr;  // non-null: an established h2 session
   };
   static constexpr size_t kPoolPerTarget = 256;
   static constexpr time_t kPoolIdleS = 30;
@@ -2026,7 +2359,41 @@ class Server {
       key |= 1ULL << 63;
       key ^= std::hash<std::string>{}(t.sni) & 0x7FFF000000000000ULL;
     }
+    if (t.h2) key |= 1ULL << 62;  // h1 and h2:// pools must never mix:
+    // a pooled h1 keep-alive socket handed to an h2 request would get
+    // a client preface mid-session (and vice versa)
     return key;
+  }
+
+  // Drain whatever session frames an idle pooled h2 connection has
+  // pending (PING, SETTINGS, GOAWAY) through its nghttp2 session.
+  // Returns false when the session is no longer usable.
+  static bool h2_pool_prefeed(PooledUpstream* pc) {
+    char buf[4096];
+    std::string sink;  // no stream is open: nothing synthesizes
+    for (;;) {
+      ssize_t r;
+      if (pc->ssl != nullptr) {
+        ERR_clear_error();
+        int rr = SSL_read(pc->ssl, buf, sizeof(buf));
+        if (rr <= 0) {
+          int e = SSL_get_error(pc->ssl, rr);
+          if (e == SSL_ERROR_WANT_READ) break;  // drained
+          return false;  // close_notify / FIN / error
+        }
+        r = rr;
+      } else {
+        r = recv(pc->fd, buf, sizeof(buf), MSG_DONTWAIT);
+        if (r == 0) return false;
+        if (r < 0) {
+          if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+          return false;
+        }
+      }
+      if (!pc->h2link->feed(buf, static_cast<size_t>(r), &sink))
+        return false;
+    }
+    return !pc->h2link->goaway && !pc->h2link->failed;
   }
 
   bool pop_pooled(const UpTarget& t, PooledUpstream* out) {
@@ -2049,27 +2416,41 @@ class Server {
       vec.erase(vec.begin() + pick);
       if (pc.ssl != nullptr) {
         // SSL_peek processes buffered records (quietly consuming
-        // TLS 1.3 session tickets): app data means a poisoned
-        // connection, WANT_READ means idle-and-alive.
+        // TLS 1.3 session tickets): on an h1 link app data means a
+        // poisoned connection; on an h2 link pending bytes are session
+        // frames — feed them through the session NOW so an idle-drain
+        // GOAWAY is detected here instead of 502ing the next request
+        // (the h1 path covers the same race with pooled replay, which
+        // h2 links do not carry).
         char probe;
         ERR_clear_error();
         int r = SSL_peek(pc.ssl, &probe, 1);
-        if (r <= 0 && SSL_get_error(pc.ssl, r) == SSL_ERROR_WANT_READ) {
+        bool alive =
+            r <= 0 && SSL_get_error(pc.ssl, r) == SSL_ERROR_WANT_READ;
+        if (!alive && r > 0 && pc.h2link != nullptr)
+          alive = h2_pool_prefeed(&pc);
+        ERR_clear_error();
+        if (alive) {
           *out = pc;
           return true;
         }
         SSL_free(pc.ssl);
         ERR_clear_error();
         close(pc.fd);
+        if (pc.h2link != nullptr) delete pc.h2link;
         continue;
       }
       char probe;
       ssize_t r = recv(pc.fd, &probe, 1, MSG_PEEK | MSG_DONTWAIT);
-      if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      bool alive = r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK);
+      if (!alive && r > 0 && pc.h2link != nullptr)
+        alive = h2_pool_prefeed(&pc);
+      if (alive) {
         *out = pc;
         return true;
       }
       close(pc.fd);  // closed by the server, or stray bytes: unusable
+      if (pc.h2link != nullptr) delete pc.h2link;
     }
     return false;
   }
@@ -2082,9 +2463,10 @@ class Server {
     }
     epoll_ctl(ep_, EPOLL_CTL_DEL, c->upstream_fd, nullptr);
     vec.push_back(PooledUpstream{c->upstream_fd, c->up_ssl,
-                                c->up_target.sni, now_});
+                                 c->up_target.sni, now_, c->up_h2});
     c->upstream_fd = -1;
     c->up_ssl = nullptr;
+    c->up_h2 = nullptr;  // session ownership moved into the pool entry
     reset_up_link(c);
   }
 
@@ -2100,11 +2482,67 @@ class Server {
             ERR_clear_error();
           }
           close(vec[i].fd);
+          if (vec[i].h2link != nullptr) delete vec[i].h2link;
         } else {
           vec[keep++] = vec[i];
         }
       }
       vec.resize(keep);
+    }
+  }
+
+  // Adopt (or create) an h2 session for this connection's upstream
+  // link and frame the rewritten request onto it.
+  bool begin_upstream_h2(Conn* c, UpH2Link* link) {
+    if (c->req.is_upgrade()) {
+      // Protocol upgrades (WebSocket) cannot ride an h2 upstream hop.
+      if (link != nullptr) delete link;
+      stats_.upstream_fail++;
+      close_upstream(c);
+      respond_close(c, k502);
+      return false;
+    }
+    if (link == nullptr) {
+      link = new UpH2Link();
+      if (!link->init()) {
+        delete link;
+        stats_.upstream_fail++;
+        close_upstream(c);
+        respond_close(c, k502);
+        return false;
+      }
+    } else {
+      link->reset_for_reuse();
+    }
+    c->up_h2 = link;
+    bool has_body = !c->req_body.done;
+    if (!link->submit(c->up_head, c->up_target.tls, has_body) ||
+        !link->pump_send(&c->upbuf)) {
+      stats_.upstream_fail++;
+      close_upstream(c);  // deletes the link
+      respond_close(c, k502);
+      return false;
+    }
+    // Pooled-retry replay is h1-shaped (raw byte replay); an h2 link
+    // would need a fresh stream submission instead — disabled.
+    c->up_replay.clear();
+    c->upstream_pooled = false;
+    return true;
+  }
+
+  void finish_upstream_send_setup(Conn* c) {
+    pump_request_body(c);
+    if (c->up_h2 == nullptr) {
+      // A POOLED connection can die between the liveness probe and our
+      // write (server idle-timeout race). Keep the sent bytes around
+      // so the request can be replayed once on a FRESH connection
+      // instead of surfacing a spurious 502 (the reference's pooled
+      // client retries the same way). Oversized bodies disable it.
+      c->up_replay = c->upbuf;
+      if (c->up_replay.size() > kMaxReplay) {
+        c->up_replay.clear();
+        c->upstream_pooled = false;
+      }
     }
   }
 
@@ -2118,6 +2556,13 @@ class Server {
     }
     PooledUpstream pc{-1, nullptr, std::string(), 0};
     bool pooled = pop_pooled(target, &pc);
+    if (pooled && pc.h2link != nullptr && c->req.is_upgrade()) {
+      // Upgrades must ride h1: hand the h2 session back and dial a
+      // fresh connection whose ALPN offer is pinned to http/1.1.
+      upstream_pool_[key].push_back(pc);
+      pooled = false;
+      pc = PooledUpstream{-1, nullptr, std::string(), 0};
+    }
     int ufd = pc.fd;
     if (!pooled) {
       ufd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK, 0);
@@ -2146,21 +2591,22 @@ class Server {
     c->last_active = now_;
 
     c->state = ConnState::kProxying;
-    // Rewritten head + whatever request-body bytes are buffered.
-    c->upbuf = rewrite_request_head(
+    c->up_head = rewrite_request_head(
         c->req, c->peer_ip, c->ssl != nullptr,
         target.internal ? internal_token_ : std::string());
-    pump_request_body(c);
-    // A POOLED connection can die between the liveness probe and our
-    // write (server idle-timeout race). Keep the sent bytes around so
-    // the request can be replayed once on a FRESH connection instead of
-    // surfacing a spurious 502 (the reference's pooled client retries
-    // the same way). Oversized bodies disable the retry.
-    c->up_replay = c->upbuf;
-    if (c->up_replay.size() > kMaxReplay) {
-      c->up_replay.clear();
-      c->upstream_pooled = false;
+    // Upstream protocol: h2 for table-marked h2:// targets and pooled
+    // h2 sessions; ALPN decides fresh TLS links after the handshake
+    // (reference hyper client, http_proxy_service.rs:54-71).
+    if (pooled && pc.h2link != nullptr) {
+      if (!begin_upstream_h2(c, pc.h2link)) return;
+    } else if (target.h2) {
+      if (!begin_upstream_h2(c, nullptr)) return;
+    } else if (target.tls && !pooled) {
+      c->up_proto_pending = true;  // decided at handshake completion
+    } else {
+      c->upbuf = c->up_head;
     }
+    if (!c->up_proto_pending) finish_upstream_send_setup(c);
 
     epoll_event ue{};
     ue.events = EPOLLOUT | EPOLLIN;
@@ -2198,26 +2644,45 @@ class Server {
         return;
       }
     }
-    // Client half-close: propagate FIN to the upstream once its bytes
-    // are through, but keep relaying the upstream->client direction
-    // (matches the Python plane, which waits for BOTH pumps).
-    if (c->client_eof && c->upbuf.empty() && !c->up_shut &&
-        c->upstream_fd >= 0) {
-      if (c->up_ssl != nullptr) SSL_shutdown(c->up_ssl);
-      shutdown(c->upstream_fd, SHUT_WR);
-      c->up_shut = true;
-    }
-    if (c->upstream_eof && c->outbuf.empty()) {
-      mark_close(c);
-      return;
-    }
+    // Half-close propagation in both directions (tcp mode closes only
+    // when both sides finished; WebSocket tunnels close as a unit).
+    tunnel_check_done(c);
+    if (c->dead) return;
     update_client_events(c);
     update_upstream_events(c);
   }
 
   // Move request-body bytes from inbuf into upbuf per the framer.
   void pump_request_body(Conn* c) {
+    if (c->up_proto_pending) return;  // body buffers raw in inbuf until
+                                      // ALPN picks the upstream framing
     if (c->req_body_forwarded) return;
+    if (c->up_h2 != nullptr) {
+      if (!c->inbuf.empty() && !c->req_body.done &&
+          c->up_h2->body.size() < kMaxBuffered) {
+        // The bound: nghttp2 flow control (64 KB windows) holds body
+        // bytes in the link, not upbuf, so the upbuf cap alone cannot
+        // backpressure a slow h2 upstream. Leaving bytes in inbuf
+        // engages the client-read gate (kProxying arms EPOLLIN only
+        // below the inbuf cap).
+        std::string payload;  // h2 DATA carries the DE-FRAMED body
+        size_t take =
+            c->req_body.consume(c->inbuf.data(), c->inbuf.size(), &payload);
+        if (!payload.empty())
+          c->up_h2->append_body(payload.data(), payload.size());
+        c->inbuf.erase(0, take);
+      }
+      if (c->req_body.bad) {
+        mark_close(c);
+        return;
+      }
+      if (c->req_body.done && !c->req_body_forwarded) {
+        c->req_body_forwarded = true;
+        c->up_h2->finish_body();
+      }
+      c->up_h2->pump_send(&c->upbuf);
+      return;
+    }
     if (!c->inbuf.empty() && !c->req_body.done) {
       size_t take = c->req_body.consume(c->inbuf.data(), c->inbuf.size());
       c->upbuf.append(c->inbuf, 0, take);
@@ -2667,6 +3132,11 @@ class Server {
   // -- per-stream upstream proxying (concurrent h2) --------------------------
 
   void h2_close_stream_upstream(Conn* c, H2Stream& st) {
+    if (st.up_h2 != nullptr) {
+      delete st.up_h2;
+      st.up_h2 = nullptr;
+    }
+    st.up_proto_pending = false;
     if (st.up_ssl != nullptr) {
       SSL_shutdown(st.up_ssl);
       SSL_free(st.up_ssl);
@@ -2712,13 +3182,17 @@ class Server {
                     // response over unsent body bytes would poison the
                     // pooled connection for its next user
                     st.up_key != 0 && st.up_fd >= 0 &&
+                    (st.up_h2 == nullptr ||
+                     (!st.up_h2->goaway && !st.up_h2->failed)) &&
                     upstream_pool_[st.up_key].size() < kPoolPerTarget;
     if (can_pool) {
       epoll_ctl(ep_, EPOLL_CTL_DEL, st.up_fd, nullptr);
       upstream_pool_[st.up_key].push_back(
-          PooledUpstream{st.up_fd, st.up_ssl, st.up_target.sni, now_});
+          PooledUpstream{st.up_fd, st.up_ssl, st.up_target.sni, now_,
+                         st.up_h2});
       st.up_fd = -1;
       st.up_ssl = nullptr;
+      st.up_h2 = nullptr;  // ownership moved into the pool entry
       c->h2_upstreams--;
       if (st.up_ref != nullptr) {
         st.up_ref->h2_sid = -1;
@@ -2757,6 +3231,40 @@ class Server {
     epoll_ctl(ep_, EPOLL_CTL_MOD, st.up_fd, &e);
   }
 
+  // Adopt (or create) an h2 session for one downstream stream's
+  // upstream link; the stream's request body is fully buffered.
+  bool h2_stream_begin_up_h2(Conn* c, int32_t sid, H2Stream& st,
+                             UpH2Link* link) {
+    if (link == nullptr) {
+      link = new UpH2Link();
+      if (!link->init()) {
+        delete link;
+        stats_.upstream_fail++;
+        h2_close_stream_upstream(c, st);
+        h2_respond_simple(c, sid, 502, "Bad Gateway");
+        return false;
+      }
+    } else {
+      link->reset_for_reuse();
+    }
+    st.up_h2 = link;
+    bool has_body = !st.up_body.empty();
+    bool ok = link->submit(st.up_head, st.up_target.tls, has_body);
+    if (ok && has_body) {
+      link->append_body(st.up_body.data(), st.up_body.size());
+    }
+    if (ok) link->finish_body();
+    if (!ok || !link->pump_send(&st.upbuf)) {
+      stats_.upstream_fail++;
+      h2_close_stream_upstream(c, st);  // deletes the link
+      h2_respond_simple(c, sid, 502, "Bad Gateway");
+      return false;
+    }
+    st.up_replay.clear();  // raw-byte replay is h1-shaped: disabled
+    st.up_pooled = false;
+    return true;
+  }
+
   void h2_start_stream_proxy(Conn* c, int32_t sid,
                              const UpTarget& target) {
     auto it = c->h2_streams.find(sid);
@@ -2793,6 +3301,9 @@ class Server {
       }
     }
     st.up_fd = ufd;
+    c->h2_upstreams++;  // before any failure path: h2_close_stream_
+    // upstream decrements whenever up_fd >= 0, so counting after a
+    // fallible step would underflow the cap counter
     st.up_key = key;
     st.up_target = target;
     st.up_pooled = pooled;
@@ -2813,14 +3324,32 @@ class Server {
     st.pending.clear();
     st.data_eof = false;
     st.submitted = false;
-    st.upbuf = h2_upstream_head(c, st);
-    st.up_replay = st.upbuf;
-    if (st.up_replay.size() > kMaxReplay) {
-      st.up_replay.clear();
-      st.up_pooled = false;
+    {
+      // Head and (fully buffered) body; the h2-upstream split keeps
+      // them separate so the link can frame DATA itself.
+      std::string headbody = h2_upstream_head(c, st);
+      size_t he = headbody.find("\r\n\r\n");
+      st.up_head = headbody.substr(0, he + 4);
+      st.up_body = headbody.substr(he + 4);
+    }
+    st.up_proto_pending = false;
+    if (pooled && pc.h2link != nullptr) {
+      if (!h2_stream_begin_up_h2(c, sid, st, pc.h2link)) return;
+    } else if (target.h2) {
+      if (!h2_stream_begin_up_h2(c, sid, st, nullptr)) return;
+    } else if (target.tls && !pooled) {
+      st.up_proto_pending = true;  // ALPN decides after the handshake
+    } else {
+      st.upbuf = st.up_head + st.up_body;
+    }
+    if (!st.up_proto_pending && st.up_h2 == nullptr) {
+      st.up_replay = st.upbuf;
+      if (st.up_replay.size() > kMaxReplay) {
+        st.up_replay.clear();
+        st.up_pooled = false;
+      }
     }
     st.up_ref = new SockRef{c, true, sid};
-    c->h2_upstreams++;
     epoll_event ue{};
     ue.events = EPOLLOUT | EPOLLIN;
     ue.data.ptr = st.up_ref;
@@ -3112,6 +3641,25 @@ class Server {
         }
         st.up_tls_hs = false;
         st.up_connected = true;
+        if (st.up_proto_pending) {
+          st.up_proto_pending = false;
+          const unsigned char* ap = nullptr;
+          unsigned aplen = 0;
+          SSL_get0_alpn_selected(st.up_ssl, &ap, &aplen);
+          if (aplen == 2 && memcmp(ap, "h2", 2) == 0) {
+            if (!h2_stream_begin_up_h2(c, sid, st, nullptr)) {
+              h2_flush(c);
+              return;
+            }
+          } else {
+            st.upbuf = st.up_head + st.up_body;
+            st.up_replay = st.upbuf;
+            if (st.up_replay.size() > kMaxReplay) {
+              st.up_replay.clear();
+              st.up_pooled = false;
+            }
+          }
+        }
       }
       if (!st.up_connected) return;  // TCP connect still pending
     }
@@ -3147,7 +3695,28 @@ class Server {
         st.up_rd_want_write = false;
         ssize_t r = up_recv_raw(st.up_fd, st.up_ssl, buf, sizeof(buf),
                                 &st.up_rd_want_write);
-        if (r > 0) {
+        if (r > 0 && st.up_h2 != nullptr) {
+          std::string synth;
+          if (!st.up_h2->feed(buf, static_cast<size_t>(r), &synth)) {
+            h2_close_stream_upstream(c, st);
+            if (!st.resp_head_done) {
+              stats_.upstream_fail++;
+              h2_respond_simple(c, sid, 502, "Bad Gateway");
+            } else {
+              h2_abort_stream(c, sid);
+            }
+            h2_process_next(c);
+            h2_flush(c);
+            return;
+          }
+          st.up_h2->pump_send(&st.upbuf);
+          if (!synth.empty() &&
+              !h2_stream_upstream_data(c, sid, st, synth.data(),
+                                       synth.size())) {
+            h2_flush(c);
+            return;  // stream aborted/serviced: st may be gone
+          }
+        } else if (r > 0) {
           if (!h2_stream_upstream_data(c, sid, st, buf,
                                        static_cast<size_t>(r))) {
             h2_flush(c);
@@ -3175,8 +3744,11 @@ class Server {
   void h2_submit(Conn* c, int32_t sid, int status,
                  const std::vector<std::pair<std::string, std::string>>&
                      headers,
-                 std::string body) {
-    long long content_length = static_cast<long long>(body.size());
+                 std::string body, long long content_length = -1) {
+    // content_length >= 0 overrides the body size: a HEAD response
+    // advertises the full entity size while sending no body.
+    if (content_length < 0)
+      content_length = static_cast<long long>(body.size());
     c->h2_send[sid] = {std::move(body), 0};
     nghttp2_data_provider prd{};
     prd.read_callback = h2_data_read;
@@ -3448,7 +4020,8 @@ class Server {
         }
         c->up_tcp_ok = true;
         if (c->up_target.tls) {
-          if (!up_tls_begin(c->up_target, c->upstream_fd, &c->up_ssl)) {
+          if (!up_tls_begin(c->up_target, c->upstream_fd, &c->up_ssl,
+                               !c->req.is_upgrade())) {
             close_upstream(c);
             respond_502(c);
             return;
@@ -3472,6 +4045,18 @@ class Server {
         }
         c->up_tls_hs = false;
         c->upstream_connected = true;
+        if (c->up_proto_pending) {
+          c->up_proto_pending = false;
+          const unsigned char* ap = nullptr;
+          unsigned aplen = 0;
+          SSL_get0_alpn_selected(c->up_ssl, &ap, &aplen);
+          if (aplen == 2 && memcmp(ap, "h2", 2) == 0) {
+            if (!begin_upstream_h2(c, nullptr)) return;
+          } else {
+            c->upbuf = c->up_head;
+          }
+          finish_upstream_send_setup(c);
+        }
       }
       if (!c->upstream_connected) return;  // TCP connect still pending
     }
@@ -3484,7 +4069,23 @@ class Server {
         c->up_rd_want_write = false;
         ssize_t r = up_recv_raw(c->upstream_fd, c->up_ssl, buf, sizeof(buf),
                                 &c->up_rd_want_write);
-        if (r > 0) {
+        if (r > 0 && c->up_h2 != nullptr) {
+          std::string synth;
+          if (!c->up_h2->feed(buf, static_cast<size_t>(r), &synth)) {
+            if (!c->resp_head_done) {
+              respond_502(c);
+            } else {
+              mark_close(c);
+            }
+            return;
+          }
+          if (!synth.empty()) {
+            on_upstream_data(c, synth.data(), synth.size());
+            if (c->dead || !proxy_live(c)) return;
+          }
+          // acks/window updates the session owes after the feed
+          c->up_h2->pump_send(&c->upbuf);
+        } else if (r > 0) {
           on_upstream_data(c, buf, static_cast<size_t>(r));
           if (c->dead || !proxy_live(c)) return;
         } else if (r == 0) {
@@ -3605,15 +4206,37 @@ class Server {
     if (c->resp_body.bad) mark_close(c);  // malformed upstream chunking
   }
 
+  // Tunnel teardown policy. WebSocket tunnels close as a unit once the
+  // upstream ends; raw TCP (tcp-proxy mode) propagates each side's FIN
+  // independently like the reference's copy_bidirectional
+  // (tcp_proxy_service.rs:74-82) and closes only when BOTH directions
+  // are finished.
+  void tunnel_check_done(Conn* c) {
+    if (c->client_eof && c->upbuf.empty() && !c->up_shut &&
+        c->upstream_fd >= 0) {
+      if (c->up_ssl != nullptr) SSL_shutdown(c->up_ssl);
+      shutdown(c->upstream_fd, SHUT_WR);
+      c->up_shut = true;
+    }
+    if (c->upstream_eof && c->outbuf.empty()) {
+      if (!tcp_mode_) {
+        mark_close(c);
+        return;
+      }
+      if (!c->down_shut) {
+        if (c->ssl != nullptr) SSL_shutdown(c->ssl);
+        shutdown(c->fd, SHUT_WR);
+        c->down_shut = true;
+      }
+      // half-open: keep relaying client -> upstream until the client
+      // finishes too (or the idle sweep reaps the connection)
+      if (c->client_eof && c->upbuf.empty()) mark_close(c);
+    }
+  }
+
   void maybe_finish_response(Conn* c) {
     if (c->state == ConnState::kTunnel) {
-      if (c->client_eof && c->upbuf.empty() && !c->up_shut &&
-          c->upstream_fd >= 0) {
-        if (c->up_ssl != nullptr) SSL_shutdown(c->up_ssl);
-        shutdown(c->upstream_fd, SHUT_WR);
-        c->up_shut = true;
-      }
-      if (c->upstream_eof && c->outbuf.empty()) mark_close(c);
+      tunnel_check_done(c);
       return;
     }
     if (c->state != ConnState::kProxying || !c->resp_head_done) {
@@ -3648,7 +4271,9 @@ class Server {
     // bytes past the response end, and the upstream allows keep-alive.
     if (c->resp_body.done && c->resp_body.mode != BodyFramer::kUntilEof &&
         !c->upstream_eof && c->upstream_keep && !c->upstream_junk &&
-        c->upbuf.empty() && c->req_body_forwarded) {
+        c->upbuf.empty() && c->req_body_forwarded &&
+        (c->up_h2 == nullptr ||
+         (!c->up_h2->goaway && !c->up_h2->failed))) {
       release_upstream(c);
     } else {
       close_upstream(c);
@@ -3732,11 +4357,17 @@ class Server {
         on_proxy_client_event(c, events);
         break;
       case ConnState::kTunnel:
-        if (events & (EPOLLHUP | EPOLLERR)) {
+        if (events & EPOLLERR) {
           mark_close(c);
           return;
         }
-        on_tunnel_client_event(c, events);
+        // EPOLLHUP fires once BOTH directions are shut (e.g. after the
+        // proxy propagated an upstream FIN and the client then FINed
+        // back) — pending bytes are still readable, so drain first;
+        // the read loop's r==0 sets client_eof and tunnel_check_done
+        // decides per-mode whether the relay lives on.
+        on_tunnel_client_event(
+            c, events | ((events & EPOLLHUP) ? EPOLLIN : 0u));
         break;
       case ConnState::kH2:
         if (events & (EPOLLHUP | EPOLLERR)) {
@@ -3765,6 +4396,7 @@ class Server {
   TlsStore* tls_;
   ServiceTable* services_ = nullptr;
   SSL_CTX* up_ctx_ = nullptr;  // upstream TLS client context
+  std::unordered_map<std::string, StaticFile> file_cache_;  // static sites
   std::string internal_token_;  // per-boot control-plane trust token
   bool tcp_mode_ = false;  // raw TCP(+TLS) fronting: no HTTP, no verdicts
   // Links whose SSL object holds decrypted-but-undelivered bytes (no fd

@@ -57,6 +57,9 @@ typedef struct {
 #define NGHTTP2_ERR_CALLBACK_FAILURE -902
 #define NGHTTP2_SETTINGS_MAX_CONCURRENT_STREAMS 3
 #define NGHTTP2_INTERNAL_ERROR 2
+#define NGHTTP2_FLAG_END_HEADERS 0x4
+#define NGHTTP2_FRAME_GOAWAY 7
+#define NGHTTP2_ERR_DEFERRED -508
 
 typedef struct {
   int32_t settings_id;
@@ -86,6 +89,13 @@ void nghttp2_session_callbacks_set_on_data_chunk_recv_callback(
 void nghttp2_session_callbacks_set_on_stream_close_callback(
     nghttp2_session_callbacks*, on_stream_close_cb);
 
+int nghttp2_session_client_new(nghttp2_session** out,
+                               const nghttp2_session_callbacks* cbs,
+                               void* user_data);
+int nghttp2_submit_request(nghttp2_session* session, const void* pri_spec,
+                           const nghttp2_nv* nva, size_t nvlen,
+                           const nghttp2_data_provider* data_prd,
+                           void* stream_user_data);
 int nghttp2_session_server_new(nghttp2_session** out,
                                const nghttp2_session_callbacks* cbs,
                                void* user_data);

@@ -73,6 +73,8 @@ int SSL_get_error(const SSL* ssl, int ret);
 int SSL_is_init_finished(const SSL* ssl);
 SSL_CTX* SSL_set_SSL_CTX(SSL* ssl, SSL_CTX* ctx);
 const char* SSL_get_servername(const SSL* ssl, const int type);
+int SSL_set_alpn_protos(SSL* ssl, const unsigned char* protos,
+                        unsigned int protos_len);
 void SSL_get0_alpn_selected(const SSL* ssl, const unsigned char** data,
                             unsigned int* len);
 int SSL_client_hello_get0_ext(SSL* ssl, unsigned int type,

@@ -226,16 +226,41 @@ def slots_to_arrays(slots: np.ndarray) -> dict:
 # C++ connector sends its per-boot internal token on hops to it, which
 # is what lets the Python listener trust the injected x-forwarded-for.
 INTERNAL = "internal"
+# Marks a cleartext prior-knowledge HTTP/2 upstream (config scheme
+# h2://): the C++ connector frames requests over an nghttp2 client
+# session instead of h1 (reference hyper client speaks h2 upstream,
+# http_proxy_service.rs:54-71).
+H2 = "h2-prior-knowledge"
+
+
+def _append_tls(lines: list, ip, port, sni) -> None:
+    if (not sni or len(sni) > 255 or any(ch.isspace() for ch in sni)):
+        # 255 = the C++ reader's %255s scan width; a longer name would
+        # be silently truncated into a hop that can never pass
+        # hostname verification.
+        raise ValueError(f"bad tls server name {sni!r}")
+    if sni in (INTERNAL, H2):
+        # Reserved table keywords: a server name that collides with a
+        # marker must use the unambiguous (ip, port, "tls", name) form
+        # — silently re-tagging the hop would either leak the internal
+        # token or downgrade TLS to cleartext h2.
+        raise ValueError(
+            f"tls server name {sni!r} collides with a table marker; "
+            f"use the (ip, port, 'tls', name) entry form")
+    lines.append(f"upstream {ip} {port} tls {sni}")
 
 
 def write_services_file(path: str, services: list) -> None:
     """Publish the native plane's routing table: `services` is the
     listener's ordered [(name, [upstream, ...])] — typically registry
-    snapshots (host/discovery.ServiceRegistry.get_upstreams). Each
-    upstream is `(ip, port)` for plaintext, `(ip, port, server_name)`
-    for a verified TLS hop (the C++ connector dials it with SNI +
-    hostname checks against server_name, reference
-    http_proxy_service.rs:54-71), or `(ip, port, INTERNAL)` for the
+    snapshots (host/discovery.ServiceRegistry.get_upstreams) — or
+    `(name, upstreams, static_root)` for a static-site service (the
+    C++ plane serves its <=500KB files directly; bigger ones proxy to
+    the upstream list). Each upstream is `(ip, port)` for plaintext,
+    `(ip, port, server_name)` for a verified TLS hop (the C++
+    connector dials it with SNI + hostname checks against server_name,
+    reference http_proxy_service.rs:54-71), `(ip, port, H2)` for
+    cleartext prior-knowledge h2, or `(ip, port, INTERNAL)` for the
     loopback control plane (token-authenticated identity headers).
     Written atomically (tmp + rename) so the C++ reader (httpd.cc
     ServiceTable) never observes a partial table; it hot-reloads on
@@ -245,22 +270,28 @@ def write_services_file(path: str, services: list) -> None:
             f"native routing supports at most 31 services (5-bit route "
             f"field, 31 = no match), got {len(services)}")
     lines = ["pingoo-services v1"]
-    for order, (name, ups) in enumerate(services):
+    for order, entry in enumerate(services):
+        name, ups = entry[0], entry[1]
+        static_root = entry[2] if len(entry) > 2 else None
         lines.append(f"service {order} {name}")
+        if static_root is not None:
+            if (not static_root or len(static_root) > 383
+                    or any(ch.isspace() for ch in static_root)):
+                # %383s scan width; whitespace would split the token.
+                raise ValueError(f"bad static root {static_root!r}")
+            lines.append(f"static {static_root}")
         for up in ups:
             if len(up) == 2:
                 lines.append(f"upstream {up[0]} {up[1]}")
-            elif up[2] is INTERNAL:
+            elif len(up) == 4 and up[2] == "tls":
+                # unambiguous TLS form: (ip, port, "tls", server_name)
+                _append_tls(lines, up[0], up[1], up[3])
+            elif up[2] == INTERNAL:
                 lines.append(f"upstream {up[0]} {up[1]} internal")
+            elif up[2] == H2:
+                lines.append(f"upstream {up[0]} {up[1]} h2")
             else:
-                ip, port, sni = up
-                if (not sni or len(sni) > 255
-                        or any(ch.isspace() for ch in sni)):
-                    # 255 = the C++ reader's %255s scan width; a longer
-                    # name would be silently truncated into a hop that
-                    # can never pass hostname verification.
-                    raise ValueError(f"bad tls server name {sni!r}")
-                lines.append(f"upstream {ip} {port} tls {sni}")
+                _append_tls(lines, up[0], up[1], up[2])
     tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         f.write("\n".join(lines) + "\n")
