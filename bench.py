@@ -188,30 +188,7 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
         httpd.kill()
         sidecar.stop()
         ring.close()
-    # Serving-path verdict latency (VERDICT r3 item 4): the data plane
-    # itself times ENQUEUE -> VERDICT per request into a fixed histogram
-    # (httpd.cc verdict_wait_ms_hist), which upper-bounds the p50/p99
-    # added wall latency against the <2 ms budget — kernel time alone
-    # (verdict_p99_ms) cannot see ring/batching/transport waits.
-    p50 = p99 = None
-    hist = stats.get("verdict_wait_ms_hist")
-    if hist:
-        edges = [("le1", 1.0), ("le2", 2.0), ("le5", 5.0), ("le10", 10.0),
-                 ("le50", 50.0), ("le100", 100.0), ("inf", float("inf"))]
-        total = sum(hist.get(k, 0) for k, _ in edges)
-        if total:
-            def pct(q):
-                need = q * total
-                run = 0
-                for k, edge in edges:
-                    run += hist.get(k, 0)
-                    if run >= need:
-                        # ">100" for the unbounded bucket: Infinity is
-                        # not valid JSON and would break the driver's
-                        # artifact parse.
-                        return edge if edge != float("inf") else ">100"
-                return ">100"
-            p50, p99 = pct(0.50), pct(0.99)
+    p50, p99 = _hist_percentiles(stats.get("verdict_wait_ms_hist"))
     return {
         "e2e_req_per_s": res["req_per_s"],
         "e2e_added_p50_ms": res["p50_ms"],
@@ -230,6 +207,32 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
                      "native plane's 3 s deadline fail open, so blocked "
                      "counts only verdicts that beat the tunnel"),
     }
+
+
+def _hist_percentiles(hist):
+    """(p50, p99) upper bounds from the data plane's enqueue->verdict
+    wall-time histogram (httpd.cc verdict_wait_ms_hist) — the serving-
+    path latency the <2 ms budget is about; kernel time alone cannot
+    see ring/batching/transport waits. ">100" for the unbounded bucket:
+    Infinity is not valid JSON and would break the driver's parse."""
+    if not hist:
+        return None, None
+    edges = [("le1", 1.0), ("le2", 2.0), ("le5", 5.0), ("le10", 10.0),
+             ("le50", 50.0), ("le100", 100.0), ("inf", float("inf"))]
+    total = sum(hist.get(k, 0) for k, _ in edges)
+    if not total:
+        return None, None
+
+    def pct(q):
+        need = q * total
+        run = 0
+        for k, edge in edges:
+            run += hist.get(k, 0)
+            if run >= need:
+                return edge if edge != float("inf") else ">100"
+        return ">100"
+
+    return pct(0.50), pct(0.99)
 
 
 def bench_dataplane(n_requests: int = 200_000) -> dict:
@@ -308,6 +311,16 @@ def bench_dataplane(n_requests: int = 200_000) -> dict:
         for p in procs:
             out, _ = p.communicate(timeout=300)
             results.append(json.loads(out.strip()))
+        dp_stats = {}
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{hport}/__pingoo/metrics",
+                    timeout=5) as resp:
+                dp_stats = json.loads(resp.read())
+        except Exception:
+            pass
     finally:
         drain.terminate()
         try:
@@ -321,8 +334,18 @@ def bench_dataplane(n_requests: int = 200_000) -> dict:
             ring.close()
     completed = sum(r["completed"] for r in results)
     elapsed = max(r["elapsed_s"] for r in results)
+    # The metrics scrape lands on ONE SO_REUSEPORT worker; with several
+    # workers its histogram covers only that worker's share, so the
+    # serving percentiles are only published when they describe the
+    # whole plane (workers == 1).
+    dp50 = dp99 = None
+    if workers == 1:
+        dp50, dp99 = _hist_percentiles(
+            dp_stats.get("verdict_wait_ms_hist"))
     return {
         "dataplane_req_per_s": round(completed / elapsed, 1),
+        "dataplane_serving_p50_ms_le": dp50,
+        "dataplane_serving_p99_ms_le": dp99,
         "dataplane_p50_ms": round(
             sum(r["p50_ms"] for r in results) / len(results), 3),
         "dataplane_p99_ms": round(max(r["p99_ms"] for r in results), 3),

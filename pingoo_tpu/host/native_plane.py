@@ -380,8 +380,11 @@ class NativePlane:
                 elif u.tls:
                     # Verify against the configured name when there
                     # is one; a literal-address upstream pins the
-                    # address itself (IP SAN).
-                    ups.append((addr, u.port, u.hostname or addr))
+                    # address itself (IP SAN). Unambiguous 4-tuple
+                    # form: a hostname that collides with a table
+                    # marker ("internal"/"h2-...") must never re-tag
+                    # the hop.
+                    ups.append((addr, u.port, "tls", u.hostname or addr))
                 else:
                     ups.append((addr, u.port))
         return ups, static_root, via_python

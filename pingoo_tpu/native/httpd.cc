@@ -89,7 +89,19 @@ const char kH2Preface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
 constexpr size_t kH2PrefaceLen = 24;
 
 constexpr size_t kMaxHead = 32 * 1024;
-constexpr size_t kMaxBuffered = 1 << 20;  // per-direction backlog cap
+constexpr size_t kMaxBufferedDefault = 1 << 20;  // per-direction backlog
+
+// Buffering cap, env-tunable (PINGOO_MAX_BUFFER) so tests can exercise
+// the backpressure/re-pump paths without multi-MB payloads.
+inline size_t max_buffered() {
+  static size_t v = [] {
+    const char* e = getenv("PINGOO_MAX_BUFFER");
+    long n = e != nullptr ? atol(e) : 0;
+    return n > 4096 ? static_cast<size_t>(n) : kMaxBufferedDefault;
+  }();
+  return v;
+}
+#define kMaxBuffered max_buffered()
 constexpr time_t kIdleTimeoutS = 30;
 constexpr time_t kVerdictTimeoutS = 3;   // then fail open
 constexpr time_t kTunnelIdleS = 300;     // upgraded (WebSocket) tunnels
@@ -1154,6 +1166,7 @@ struct ServiceTable {
     std::vector<std::string> new_names;
     std::vector<std::vector<UpTarget>> new_ups;
     std::vector<std::string> new_static;
+    int static_consumed = 0;
     char line[512];
     bool ok = true;
     while (fgets(line, sizeof(line), f) != nullptr) {
@@ -1170,12 +1183,19 @@ struct ServiceTable {
         new_ups.emplace_back();
         new_static.emplace_back();
       } else if (char sroot[384];
-                 sscanf(line, "static %383s", sroot) == 1) {
+                 sscanf(line, "static %383s%n", sroot,
+                        &static_consumed) == 1) {
         // Static site root for the CURRENT service (reference
         // http_static_site_service.rs): files <= 500 KB are served
         // from this binary; bigger ones proxy to the service's
         // upstream list (the streaming control plane).
-        if (new_static.empty()) {
+        const char* tail = line + static_consumed;
+        while (*tail == ' ' || *tail == '\t') tail++;
+        if (new_static.empty() ||
+            (*tail != '\0' && *tail != '\n' && *tail != '\r')) {
+          // trailing fields (version skew) or a root past the %383s
+          // scan width: reject the table, keep the last good one —
+          // the same fail-closed rule as the tls/h2/internal markers.
           ok = false;
           break;
         }
@@ -1368,7 +1388,8 @@ class Server {
     int status = 0;         // 200 / 304 / 404 / 405 / 500
     bool oversized = false;  // caller proxies to the upstream list
     std::string body;
-    std::string headers;     // extra response header lines
+    std::vector<std::pair<std::string, std::string>> headers;
+    uint64_t file_size = 0;  // entity size (HEAD advertises it)
   };
 
   StaticResult static_lookup(const std::string& root,
@@ -1376,12 +1397,15 @@ class Server {
                              const std::string& target,
                              const std::string& if_none_match) {
     StaticResult out;
-    if (method != "GET" && method != "HEAD") {
-      out.status = 405;
-      out.body = "Method Not Allowed";
-      out.headers = "content-type: text/plain\r\n";
+    auto plain = [&out](int status, const char* body) -> StaticResult& {
+      out.status = status;
+      out.body = body;
+      out.headers.emplace_back("content-type", "text/plain");
+      out.file_size = out.body.size();
       return out;
-    }
+    };
+    if (method != "GET" && method != "HEAD")
+      return plain(405, "Method Not Allowed");
     std::string path = target.substr(0, target.find('?'));
     // trim leading/trailing '/' like the reference, then guard
     size_t b = path.find_first_not_of('/');
@@ -1389,38 +1413,22 @@ class Server {
     path = b == std::string::npos ? "" : path.substr(b, e - b + 1);
     if (path.find("/..") != std::string::npos ||
         path.find("../") != std::string::npos || path == ".." ||
-        path.find("//") != std::string::npos) {
-      out.status = 404;
-      out.body = "Not Found";
-      out.headers = "content-type: text/plain\r\n";
-      return out;
-    }
+        path.find("//") != std::string::npos)
+      return plain(404, "Not Found");
     std::string full = root + "/" + path;
     struct stat st;
     if (stat(full.c_str(), &st) == 0 && S_ISDIR(st.st_mode)) {
       full += path.empty() ? "index.html" : "/index.html";
-      if (stat(full.c_str(), &st) != 0 || S_ISDIR(st.st_mode)) {
-        out.status = 404;
-        out.body = "Not Found";
-        out.headers = "content-type: text/plain\r\n";
-        return out;
-      }
+      if (stat(full.c_str(), &st) != 0 || S_ISDIR(st.st_mode))
+        return plain(404, "Not Found");
     } else if (stat(full.c_str(), &st) != 0) {
       // prettify: extensionless /page -> /page.html
       size_t slash = full.rfind('/');
-      if (full.find('.', slash + 1) != std::string::npos) {
-        out.status = 404;
-        out.body = "Not Found";
-        out.headers = "content-type: text/plain\r\n";
-        return out;
-      }
+      if (full.find('.', slash + 1) != std::string::npos)
+        return plain(404, "Not Found");
       full += ".html";
-      if (stat(full.c_str(), &st) != 0 || S_ISDIR(st.st_mode)) {
-        out.status = 404;
-        out.body = "Not Found";
-        out.headers = "content-type: text/plain\r\n";
-        return out;
-      }
+      if (stat(full.c_str(), &st) != 0 || S_ISDIR(st.st_mode))
+        return plain(404, "Not Found");
     }
     uint64_t size = static_cast<uint64_t>(st.st_size);
     uint64_t mtime_ns = static_cast<uint64_t>(st.st_mtim.tv_sec) *
@@ -1441,11 +1449,11 @@ class Server {
       etag += hexd[md[i] & 15];
     }
     etag += "\"";
-    std::string base_headers = std::string("content-type: ") +
-                               mime_for(full) + "\r\n" +
-                               "cache-control: public, max-age=0, "
-                               "must-revalidate\r\n" +
-                               "etag: " + etag + "\r\n";
+    std::vector<std::pair<std::string, std::string>> base_headers = {
+        {"content-type", mime_for(full)},
+        {"cache-control", "public, max-age=0, must-revalidate"},
+        {"etag", etag},
+    };
     // If-None-Match (W/ prefix + quotes stripped, reference :161-183)
     std::string inm = if_none_match;
     size_t s0 = inm.find_first_not_of(" \t");
@@ -1457,6 +1465,7 @@ class Server {
     if (!inm.empty() && etag == "\"" + inm + "\"") {
       out.status = 304;
       out.headers = base_headers;
+      out.file_size = size;
       return out;
     }
     if (size > kStaticCacheFileLimit) {
@@ -1469,15 +1478,12 @@ class Server {
       out.status = 200;
       out.body = it->second.data;
       out.headers = base_headers;
+      out.file_size = size;
       return out;
     }
     FILE* f = fopen(full.c_str(), "rb");
-    if (f == nullptr) {
-      out.status = 500;
-      out.body = "Internal Server Error";
-      out.headers = "content-type: text/plain\r\n";
-      return out;
-    }
+    if (f == nullptr)
+      return plain(500, "Internal Server Error");
     std::string data;
     data.resize(size);
     size_t got = fread(data.data(), 1, size, f);
@@ -1489,19 +1495,29 @@ class Server {
     out.status = 200;
     out.body = std::move(data);
     out.headers = base_headers;
+    out.file_size = size;
     return out;
   }
 
   // Generic keep-alive-aware h1 response for natively served content.
+  // content_length < 0 omits the header entirely (304: RFC 9110 §8.6 —
+  // a stated length must match the SELECTED representation, and the
+  // 304 carries no body to derive it from).
   void respond_h1(Conn* c, int status, const char* reason,
-                  const std::string& extra_headers, const std::string& body,
-                  bool head_only) {
+                  const std::vector<std::pair<std::string, std::string>>&
+                      extra_headers,
+                  const std::string& body, bool head_only,
+                  long long content_length) {
     bool keep = c->req.keep_alive && c->req_body.done;
     c->outbuf += "HTTP/1.1 " + std::to_string(status) + " " + reason +
-                 "\r\nserver: pingoo\r\ncontent-length: " +
-                 std::to_string(body.size()) + "\r\n" + extra_headers +
-                 (keep ? "connection: keep-alive\r\n\r\n"
-                       : "connection: close\r\n\r\n");
+                 "\r\nserver: pingoo\r\n";
+    if (content_length >= 0)
+      c->outbuf += "content-length: " + std::to_string(content_length) +
+                   "\r\n";
+    for (const auto& kv : extra_headers)
+      c->outbuf += kv.first + ": " + kv.second + "\r\n";
+    c->outbuf += keep ? "connection: keep-alive\r\n\r\n"
+                      : "connection: close\r\n\r\n";
     if (!head_only) c->outbuf += body;
     if (!flush_out(c)) {
       mark_close(c);
@@ -1550,8 +1566,12 @@ class Server {
     }
     StaticResult r = static_lookup(root, c->req.method, c->req.target, inm);
     if (r.oversized) return false;
+    bool head_only = c->req.method == "HEAD" || r.status == 304;
+    long long cl = r.status == 304
+                       ? -1
+                       : static_cast<long long>(r.file_size);
     respond_h1(c, r.status, reason_for(r.status), r.headers, r.body,
-               c->req.method == "HEAD" || r.status == 304);
+               head_only, cl);
     return true;
   }
 
@@ -1566,24 +1586,14 @@ class Server {
     }
     StaticResult r = static_lookup(root, st.p.method, st.p.target, inm);
     if (r.oversized) return false;
-    std::vector<std::pair<std::string, std::string>> headers;
-    size_t pos = 0;
-    while (pos < r.headers.size()) {
-      size_t eol = r.headers.find("\r\n", pos);
-      if (eol == std::string::npos) break;
-      size_t colon = r.headers.find(':', pos);
-      if (colon != std::string::npos && colon < eol) {
-        size_t vs = colon + 1;
-        while (vs < eol && r.headers[vs] == ' ') vs++;
-        headers.emplace_back(r.headers.substr(pos, colon - pos),
-                             r.headers.substr(vs, eol - vs));
-      }
-      pos = eol + 2;
-    }
     bool head_only = st.p.method == "HEAD" || r.status == 304;
-    h2_submit(c, sid, r.status, headers,
-              head_only ? std::string() : r.body,
-              head_only ? static_cast<long long>(r.body.size()) : -1);
+    // 304 omits content-length (RFC 9110 §8.6); HEAD advertises the
+    // full entity size while sending no body.
+    long long cl = r.status == 304
+                       ? -1
+                       : static_cast<long long>(r.file_size);
+    h2_submit(c, sid, r.status, r.headers,
+              head_only ? std::string() : r.body, cl);
     h2_process_next(c);
     return true;
   }
@@ -2794,11 +2804,21 @@ class Server {
     for (;;) {
       ssize_t r = t_read(c, buf, sizeof(buf));
       if (r > 0) {
+        size_t old = c->inbuf.size();
         c->inbuf.append(buf, static_cast<size_t>(r));
         if (c->inbuf.size() > kMaxHead + kMaxBuffered) {
           mark_close(c);
           return;
         }
+        // Stop draining once a full head is buffered: the request
+        // BODY must flow under the proxy states' backpressure gates —
+        // a fast client front-loading a multi-MB upload would
+        // otherwise blow the inbuf cap before proxying even starts.
+        // (The h2 preface contains its own CRLFCRLF, so h2 handoff
+        // breaks here too and the h2 machinery takes over.)
+        if (c->inbuf.find("\r\n\r\n", old > 3 ? old - 3 : 0) !=
+            std::string::npos)
+          break;
       } else if (r == 0) {
         eof = true;
         break;
@@ -3741,13 +3761,16 @@ class Server {
     h2_flush(c);
   }
 
+  static constexpr long long kClFromBody = -2;  // derive from body.size()
+
   void h2_submit(Conn* c, int32_t sid, int status,
                  const std::vector<std::pair<std::string, std::string>>&
                      headers,
-                 std::string body, long long content_length = -1) {
-    // content_length >= 0 overrides the body size: a HEAD response
-    // advertises the full entity size while sending no body.
-    if (content_length < 0)
+                 std::string body, long long content_length = kClFromBody) {
+    // kClFromBody derives the length from the body; >= 0 overrides it
+    // (HEAD advertises the entity size while sending no body); -1
+    // omits the header entirely (304 responses).
+    if (content_length == kClFromBody)
       content_length = static_cast<long long>(body.size());
     c->h2_send[sid] = {std::move(body), 0};
     nghttp2_data_provider prd{};
@@ -3838,13 +3861,22 @@ class Server {
     return 0;
   }
 
-  static int h2_on_data_chunk(nghttp2_session*, uint8_t, int32_t stream_id,
-                              const uint8_t* data, size_t len,
-                              void* user_data) {
+  static int h2_on_data_chunk(nghttp2_session* sess, uint8_t,
+                              int32_t stream_id, const uint8_t* data,
+                              size_t len, void* user_data) {
     Conn* c = static_cast<Conn*>(user_data);
     H2Stream& st = c->h2_streams[stream_id];
-    if (st.body.size() + len > kMaxBuffered)
-      return NGHTTP2_ERR_CALLBACK_FAILURE;
+    if (st.body.size() + len > kMaxBuffered) {
+      // One oversized stream must not tear the SESSION down
+      // (CALLBACK_FAILURE is connection-fatal): reset just this
+      // stream. Streaming h2 request bodies end-to-end is the known
+      // remaining delta vs hyper's fully-streamed bodies.
+      nghttp2_submit_rst_stream(sess, 0, stream_id,
+                                NGHTTP2_INTERNAL_ERROR);
+      st.body.clear();
+      st.complete = false;
+      return 0;
+    }
     st.body.append(reinterpret_cast<const char*>(data), len);
     return 0;
   }
@@ -4081,9 +4113,20 @@ class Server {
           }
           if (!synth.empty()) {
             on_upstream_data(c, synth.data(), synth.size());
-            if (c->dead || !proxy_live(c)) return;
+            // The synthesized bytes may COMPLETE the response: the
+            // link is then released/closed (up_h2 == nullptr) and the
+            // connection may already be proxying a pipelined next
+            // request (even over a fresh link) — this event context is
+            // stale either way.
+            if (c->dead || !proxy_live(c) || c->up_h2 == nullptr) return;
           }
-          // acks/window updates the session owes after the feed
+          // acks/window updates the session owes after the feed, and
+          // any request-body bytes the 1 MiB link cap left stranded in
+          // inbuf — the client may be done sending (no more client
+          // events), so the upstream's WINDOW_UPDATEs must re-drive
+          // the pump or a large upload deadlocks here.
+          pump_request_body(c);
+          if (c->dead) return;
           c->up_h2->pump_send(&c->upbuf);
         } else if (r > 0) {
           on_upstream_data(c, buf, static_cast<size_t>(r));
@@ -4361,11 +4404,16 @@ class Server {
           mark_close(c);
           return;
         }
-        // EPOLLHUP fires once BOTH directions are shut (e.g. after the
-        // proxy propagated an upstream FIN and the client then FINed
-        // back) — pending bytes are still readable, so drain first;
-        // the read loop's r==0 sets client_eof and tunnel_check_done
-        // decides per-mode whether the relay lives on.
+        // EPOLLHUP fires once BOTH directions are shut — pending bytes
+        // are still readable, so drain first (the read loop's r==0
+        // sets client_eof). HUP cannot be masked by a 0 event mask, so
+        // an ALREADY-drained client must close here: nothing can ever
+        // be delivered to it again, and letting it loop would pin a
+        // core (each wake refreshing last_active past the idle sweep).
+        if ((events & EPOLLHUP) && c->client_eof) {
+          mark_close(c);
+          return;
+        }
         on_tunnel_client_event(
             c, events | ((events & EPOLLHUP) ? EPOLLIN : 0u));
         break;

@@ -222,26 +222,37 @@ def slots_to_arrays(slots: np.ndarray) -> dict:
     return arrays
 
 
+class _TableMarker(str):
+    """Identity-carrying marker for services-table upstream entries.
+    A marker is recognized ONLY by `isinstance` + identity — a config-
+    derived hostname that happens to equal a marker's text can never be
+    mistaken for one (it raises in _append_tls with guidance to use the
+    explicit (ip, port, "tls", name) form instead)."""
+
+    __slots__ = ()
+
+
 # Marks a services-table upstream as the loopback control plane: the
 # C++ connector sends its per-boot internal token on hops to it, which
 # is what lets the Python listener trust the injected x-forwarded-for.
-INTERNAL = "internal"
+INTERNAL = _TableMarker("internal")
 # Marks a cleartext prior-knowledge HTTP/2 upstream (config scheme
 # h2://): the C++ connector frames requests over an nghttp2 client
 # session instead of h1 (reference hyper client speaks h2 upstream,
 # http_proxy_service.rs:54-71).
-H2 = "h2-prior-knowledge"
+H2 = _TableMarker("h2-prior-knowledge")
 
 
-def _append_tls(lines: list, ip, port, sni) -> None:
+def _append_tls(lines: list, ip, port, sni, explicit: bool = False) -> None:
     if (not sni or len(sni) > 255 or any(ch.isspace() for ch in sni)):
         # 255 = the C++ reader's %255s scan width; a longer name would
         # be silently truncated into a hop that can never pass
         # hostname verification.
         raise ValueError(f"bad tls server name {sni!r}")
-    if sni in (INTERNAL, H2):
-        # Reserved table keywords: a server name that collides with a
-        # marker must use the unambiguous (ip, port, "tls", name) form
+    if not explicit and sni in (INTERNAL, H2):
+        # Reserved table keywords in the legacy 3-tuple form are
+        # ambiguous: a server name that collides with a marker must use
+        # the unambiguous (ip, port, "tls", name) form (explicit=True)
         # — silently re-tagging the hop would either leak the internal
         # token or downgrade TLS to cleartext h2.
         raise ValueError(
@@ -285,10 +296,10 @@ def write_services_file(path: str, services: list) -> None:
                 lines.append(f"upstream {up[0]} {up[1]}")
             elif len(up) == 4 and up[2] == "tls":
                 # unambiguous TLS form: (ip, port, "tls", server_name)
-                _append_tls(lines, up[0], up[1], up[3])
-            elif up[2] == INTERNAL:
+                _append_tls(lines, up[0], up[1], up[3], explicit=True)
+            elif isinstance(up[2], _TableMarker) and up[2] is INTERNAL:
                 lines.append(f"upstream {up[0]} {up[1]} internal")
-            elif up[2] == H2:
+            elif isinstance(up[2], _TableMarker) and up[2] is H2:
                 lines.append(f"upstream {up[0]} {up[1]} h2")
             else:
                 _append_tls(lines, up[0], up[1], up[2])

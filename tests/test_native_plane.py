@@ -2937,3 +2937,203 @@ class TestTcpUpstreamHalfClose:
             proc.wait()
             ring.close()
             ls.close()
+
+
+class TestH2UpstreamConcurrency:
+    """Concurrent h2 downstream streams over a pooled h2c upstream:
+    each stream opens (or reuses) its own upstream h2 session — mixed
+    with h1 clients hammering the same pool. Exercises pool handoff,
+    GOAWAY-free reuse, and session ownership transfer under load."""
+
+    def test_mixed_h1_h2_traffic_over_h2c_upstream(self, tmp_path):
+        from pingoo_tpu.host import h2 as h2mod
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+        from pingoo_tpu.native_ring import H2
+
+        pong = _tagged_upstream("svc-pong")
+        pa, pb = _free_port(), _free_port()
+        mk = TestH2UpstreamNative()._mk_httpd
+        cleanup = []
+        try:
+            cleanup.append(mk(tmp_path, "cb", pb, pong.server_address[1]))
+            tbl = str(tmp_path / "svc_c.tbl")
+            native_ring.write_services_file(
+                tbl, [("app", [("127.0.0.1", pb, H2)])])
+            cleanup.append(mk(tmp_path, "ca", pa, 9, ("--services", tbl)))
+
+            from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+            async def h2_batch(n):
+                conn = H2UpstreamConnection("127.0.0.1", pa)
+                await conn.connect()
+                try:
+                    outs = await asyncio.gather(*[
+                        asyncio.wait_for(conn.request(
+                            "GET", "t", f"/s{i}", [("user-agent", "u")]),
+                            20)
+                        for i in range(n)])
+                    return outs
+                finally:
+                    await conn.close()
+
+            h1_results = []
+
+            def h1_hammer(k):
+                for i in range(k):
+                    out = raw_request(
+                        pa, f"GET /h1-{i} HTTP/1.1\r\nhost: t\r\n"
+                            f"user-agent: u\r\nconnection: close"
+                            f"\r\n\r\n".encode())
+                    h1_results.append(b"svc-pong:/h1-" + str(i).encode()
+                                      in out)
+
+            t = threading.Thread(target=h1_hammer, args=(30,))
+            t.start()
+            outs = asyncio.run(h2_batch(24))
+            t.join(timeout=60)
+            for i, (st, _h, body) in enumerate(outs):
+                assert st == 200 and body == f"svc-pong:/s{i}".encode(), \
+                    (i, st, body)
+            assert len(h1_results) == 30 and all(h1_results)
+        finally:
+            for ring, drain, h in cleanup:
+                drain.kill()
+                h.kill()
+                ring.close()
+            pong.shutdown()
+
+
+class TestH2UpstreamLargeUpload:
+    """A POST bigger than the h2 LINK's body cap: bytes past the cap
+    stay in inbuf and MUST be re-pumped when the upstream's
+    WINDOW_UPDATEs drain the link (round-5 fix: the client may be done
+    sending, so upstream events drive the pump). The front proxy runs
+    with PINGOO_MAX_BUFFER=64KB so a 512KB upload exercises the
+    stranded-bytes path while staying under the h2 SERVER side's
+    buffered-body cap (streamed h2 request bodies are the known
+    remaining delta vs hyper)."""
+
+    def test_post_past_link_cap_completes(self, tmp_path):
+        from pingoo_tpu.native_ring import H2
+
+        class _BigPost(_TaggedUpstream):
+            def do_POST(self):
+                n = int(self.headers.get("content-length", 0))
+                remaining, total = n, 0
+                while remaining:
+                    chunk = self.rfile.read(min(65536, remaining))
+                    if not chunk:
+                        break
+                    total += len(chunk)
+                    remaining -= len(chunk)
+                body = f"got:{total}".encode()
+                self.send_response(200)
+                self.send_header("content-length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        pong = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _BigPost)
+        pong.tag = "big"
+        pong.delay_s = 0
+        threading.Thread(target=pong.serve_forever, daemon=True).start()
+        pa, pb = _free_port(), _free_port()
+        mk = TestH2UpstreamNative()._mk_httpd
+        cleanup = []
+        try:
+            cleanup.append(mk(tmp_path, "bb", pb, pong.server_address[1]))
+            tbl = str(tmp_path / "svc_big.tbl")
+            native_ring.write_services_file(
+                tbl, [("app", [("127.0.0.1", pb, H2)])])
+            env = dict(os.environ)
+            env["PINGOO_MAX_BUFFER"] = "65536"
+            ring_path = str(tmp_path / "ring_ba")
+            ring = Ring(ring_path, capacity=256, create=True)
+            drain = subprocess.Popen(
+                [os.path.join(native_ring.NATIVE_DIR, "drain"), ring_path],
+                stdout=subprocess.PIPE)
+            assert b"draining" in drain.stdout.readline()
+            h = subprocess.Popen(
+                [HTTPD, str(pa), ring_path, "127.0.0.1", "9",
+                 "--services", tbl], stdout=subprocess.PIPE, env=env)
+            assert b"listening" in h.stdout.readline()
+            cleanup.append((ring, drain, h))
+            n = 512 * 1024
+            body = b"z" * n
+            c = socket.create_connection(("127.0.0.1", pa), timeout=30)
+            c.sendall((f"POST /up HTTP/1.1\r\nhost: t\r\nuser-agent: u"
+                       f"\r\ncontent-length: {n}\r\nconnection: close"
+                       f"\r\n\r\n").encode())
+            c.sendall(body)
+            c.settimeout(60)
+            data = b""
+            while True:
+                try:
+                    ch = c.recv(65536)
+                except socket.timeout:
+                    break
+                if not ch:
+                    break
+                data += ch
+            c.close()
+            assert f"got:{n}".encode() in data, data[:300]
+        finally:
+            for ring, drain, h in cleanup:
+                drain.kill()
+                h.kill()
+                ring.close()
+            pong.shutdown()
+
+    def test_oversized_h2_request_body_resets_stream_not_session(
+            self, tmp_path):
+        """An h2 DOWNSTREAM request body past the buffered cap must
+        reset that stream only — the session (and its siblings) live."""
+        from pingoo_tpu.host import h2 as h2mod
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+        pong = _tagged_upstream("svc-pong")
+        port = _free_port()
+        ring_path = str(tmp_path / "ring_ov")
+        ring = Ring(ring_path, capacity=256, create=True)
+        drain = subprocess.Popen(
+            [os.path.join(native_ring.NATIVE_DIR, "drain"), ring_path],
+            stdout=subprocess.PIPE)
+        assert b"draining" in drain.stdout.readline()
+        env = dict(os.environ)
+        env["PINGOO_MAX_BUFFER"] = "65536"
+        h = subprocess.Popen(
+            [HTTPD, str(port), ring_path, "127.0.0.1",
+             str(pong.server_address[1])], stdout=subprocess.PIPE, env=env)
+        assert b"listening" in h.stdout.readline()
+        try:
+            from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+            async def flow():
+                conn = H2UpstreamConnection("127.0.0.1", port)
+                await conn.connect()
+                try:
+                    big = b"y" * (256 * 1024)  # 4x the cap
+                    try:
+                        await asyncio.wait_for(conn.request(
+                            "POST", "t", "/up", [("user-agent", "u")],
+                            big), 15)
+                        oversized_ok = True  # unexpected
+                    except (ConnectionError, OSError):
+                        oversized_ok = False
+                    # the SESSION must still serve new streams
+                    st, _h, body = await asyncio.wait_for(conn.request(
+                        "GET", "t", "/after", [("user-agent", "u")]), 15)
+                    return oversized_ok, st, body
+                finally:
+                    await conn.close()
+
+            oversized_ok, st, body = asyncio.run(flow())
+            assert not oversized_ok
+            assert st == 200 and body == b"svc-pong:/after", (st, body)
+        finally:
+            drain.kill()
+            h.kill()
+            ring.close()
+            pong.shutdown()

@@ -406,3 +406,40 @@ class TestSidecarRouting:
             t.join(timeout=10)
             for ring in rings:
                 ring.close()
+
+
+class TestServicesTableMarkers:
+    """Marker/hostname ambiguity: a TLS server name that equals a
+    marker's text must never silently re-tag the hop (internal-token
+    leak / TLS-to-cleartext downgrade); the markers themselves are
+    identity objects, and the explicit 4-tuple tls form carries
+    colliding names safely."""
+
+    def test_hostname_equal_to_marker_text_raises(self, tmp_path):
+        from pingoo_tpu.native_ring import write_services_file
+
+        for name in ("internal", "h2-prior-knowledge"):
+            with pytest.raises(ValueError, match="collides"):
+                write_services_file(
+                    str(tmp_path / "t.tbl"),
+                    [("svc", [("1.2.3.4", 443, name)])])
+
+    def test_explicit_tls_form_carries_colliding_name(self, tmp_path):
+        from pingoo_tpu.native_ring import write_services_file
+
+        p = str(tmp_path / "t.tbl")
+        write_services_file(
+            p, [("svc", [("1.2.3.4", 443, "tls", "internal")])])
+        assert "upstream 1.2.3.4 443 tls internal" in open(p).read()
+
+    def test_marker_objects_still_mark(self, tmp_path):
+        from pingoo_tpu.native_ring import H2, INTERNAL, \
+            write_services_file
+
+        p = str(tmp_path / "t.tbl")
+        write_services_file(
+            p, [("svc", [("1.2.3.4", 80, INTERNAL),
+                         ("1.2.3.5", 80, H2)])])
+        txt = open(p).read()
+        assert "upstream 1.2.3.4 80 internal" in txt
+        assert "upstream 1.2.3.5 80 h2" in txt

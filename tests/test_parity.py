@@ -475,3 +475,38 @@ class TestRoutePseudoRules:
         assert unverified.tolist() == [1, 0]
         assert verified_block.tolist() == [True, False]
         assert matched[:, plan.route_index["all"]].all()
+
+
+class TestMultiSeedDifferential:
+    """Randomized CRS-scale rulesets across several seeds: compiler
+    bugs that depend on rule COMPOSITION (bank packing, span layout,
+    class compression interactions) only surface when the generated
+    set changes — the fixed corpus above cannot move those seams."""
+
+    def test_generated_rulesets_exact_across_seeds(self):
+        import numpy as np
+
+        from pingoo_tpu.engine.batch import bucket_arrays
+        from pingoo_tpu.engine.verdict import interpret_rules_row
+        from pingoo_tpu.utils.crs import generate_ruleset, generate_traffic
+
+        for seed in (7, 1234, 999983):
+            rules, lists = generate_ruleset(
+                80, with_lists=True, list_sizes=(512, 64), seed=seed)
+            plan = compile_ruleset(rules, lists)
+            verdict_fn = make_verdict_fn(plan)
+            reqs = generate_traffic(192, lists=lists, seed=seed + 1,
+                                    attack_fraction=0.3)
+            from pingoo_tpu.engine.batch import RequestBatch
+
+            batch = encode_requests(reqs)
+            b2 = RequestBatch(size=batch.size,
+                              arrays=bucket_arrays(batch.arrays))
+            matched = evaluate_batch(plan, verdict_fn,
+                                     plan.device_tables(), b2, lists)
+            contexts = batch_to_contexts(batch, lists)
+            for i, ctx in enumerate(contexts):
+                want = interpret_rules_row(plan, ctx)
+                assert np.array_equal(matched[i], want), (
+                    f"seed {seed}: request {i} diverged: "
+                    f"{np.nonzero(matched[i] != want)[0]}")
