@@ -42,6 +42,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--state-dir", default="/var/run/pingoo",
                         help="ring files + services table directory "
                              "(native plane)")
+    parser.add_argument("--upstream-ca", default=None,
+                        help="PEM trust bundle for TLS upstream hops "
+                             "(native plane; system roots by default)")
     args = parser.parse_args(argv)
 
     init_logging()
@@ -73,6 +76,7 @@ def main(argv: list[str] | None = None) -> int:
             asyncio.run(run_native(
                 config, state_dir=args.state_dir,
                 workers=args.native_workers,
+                upstream_ca=args.upstream_ca,
                 use_device=not args.no_device,
                 enable_docker=not args.no_docker,
                 cache_dir=args.cache_dir,

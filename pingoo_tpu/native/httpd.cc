@@ -92,16 +92,20 @@ constexpr size_t kMaxHead = 32 * 1024;
 constexpr size_t kMaxBufferedDefault = 1 << 20;  // per-direction backlog
 
 // Buffering cap, env-tunable (PINGOO_MAX_BUFFER) so tests can exercise
-// the backpressure/re-pump paths without multi-MB payloads.
-inline size_t max_buffered() {
-  static size_t v = [] {
-    const char* e = getenv("PINGOO_MAX_BUFFER");
-    long n = e != nullptr ? atol(e) : 0;
-    return n > 4096 ? static_cast<size_t>(n) : kMaxBufferedDefault;
-  }();
-  return v;
+// the backpressure/re-pump paths without multi-MB payloads. Resolved
+// once at process start; out-of-range values warn and fall back.
+inline size_t parse_max_buffered() {
+  const char* e = getenv("PINGOO_MAX_BUFFER");
+  if (e == nullptr || *e == '\0') return kMaxBufferedDefault;
+  long n = atol(e);
+  if (n < 4096) {
+    fprintf(stderr, "PINGOO_MAX_BUFFER=%s out of range (< 4096); using %zu\n",
+            e, kMaxBufferedDefault);
+    return kMaxBufferedDefault;
+  }
+  return static_cast<size_t>(n);
 }
-#define kMaxBuffered max_buffered()
+const size_t kMaxBuffered = parse_max_buffered();
 constexpr time_t kIdleTimeoutS = 30;
 constexpr time_t kVerdictTimeoutS = 3;   // then fail open
 constexpr time_t kTunnelIdleS = 300;     // upgraded (WebSocket) tunnels
@@ -663,8 +667,16 @@ struct H2Stream {
   bool up_trunc = false;        // upstream ended with an ERROR, not clean EOF
   UpH2Link* up_h2 = nullptr;    // non-null: upstream link speaks h2
   std::string up_head;          // synthesized h1 head (until ALPN decides)
-  std::string up_body;          // buffered request body for an h2 link
+  std::string up_body;          // request-body bytes pending the h2 link
   bool up_proto_pending = false;
+  // Streamed request bodies (reference: hyper streams them): the
+  // stream dispatches at END_HEADERS; DATA arriving after dispatch
+  // forwards straight to the upstream instead of buffering in `body`.
+  bool ready_queued = false;    // pushed to h2_ready once
+  bool up_dispatched = false;   // upstream head synthesized
+  bool up_body_chunked = false;  // forwarding with h1 chunked framing
+  uint64_t window_debt = 0;     // received-but-unconsumed body bytes
+                                // (released as the upstream drains)
   bool up_pooled = false;
   uint64_t up_key = 0;
   UpTarget up_target{};
@@ -3027,7 +3039,19 @@ class Server {
         cbs, h2_on_data_chunk);
     nghttp2_session_callbacks_set_on_stream_close_callback(
         cbs, h2_on_stream_close);
-    int rv = nghttp2_session_server_new(&c->h2, cbs, c);
+    // MANUAL receive-window management (no_auto_window_update +
+    // nghttp2_session_consume): streamed request bodies only open the
+    // client's send window as the UPSTREAM drains, so a slow upstream
+    // backpressures the client through h2 flow control instead of
+    // forcing a buffer-or-reset choice here.
+    nghttp2_option* opt = nullptr;
+    if (nghttp2_option_new(&opt) != 0) {
+      nghttp2_session_callbacks_del(cbs);
+      return false;
+    }
+    nghttp2_option_set_no_auto_window_update(opt, 1);
+    int rv = nghttp2_session_server_new2(&c->h2, cbs, c, opt);
+    nghttp2_option_del(opt);
     nghttp2_session_callbacks_del(cbs);
     if (rv != 0) return false;
     // Bound per-connection stream state: without this SETTINGS entry
@@ -3198,6 +3222,7 @@ class Server {
     bool can_pool = st.resp_body.done &&
                     st.resp_body.mode != BodyFramer::kUntilEof &&
                     !st.up_eof && st.up_keep && !st.up_junk &&
+                    st.complete &&  // streamed request body fully in
                     st.upbuf.empty() &&  // request fully sent: an early
                     // response over unsent body bytes would poison the
                     // pooled connection for its next user
@@ -3251,8 +3276,22 @@ class Server {
     epoll_ctl(ep_, EPOLL_CTL_MOD, st.up_fd, &e);
   }
 
+  // Put the head + whatever body bytes are buffered onto an h1
+  // upstream link, with the stream's framing mode applied.
+  void h2_stream_attach_h1_body(H2Stream& st) {
+    st.upbuf = st.up_head;
+    if (st.up_body_chunked) {
+      h1_chunk_wrap(&st.upbuf, st.up_body.data(), st.up_body.size());
+      if (st.complete) st.upbuf += "0\r\n\r\n";
+    } else {
+      st.upbuf += st.up_body;
+    }
+    st.up_body.clear();
+  }
+
   // Adopt (or create) an h2 session for one downstream stream's
-  // upstream link; the stream's request body is fully buffered.
+  // upstream link; buffered body bytes attach now, later ones stream
+  // via h2_stream_body_chunk.
   bool h2_stream_begin_up_h2(Conn* c, int32_t sid, H2Stream& st,
                              UpH2Link* link) {
     if (link == nullptr) {
@@ -3268,12 +3307,13 @@ class Server {
       link->reset_for_reuse();
     }
     st.up_h2 = link;
-    bool has_body = !st.up_body.empty();
+    bool has_body = !st.up_body.empty() || !st.complete;
     bool ok = link->submit(st.up_head, st.up_target.tls, has_body);
-    if (ok && has_body) {
+    if (ok && !st.up_body.empty()) {
       link->append_body(st.up_body.data(), st.up_body.size());
+      st.up_body.clear();
     }
-    if (ok) link->finish_body();
+    if (ok && st.complete) link->finish_body();
     if (!ok || !link->pump_send(&st.upbuf)) {
       stats_.upstream_fail++;
       h2_close_stream_upstream(c, st);  // deletes the link
@@ -3344,14 +3384,20 @@ class Server {
     st.pending.clear();
     st.data_eof = false;
     st.submitted = false;
-    {
-      // Head and (fully buffered) body; the h2-upstream split keeps
-      // them separate so the link can frame DATA itself.
-      std::string headbody = h2_upstream_head(c, st);
-      size_t he = headbody.find("\r\n\r\n");
-      st.up_head = headbody.substr(0, he + 4);
-      st.up_body = headbody.substr(he + 4);
+    // Body framing mode: complete bodies get a derived length;
+    // streaming ones pass the client's content-length through or fall
+    // back to chunked (decided BEFORE head synthesis).
+    st.up_body_chunked = false;
+    if (!st.complete) {
+      bool has_cl = false;
+      for (const auto& kv : st.p.h2_headers)
+        if (kv.first == "content-length") has_cl = true;
+      st.up_body_chunked = !has_cl;
     }
+    st.up_dispatched = true;
+    st.up_head = h2_upstream_head(c, st);
+    st.up_body = std::move(st.body);  // raw bytes buffered so far
+    st.body.clear();
     st.up_proto_pending = false;
     if (pooled && pc.h2link != nullptr) {
       if (!h2_stream_begin_up_h2(c, sid, st, pc.h2link)) return;
@@ -3360,20 +3406,28 @@ class Server {
     } else if (target.tls && !pooled) {
       st.up_proto_pending = true;  // ALPN decides after the handshake
     } else {
-      st.upbuf = st.up_head + st.up_body;
+      h2_stream_attach_h1_body(st);
     }
-    if (!st.up_proto_pending && st.up_h2 == nullptr) {
+    if (!st.up_proto_pending && st.up_h2 == nullptr && st.complete) {
+      // Replay is a raw byte copy: only a FULLY-KNOWN body can replay.
       st.up_replay = st.upbuf;
       if (st.up_replay.size() > kMaxReplay) {
         st.up_replay.clear();
         st.up_pooled = false;
       }
+    } else if (st.up_h2 == nullptr && !st.complete) {
+      st.up_replay.clear();
+      st.up_pooled = false;
     }
     st.up_ref = new SockRef{c, true, sid};
     epoll_event ue{};
     ue.events = EPOLLOUT | EPOLLIN;
     ue.data.ptr = st.up_ref;
     epoll_ctl(ep_, EPOLL_CTL_ADD, ufd, &ue);
+    // Pre-dispatch bytes may have closed the client's window; now that
+    // they are on the forwarding path the drain hook will reopen it —
+    // kick once for the case where everything already fits.
+    h2_stream_release_window(c, sid, st);
   }
 
   bool h2_try_stream_retry(Conn* c, int32_t sid, H2Stream& st) {
@@ -3672,9 +3726,14 @@ class Server {
               return;
             }
           } else {
-            st.upbuf = st.up_head + st.up_body;
-            st.up_replay = st.upbuf;
-            if (st.up_replay.size() > kMaxReplay) {
+            h2_stream_attach_h1_body(st);
+            if (st.complete) {
+              st.up_replay = st.upbuf;
+              if (st.up_replay.size() > kMaxReplay) {
+                st.up_replay.clear();
+                st.up_pooled = false;
+              }
+            } else {
               st.up_replay.clear();
               st.up_pooled = false;
             }
@@ -3707,6 +3766,9 @@ class Server {
           return;
         }
       }
+      // upstream writes drained some backlog: reopen the client's
+      // send window if debt was parked on this stream
+      h2_stream_release_window(c, sid, st);
     }
     if ((events & EPOLLIN) || st.up_rd_want_write) {
       char buf[16384];
@@ -3790,19 +3852,30 @@ class Server {
   }
 
   // Synthesized upstream h1 request head for the active h2 stream
-  // (h2 streams have no raw h1 head to rewrite).
+  // (h2 streams have no raw h1 head to rewrite). HEAD ONLY — the body
+  // is framed by the caller per st's streaming mode: complete bodies
+  // get a derived content-length, streamed ones pass the client's
+  // content-length through or fall back to chunked.
   std::string h2_upstream_head(Conn* c, const H2Stream& st) {
     const Parsed& p = st.p;
     std::string out = p.method + " " + p.target + " HTTP/1.1\r\n";
     if (!p.host.empty()) out += "host: " + p.host + "\r\n";
+    const std::string* client_cl = nullptr;
     for (const auto& kv : p.h2_headers) {
+      if (kv.first == "content-length") client_cl = &kv.second;
       if (drop_request_header(kv.first, false) || kv.first == "host")
         continue;
       out += kv.first + ": " + kv.second + "\r\n";
     }
     out += "connection: keep-alive\r\n";
-    if (!st.body.empty())
-      out += "content-length: " + std::to_string(st.body.size()) + "\r\n";
+    if (st.complete) {
+      if (!st.body.empty())
+        out += "content-length: " + std::to_string(st.body.size()) + "\r\n";
+    } else if (client_cl != nullptr) {
+      out += "content-length: " + *client_cl + "\r\n";
+    } else if (st.up_body_chunked) {
+      out += "transfer-encoding: chunked\r\n";
+    }
     out += "x-forwarded-for: " + std::string(c->peer_ip) + "\r\n";
     out += std::string("x-forwarded-proto: ") +
            (c->ssl != nullptr ? "https" : "http") + "\r\n";
@@ -3810,8 +3883,62 @@ class Server {
     if (st.up_target.internal && !internal_token_.empty())
       out += "x-pingoo-internal: " + internal_token_ + "\r\n";
     out += "pingoo-client-ip: " + std::string(c->peer_ip) + "\r\n\r\n";
-    out += st.body;
     return out;
+  }
+
+  static void h1_chunk_wrap(std::string* out, const char* d, size_t n) {
+    if (n == 0) return;  // a zero-size chunk would terminate the body
+    char sz[32];
+    snprintf(sz, sizeof(sz), "%zx\r\n", n);
+    out->append(sz);
+    out->append(d, n);
+    out->append("\r\n");
+  }
+
+  // Forward one streamed request-body chunk / the end-of-body mark to
+  // the stream's upstream (called from the nghttp2 receive callbacks).
+  void h2_stream_body_chunk(Conn* c, H2Stream& st, const char* d,
+                            size_t n) {
+    if (st.up_proto_pending || st.up_queued || st.up_fd < 0) {
+      st.up_body.append(d, n);  // framed at adoption/dispatch
+      return;
+    }
+    if (st.up_h2 != nullptr) {
+      st.up_h2->append_body(d, n);
+      st.up_h2->pump_send(&st.upbuf);
+    } else if (st.up_body_chunked) {
+      h1_chunk_wrap(&st.upbuf, d, n);
+    } else {
+      st.upbuf.append(d, n);
+    }
+    h2_update_stream_events(c, st);
+  }
+
+  // Reopen the client's send window once the upstream has drained the
+  // backlog below half the cap (manual flow control: window debt
+  // accrued in h2_on_data_chunk). Must run from every path that
+  // shrinks the stream's pending bytes.
+  void h2_stream_release_window(Conn* c, int32_t sid, H2Stream& st) {
+    if (st.window_debt == 0 || c->h2 == nullptr) return;
+    size_t pending = st.upbuf.size() + st.up_body.size() +
+                     (st.up_h2 != nullptr ? st.up_h2->body.size() : 0);
+    if (pending >= kMaxBuffered / 2) return;
+    nghttp2_session_consume(c->h2, sid,
+                            static_cast<size_t>(st.window_debt));
+    st.window_debt = 0;
+    h2_flush(c);  // the WINDOW_UPDATE frames must reach the wire
+  }
+
+  void h2_stream_body_finish(Conn* c, H2Stream& st) {
+    if (st.up_proto_pending || st.up_queued || st.up_fd < 0)
+      return;  // adoption/dispatch sees st.complete and finishes
+    if (st.up_h2 != nullptr) {
+      st.up_h2->finish_body();
+      st.up_h2->pump_send(&st.upbuf);
+    } else if (st.up_body_chunked) {
+      st.upbuf += "0\r\n\r\n";
+    }
+    h2_update_stream_events(c, st);
   }
 
   static int h2_on_header(nghttp2_session*, const void* frame,
@@ -3847,15 +3974,36 @@ class Server {
                               void* user_data) {
     Conn* c = static_cast<Conn*>(user_data);
     const auto* hd = static_cast<const nghttp2_frame_hd*>(frame);
-    if ((hd->type == NGHTTP2_FRAME_HEADERS ||
-         hd->type == NGHTTP2_FRAME_DATA) &&
-        (hd->flags & NGHTTP2_FLAG_END_STREAM)) {
+    bool end_stream = (hd->flags & NGHTTP2_FLAG_END_STREAM) != 0;
+    if (hd->type == NGHTTP2_FRAME_HEADERS &&
+        (hd->flags & NGHTTP2_FLAG_END_HEADERS) != 0) {
+      auto it = c->h2_streams.find(hd->stream_id);
+      if (it == c->h2_streams.end()) return 0;
+      H2Stream& st = it->second;
+      if (!st.ready_queued) {
+        // Dispatch at END_HEADERS (the verdict tuple needs no body):
+        // request bodies STREAM to the upstream as DATA arrives, like
+        // the reference's hyper service (http_listener.rs:276).
+        st.ready_queued = true;
+        st.complete = end_stream;
+        st.p.ok = !st.p.method.empty() && !st.p.target.empty();
+        c->h2_ready.push_back(hd->stream_id);
+      } else if (end_stream && !st.complete) {
+        // TRAILERS: a second HEADERS frame carrying END_STREAM ends
+        // the body exactly like a final DATA frame would.
+        st.complete = true;
+        if (st.up_dispatched && g_server != nullptr)
+          g_server->h2_stream_body_finish(c, st);
+      }
+      return 0;
+    }
+    if (hd->type == NGHTTP2_FRAME_DATA && end_stream) {
       auto it = c->h2_streams.find(hd->stream_id);
       if (it != c->h2_streams.end() && !it->second.complete) {
-        it->second.complete = true;
-        it->second.p.ok = !it->second.p.method.empty() &&
-                          !it->second.p.target.empty();
-        c->h2_ready.push_back(hd->stream_id);
+        H2Stream& st = it->second;
+        st.complete = true;
+        if (st.up_dispatched && g_server != nullptr)
+          g_server->h2_stream_body_finish(c, st);
       }
     }
     return 0;
@@ -3866,26 +4014,54 @@ class Server {
                               size_t len, void* user_data) {
     Conn* c = static_cast<Conn*>(user_data);
     H2Stream& st = c->h2_streams[stream_id];
-    if (st.body.size() + len > kMaxBuffered) {
-      // One oversized stream must not tear the SESSION down
-      // (CALLBACK_FAILURE is connection-fatal): reset just this
-      // stream. Streaming h2 request bodies end-to-end is the known
-      // remaining delta vs hyper's fully-streamed bodies.
-      nghttp2_submit_rst_stream(sess, 0, stream_id,
-                                NGHTTP2_INTERNAL_ERROR);
-      st.body.clear();
-      st.complete = false;
+    if (st.up_dispatched && g_server != nullptr) {
+      // Streamed forwarding under manual flow control: bytes are
+      // CONSUMED (window reopened) only while the pending backlog is
+      // under half the cap; past that they accrue window debt, the
+      // client's send window closes, and the debt is released as the
+      // upstream drains (h2_stream_release_window). Bodies of ANY
+      // size stream through at the pace of the slowest hop.
+      g_server->h2_stream_body_chunk(
+          c, st, reinterpret_cast<const char*>(data), len);
+      size_t pending = st.upbuf.size() + st.up_body.size() +
+                       (st.up_h2 != nullptr ? st.up_h2->body.size() : 0);
+      if (pending < kMaxBuffered / 2) {
+        nghttp2_session_consume(sess, stream_id, len);
+      } else {
+        st.window_debt += len;
+      }
       return 0;
     }
+    // Pre-dispatch (or non-proxy outcome) bytes buffer in st.body
+    // under the same debt-based window withholding: small bodies
+    // consume freely (the verdict round-trip must not stall the
+    // client), larger ones close the window until dispatch drains the
+    // buffer — st.body stays bounded by cap/2 plus the client's
+    // in-flight window, with no resets. Debt parked on a stream that
+    // never proxies (403/captcha) is returned to the connection
+    // window at stream close.
     st.body.append(reinterpret_cast<const char*>(data), len);
+    if (st.body.size() < kMaxBuffered / 2) {
+      nghttp2_session_consume(sess, stream_id, len);
+    } else {
+      st.window_debt += len;
+    }
     return 0;
   }
 
-  static int h2_on_stream_close(nghttp2_session*, int32_t stream_id,
+  static int h2_on_stream_close(nghttp2_session* sess, int32_t stream_id,
                                 uint32_t, void* user_data) {
     Conn* c = static_cast<Conn*>(user_data);
     auto it = c->h2_streams.find(stream_id);
     if (it != c->h2_streams.end()) {
+      if (it->second.window_debt > 0) {
+        // the stream window dies with the stream, but unconsumed bytes
+        // still hold CONNECTION window — leak enough of them and every
+        // other stream on the session stalls
+        nghttp2_session_consume_connection(
+            sess, static_cast<size_t>(it->second.window_debt));
+        it->second.window_debt = 0;
+      }
       if (g_server != nullptr)
         g_server->h2_release_stream_resources(c, it->second);
       c->h2_streams.erase(it);
@@ -4407,11 +4583,17 @@ class Server {
         // EPOLLHUP fires once BOTH directions are shut — pending bytes
         // are still readable, so drain first (the read loop's r==0
         // sets client_eof). HUP cannot be masked by a 0 event mask, so
-        // an ALREADY-drained client must close here: nothing can ever
-        // be delivered to it again, and letting it loop would pin a
-        // core (each wake refreshing last_active past the idle sweep).
+        // an ALREADY-drained client is handled here: close when its
+        // relay backlog is through; otherwise stop watching the client
+        // fd entirely (nothing can arrive or be delivered) and let
+        // upstream EPOLLOUT drain the remaining upbuf tail.
         if ((events & EPOLLHUP) && c->client_eof) {
-          mark_close(c);
+          if (c->upbuf.empty()) {
+            mark_close(c);
+          } else {
+            epoll_ctl(ep_, EPOLL_CTL_DEL, c->fd, nullptr);
+            update_upstream_events(c);
+          }
           return;
         }
         on_tunnel_client_event(

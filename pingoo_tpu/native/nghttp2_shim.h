@@ -96,6 +96,18 @@ int nghttp2_submit_request(nghttp2_session* session, const void* pri_spec,
                            const nghttp2_nv* nva, size_t nvlen,
                            const nghttp2_data_provider* data_prd,
                            void* stream_user_data);
+typedef struct nghttp2_option nghttp2_option;
+int nghttp2_option_new(nghttp2_option** out);
+void nghttp2_option_del(nghttp2_option* opt);
+void nghttp2_option_set_no_auto_window_update(nghttp2_option* opt, int val);
+int nghttp2_session_server_new2(nghttp2_session** out,
+                                const nghttp2_session_callbacks* cbs,
+                                void* user_data,
+                                const nghttp2_option* opt);
+int nghttp2_session_consume(nghttp2_session* session, int32_t stream_id,
+                            size_t size);
+int nghttp2_session_consume_connection(nghttp2_session* session,
+                                       size_t size);
 int nghttp2_session_server_new(nghttp2_session** out,
                                const nghttp2_session_callbacks* cbs,
                                void* user_data);

@@ -415,6 +415,7 @@ class RingSidecar:
         self.batches = 0
         self.device_wait_s = 0.0  # blocking time on device lane results
         self._ring_rr = -1  # rotating drain start (multi-ring fairness)
+        self._thread = None  # set by run(); joined by stop()
         self._stop = False
 
     def run(self, max_requests: Optional[int] = None) -> int:
@@ -431,6 +432,12 @@ class RingSidecar:
 
         from .engine.batch import RequestBatch, bucket_arrays, pad_batch
 
+        import threading as _threading
+
+        # stop() joins this thread before callers unmap the rings — a
+        # dequeue racing Ring.close() would be a use-after-munmap
+        # segfault in the ctypes call.
+        self._thread = _threading.current_thread()
         inflight: deque = deque()
         while not self._stop:
             # One merged batch per cycle across all worker rings. The
@@ -680,5 +687,15 @@ class RingSidecar:
             "rings": len(self.rings),
         }
 
-    def stop(self) -> None:
+    def stop(self, join_timeout_s: float = 10.0) -> None:
+        """Signal the drain loop to exit and WAIT for it (when called
+        from another thread): only after this returns may the caller
+        close/unmap the rings — the loop may be mid-FFI into the
+        mapping, and pulling it out from under the call is a segfault,
+        not an exception."""
+        import threading as _threading
+
         self._stop = True
+        t = self._thread
+        if t is not None and t.is_alive()                 and t is not _threading.current_thread():
+            t.join(timeout=join_timeout_s)

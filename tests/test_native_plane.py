@@ -3085,15 +3085,35 @@ class TestH2UpstreamLargeUpload:
                 ring.close()
             pong.shutdown()
 
-    def test_oversized_h2_request_body_resets_stream_not_session(
-            self, tmp_path):
-        """An h2 DOWNSTREAM request body past the buffered cap must
-        reset that stream only — the session (and its siblings) live."""
+    def test_h2_downstream_body_past_cap_streams_through(self, tmp_path):
+        """Round 5: h2 DOWNSTREAM request bodies STREAM to the upstream
+        (dispatch at END_HEADERS) — a body far past the buffering cap
+        completes as long as the upstream keeps up, like hyper."""
         from pingoo_tpu.host import h2 as h2mod
 
         if not h2mod.available():
             pytest.skip("libnghttp2 unavailable")
-        pong = _tagged_upstream("svc-pong")
+
+        class _Count(_TaggedUpstream):
+            def do_POST(self):
+                n = int(self.headers.get("content-length", 0))
+                total, remaining = 0, n
+                while remaining:
+                    ch = self.rfile.read(min(65536, remaining))
+                    if not ch:
+                        break
+                    total += len(ch)
+                    remaining -= len(ch)
+                body = f"streamed:{total}".encode()
+                self.send_response(200)
+                self.send_header("content-length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        pong = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Count)
+        pong.tag = "cnt"
+        pong.delay_s = 0
+        threading.Thread(target=pong.serve_forever, daemon=True).start()
         port = _free_port()
         ring_path = str(tmp_path / "ring_ov")
         ring = Ring(ring_path, capacity=256, create=True)
@@ -3114,24 +3134,183 @@ class TestH2UpstreamLargeUpload:
                 conn = H2UpstreamConnection("127.0.0.1", port)
                 await conn.connect()
                 try:
-                    big = b"y" * (256 * 1024)  # 4x the cap
-                    try:
-                        await asyncio.wait_for(conn.request(
-                            "POST", "t", "/up", [("user-agent", "u")],
-                            big), 15)
-                        oversized_ok = True  # unexpected
-                    except (ConnectionError, OSError):
-                        oversized_ok = False
-                    # the SESSION must still serve new streams
-                    st, _h, body = await asyncio.wait_for(conn.request(
+                    big = b"y" * (512 * 1024)  # 8x the buffering cap
+                    r1 = await asyncio.wait_for(conn.request(
+                        "POST", "t", "/up", [("user-agent", "u")],
+                        big), 30)
+                    r2 = await asyncio.wait_for(conn.request(
                         "GET", "t", "/after", [("user-agent", "u")]), 15)
-                    return oversized_ok, st, body
+                    return r1, r2
                 finally:
                     await conn.close()
 
-            oversized_ok, st, body = asyncio.run(flow())
-            assert not oversized_ok
-            assert st == 200 and body == b"svc-pong:/after", (st, body)
+            (s1, _h1, b1), (s2, _h2, b2) = asyncio.run(flow())
+            assert s1 == 200 and b1 == b"streamed:524288", (s1, b1)
+            assert s2 == 200 and b2 == b"cnt:/after", (s2, b2)
+        finally:
+            drain.kill()
+            h.kill()
+            ring.close()
+            pong.shutdown()
+
+    def test_h2_body_to_stalled_upstream_bounded(self, tmp_path):
+        """A STALLED upstream bounds a streamed h2 body at the cap: the
+        stream errors (reset) instead of buffering without limit, and
+        the worker survives."""
+        from pingoo_tpu.host import h2 as h2mod
+
+        if not h2mod.available():
+            pytest.skip("libnghttp2 unavailable")
+        # upstream that accepts and never reads
+        ls = socket.socket()
+        ls.bind(("127.0.0.1", 0))
+        ls.listen(4)
+        held = []
+
+        def hold():
+            while True:
+                try:
+                    conn, _ = ls.accept()
+                except OSError:
+                    return
+                held.append(conn)  # never read
+
+        threading.Thread(target=hold, daemon=True).start()
+        port = _free_port()
+        ring_path = str(tmp_path / "ring_st")
+        ring = Ring(ring_path, capacity=256, create=True)
+        drain = subprocess.Popen(
+            [os.path.join(native_ring.NATIVE_DIR, "drain"), ring_path],
+            stdout=subprocess.PIPE)
+        assert b"draining" in drain.stdout.readline()
+        env = dict(os.environ)
+        env["PINGOO_MAX_BUFFER"] = "65536"
+        h = subprocess.Popen(
+            [HTTPD, str(port), ring_path, "127.0.0.1",
+             str(ls.getsockname()[1])], stdout=subprocess.PIPE, env=env)
+        assert b"listening" in h.stdout.readline()
+        try:
+            from pingoo_tpu.host.h2 import H2UpstreamConnection
+
+            async def flow():
+                conn = H2UpstreamConnection("127.0.0.1", port)
+                await conn.connect()
+                try:
+                    big = b"y" * (1024 * 1024)
+                    try:
+                        await asyncio.wait_for(conn.request(
+                            "POST", "t", "/up", [("user-agent", "u")],
+                            big), 20)
+                        return True
+                    except (ConnectionError, OSError,
+                            asyncio.TimeoutError):
+                        return False
+                finally:
+                    await conn.close()
+
+            completed = asyncio.run(flow())
+            assert not completed  # bounded: reset, not buffered forever
+            assert h.poll() is None  # worker alive
+        finally:
+            drain.kill()
+            h.kill()
+            ring.close()
+            ls.close()
+            for s in held:
+                s.close()
+
+    def test_trailers_end_the_streamed_body(self, tmp_path):
+        """An h2 request whose body ends with TRAILERS (HEADERS frame
+        carrying END_STREAM) must finish the upstream body — the
+        pre-round-5 code only ended bodies on DATA+END_STREAM."""
+        got = {}
+        done = threading.Event()
+
+        class _Cap(_TaggedUpstream):
+            def do_POST(self):
+                n = int(self.headers.get("content-length", 0) or 0)
+                if n:
+                    body = self.rfile.read(n)
+                else:
+                    # chunked from the proxy (no client content-length)
+                    body = b""
+                    while True:
+                        line = self.rfile.readline().strip()
+                        size = int(line, 16)
+                        if size == 0:
+                            self.rfile.readline()
+                            break
+                        body += self.rfile.read(size)
+                        self.rfile.readline()
+                got["body"] = body
+                done.set()
+                out = b"ok"
+                self.send_response(200)
+                self.send_header("content-length", "2")
+                self.end_headers()
+                self.wfile.write(out)
+
+        pong = http.server.ThreadingHTTPServer(("127.0.0.1", 0), _Cap)
+        pong.tag = "cap"
+        pong.delay_s = 0
+        threading.Thread(target=pong.serve_forever, daemon=True).start()
+        port = _free_port()
+        ring_path = str(tmp_path / "ring_tr")
+        ring = Ring(ring_path, capacity=256, create=True)
+        drain = subprocess.Popen(
+            [os.path.join(native_ring.NATIVE_DIR, "drain"), ring_path],
+            stdout=subprocess.PIPE)
+        assert b"draining" in drain.stdout.readline()
+        h = subprocess.Popen(
+            [HTTPD, str(port), ring_path, "127.0.0.1",
+             str(pong.server_address[1])], stdout=subprocess.PIPE)
+        assert b"listening" in h.stdout.readline()
+
+        def hp(name, value):  # HPACK literal w/o indexing, new name
+            return (b"\x00" + bytes([len(name)]) + name
+                    + bytes([len(value)]) + value)
+
+        def frame(ftype, flags, sid, payload):
+            return (len(payload).to_bytes(3, "big") + bytes([ftype, flags])
+                    + sid.to_bytes(4, "big") + payload)
+
+        try:
+            c = socket.create_connection(("127.0.0.1", port), timeout=10)
+            c.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+            c.sendall(frame(4, 0, 0, b""))  # SETTINGS
+            heads = (hp(b":method", b"POST") + hp(b":path", b"/t")
+                     + hp(b":scheme", b"http") + hp(b":authority", b"t")
+                     + hp(b"user-agent", b"trail/1.0"))
+            c.sendall(frame(1, 0x4, 1, heads))       # HEADERS, no ES
+            c.sendall(frame(0, 0, 1, b"BODYBYTES"))  # DATA, no ES
+            trailers = hp(b"x-checksum", b"abc123")
+            c.sendall(frame(1, 0x5, 1, trailers))    # trailers: ES+EH
+            assert done.wait(20), "upstream never saw the finished body"
+            assert got["body"] == b"BODYBYTES", got
+            # response HEADERS for stream 1 must come back
+            c.settimeout(10)
+            buf = b""
+            saw_resp = False
+            deadline = time.time() + 10
+            while time.time() < deadline and not saw_resp:
+                try:
+                    ch = c.recv(65536)
+                except socket.timeout:
+                    break
+                if not ch:
+                    break
+                buf += ch
+                while len(buf) >= 9:
+                    ln = int.from_bytes(buf[:3], "big")
+                    if len(buf) < 9 + ln:
+                        break
+                    ftype = buf[3]
+                    fsid = int.from_bytes(buf[5:9], "big") & 0x7FFFFFFF
+                    if ftype == 1 and fsid == 1:
+                        saw_resp = True
+                    buf = buf[9 + ln:]
+            assert saw_resp, "no response HEADERS on stream 1"
+            c.close()
         finally:
             drain.kill()
             h.kill()
