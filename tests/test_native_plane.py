@@ -2306,6 +2306,34 @@ class TestPerListenerServiceSets:
             loop_runner.run(plane.stop(), timeout=60)
 
 
+def _tcp_echo_upstream(prefix=b"echo:"):
+    """Threaded echo server replying `prefix + data` per recv; the
+    listen socket is returned (close() stops the accept loop)."""
+    ls = socket.socket()
+    ls.bind(("127.0.0.1", 0))
+    ls.listen(8)
+
+    def serve():
+        while True:
+            try:
+                conn, _ = ls.accept()
+            except OSError:
+                return
+
+            def pump(conn=conn):
+                while True:
+                    d = conn.recv(4096)
+                    if not d:
+                        break
+                    conn.sendall(prefix + d)
+                conn.close()
+
+            threading.Thread(target=pump, daemon=True).start()
+
+    threading.Thread(target=serve, daemon=True).start()
+    return ls
+
+
 class TestNativeTcpFronting:
     """VERDICT r4 item 3: TCP(+TLS) listeners are fronted by the C++
     plane (tcp-proxy mode — accept, optional TLS terminate, random
@@ -2314,29 +2342,7 @@ class TestNativeTcpFronting:
     tcp_proxy_service.rs:30-84). Python is control plane only."""
 
     def _echo_upstream(self):
-        ls = socket.socket()
-        ls.bind(("127.0.0.1", 0))
-        ls.listen(8)
-
-        def serve():
-            while True:
-                try:
-                    conn, _ = ls.accept()
-                except OSError:
-                    return
-
-                def pump(conn=conn):
-                    while True:
-                        d = conn.recv(4096)
-                        if not d:
-                            break
-                        conn.sendall(b"echo:" + d)
-                    conn.close()
-
-                threading.Thread(target=pump, daemon=True).start()
-
-        threading.Thread(target=serve, daemon=True).start()
-        return ls
+        return _tcp_echo_upstream(b"echo:")
 
     def _config(self, tmp_path, proto, tcp_port, http_port, up_port,
                 echo_port):
@@ -3316,3 +3322,122 @@ class TestH2UpstreamLargeUpload:
             h.kill()
             ring.close()
             pong.shutdown()
+
+
+class TestFullStackCombinedConfig:
+    """One CLI-driven config exercising every native-plane capability
+    at once: an h2:// upstream service with a route, a static service
+    with a route, a catch-all h1 proxy, a WAF rule, and a native TCP
+    listener — the closest thing to a production deployment the test
+    suite drives."""
+
+    def test_cli_combined_deployment(self, tmp_path, loop_runner):
+        import textwrap
+        import urllib.request
+
+        from pingoo_tpu.config import load_and_validate
+        from pingoo_tpu.host.native_plane import NativePlane
+
+        # h2c upstream: a second native httpd fronting a tagged pong
+        pong = _tagged_upstream("svc-pong")
+        h2_port = _free_port()
+        ring_b = Ring(str(tmp_path / "rb"), capacity=256, create=True)
+        drain_b = subprocess.Popen(
+            [os.path.join(native_ring.NATIVE_DIR, "drain"),
+             str(tmp_path / "rb")], stdout=subprocess.PIPE)
+        assert b"draining" in drain_b.stdout.readline()
+        h2up = subprocess.Popen(
+            [HTTPD, str(h2_port), str(tmp_path / "rb"), "127.0.0.1",
+             str(pong.server_address[1])], stdout=subprocess.PIPE)
+        assert b"listening" in h2up.stdout.readline()
+
+        echo = _tcp_echo_upstream(b"tcp:")
+
+        site = tmp_path / "site"
+        (site / "static").mkdir(parents=True)
+        # the `site` route matches /static/*; paths resolve under the
+        # root, so the file lives at <root>/static/page.html
+        (site / "static" / "page.html").write_text("<h1>combined</h1>")
+        app = _tagged_upstream("svc-app")
+        port, tcp_port = _free_port(), _free_port()
+        cfg = tmp_path / "pingoo.yml"
+        cfg.write_text(textwrap.dedent(f"""
+        listeners:
+          main:
+            address: "http://127.0.0.1:{port}"
+            services: [api, site, app]
+          db:
+            address: "tcp://127.0.0.1:{tcp_port}"
+            services: [dbsvc]
+        services:
+          api:
+            http_proxy: ["h2://127.0.0.1:{h2_port}"]
+            route: http_request.path.starts_with("/api")
+          site:
+            static: {{root: "{site}"}}
+            route: http_request.path.starts_with("/static")
+          app:
+            http_proxy: ["http://127.0.0.1:{app.server_address[1]}"]
+          dbsvc:
+            tcp_proxy: ["tcp://127.0.0.1:{echo.getsockname()[1]}"]
+        rules:
+          block-env:
+            expression: http_request.path.starts_with("/.env")
+            actions: [{{action: block}}]
+        """))
+        config = load_and_validate(str(cfg))
+        plane = NativePlane(
+            config, state_dir=str(tmp_path / "state"), use_device=False,
+            enable_docker=False,
+            geoip_paths=(str(tmp_path / "missing.mmdb"),),
+            captcha_jwks_path=str(tmp_path / "jwks.json"),
+            tls_dir=str(tmp_path / "tls"))
+        loop_runner.run(plane.start(), timeout=180)
+        try:
+            def get(path):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}{path}",
+                    headers={"user-agent": "full/1.0"})
+                try:
+                    with urllib.request.urlopen(req, timeout=30) as r:
+                        return r.status, r.read()
+                except urllib.error.HTTPError as e:
+                    return e.code, e.read()
+
+            # warm routing (fail-open to service 0 during first compile)
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                st, body = get("/")
+                if st == 200 and b"svc-app:/" in body:
+                    break
+                time.sleep(0.5)
+            assert b"svc-app:/" in body, (st, body)
+            # h2:// upstream, natively framed
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                st, body = get("/api/x")
+                if body == b"svc-pong:/api/x":
+                    break
+                time.sleep(0.5)
+            assert st == 200 and body == b"svc-pong:/api/x", (st, body)
+            # native static (with .html prettify) via the routed service
+            st, body = get("/static/page")
+            assert st == 200 and b"<h1>combined</h1>" in body, (st, body)
+            # WAF applies before everything
+            st, _ = get("/.env")
+            assert st == 403
+            # native tcp
+            c = socket.create_connection(("127.0.0.1", tcp_port),
+                                         timeout=10)
+            c.settimeout(10)
+            c.sendall(b"ping")
+            assert c.recv(100) == b"tcp:ping"
+            c.close()
+        finally:
+            loop_runner.run(plane.stop(), timeout=60)
+            drain_b.kill()
+            h2up.kill()
+            ring_b.close()
+            echo.close()
+            pong.shutdown()
+            app.shutdown()
