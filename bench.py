@@ -522,7 +522,10 @@ def _main_guarded(result: dict) -> int:
         os.environ.setdefault("BENCH_ITERS", "10")
         os.environ.setdefault("BENCH_SKIP_BLOCKLIST", "1")
         os.environ.setdefault("BENCH_SKIP_E2E", "1")
-        os.environ.setdefault("BENCH_SKIP_DATAPLANE", "1")
+        # The dataplane bench is DEVICE-INDEPENDENT (native drain, no
+        # accelerator in the loop): keep it so the artifact still
+        # carries a real native-plane measurement when the chip is
+        # unreachable.
     else:
         result["backend"] = "device"
         result["backend_probe"] = info
