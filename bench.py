@@ -523,9 +523,16 @@ def _main_guarded(result: dict) -> int:
         os.environ.setdefault("BENCH_SKIP_BLOCKLIST", "1")
         os.environ.setdefault("BENCH_SKIP_E2E", "1")
         # The dataplane bench is DEVICE-INDEPENDENT (native drain, no
-        # accelerator in the loop): keep it so the artifact still
-        # carries a real native-plane measurement when the chip is
-        # unreachable.
+        # accelerator in the loop): run it FIRST so the artifact
+        # carries a real native-plane measurement even if the CPU XLA
+        # pipeline below also fails on this degraded host (the error
+        # line includes every partial result).
+        if os.environ.get("BENCH_SKIP_DATAPLANE") != "1":
+            try:
+                result.update(bench_dataplane())
+            except Exception as exc:
+                result["dataplane_error"] = repr(exc)[:200]
+            os.environ["BENCH_SKIP_DATAPLANE"] = "1"  # ran already
     else:
         result["backend"] = "device"
         result["backend_probe"] = info

@@ -60,3 +60,20 @@ def loop_runner():
     runner = LoopRunner()
     yield runner
     runner.close()
+
+
+def pytest_configure(config):
+    """Build ALL native binaries up front when a toolchain exists: many
+    tests exec `httpd`/`drain`/`loadgen*`/`pong` directly (they are
+    build outputs, not committed), and a fresh tree would otherwise
+    fail on the first direct spawn rather than the build."""
+    import subprocess
+
+    from pingoo_tpu import native_ring
+
+    try:
+        subprocess.run(["make", "-C", native_ring.NATIVE_DIR, "all"],
+                       check=True, capture_output=True, timeout=300)
+    except (subprocess.CalledProcessError,
+            subprocess.TimeoutExpired, FileNotFoundError):
+        pass  # per-test skips/spawn errors will say what's missing
