@@ -256,7 +256,8 @@ def bench_dataplane(n_requests: int = 200_000) -> dict:
                   "drain"], check=True, capture_output=True)
 
     # Defaults tuned for THIS 1-CPU host (nproc == 1): one worker and
-    # c=128 measured fastest (14.1k req/s, p99 16 ms); more workers just
+    # c=128 measured fastest (~23k req/s, p99 <= 10 ms with the native
+    # drain; the old Python drain measured 14.1k); more workers just
     # time-share the core. On a multi-core host raise BENCH_DP_WORKERS /
     # BENCH_DP_LOADGENS to exercise the SO_REUSEPORT + ring-per-worker
     # sharding this bench is built on.

@@ -69,11 +69,12 @@ def pytest_configure(config):
     fail on the first direct spawn rather than the build."""
     import subprocess
 
-    from pingoo_tpu import native_ring
-
     try:
+        from pingoo_tpu import native_ring
+
         subprocess.run(["make", "-C", native_ring.NATIVE_DIR, "all"],
                        check=True, capture_output=True, timeout=300)
-    except (subprocess.CalledProcessError,
-            subprocess.TimeoutExpired, FileNotFoundError):
-        pass  # per-test skips/spawn errors will say what's missing
+    except Exception:
+        # Never abort the session from this convenience hook: per-test
+        # skips/spawn errors will say what's missing.
+        pass
