@@ -490,7 +490,7 @@ class TestMultiSeedDifferential:
         from pingoo_tpu.engine.verdict import interpret_rules_row
         from pingoo_tpu.utils.crs import generate_ruleset, generate_traffic
 
-        for seed in (7, 1234, 999983):
+        for seed in (7, 1234, 999983, 31337, 2026):
             rules, lists = generate_ruleset(
                 80, with_lists=True, list_sizes=(512, 64), seed=seed)
             plan = compile_ruleset(rules, lists)
