@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native test check bench clean
+.PHONY: all native test check bench audit asan clean
 
 all: native
 
@@ -18,6 +18,26 @@ check:
 
 bench: native
 	$(PY) bench.py
+
+# Dependency audit — the reference ships .github/workflows/audit.yml
+# (cargo audit + cargo deny); the equivalent here is pip-audit over the
+# Python environment plus the EXACT native runtime libraries the data
+# plane links (the image has no dev packages to query, so surface the
+# versioned sonames for CVE review). pip-audit needs network; when it
+# is unavailable the target still emits the frozen dependency list for
+# an offline scanner.
+audit:
+	@$(PY) -m pip_audit 2>/dev/null || \
+		{ echo "pip-audit unavailable/offline; frozen deps for offline review:"; \
+		  $(PY) -m pip freeze; }
+	@echo "-- native plane runtime libraries --"
+	@ldconfig -p | grep -E 'libssl|libcrypto|libnghttp2' || true
+	@if [ -x pingoo_tpu/native/httpd ]; then \
+		ldd pingoo_tpu/native/httpd | grep -E 'ssl|crypto|nghttp2'; fi
+
+# ASAN/UBSAN build of the native data plane (httpd_asan).
+asan:
+	$(MAKE) -C pingoo_tpu/native asan
 
 clean:
 	$(MAKE) -C pingoo_tpu/native clean

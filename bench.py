@@ -125,6 +125,79 @@ def bench_blocklist_1m(iters: int = 50, batch: int = 8192) -> dict:
     }
 
 
+def autotune_scan_strategies(plan, tables, arrays, iters: int = 30) -> dict:
+    """Micro-autotune hook: measure the per-LOOP-ITERATION cost of each
+    NFA scan strategy (lax.scan single/pair, fused Pallas single/pair)
+    on the LIVE backend with the same chained-salted-loop method as the
+    headline bench, on the widest bank (it dominates the verdict).
+    Returns a DEFAULT_STEP_COSTS-shaped dict (relative to "scan") for
+    compiler.plan.reselect_scan_strategies; {} when there is no bank."""
+    import jax
+    import jax.numpy as jnp
+
+    from pingoo_tpu.ops.nfa_scan import (extract_slots, init_scan_state,
+                                         scan_chunk)
+
+    keys = [k for k in plan.scan_plans if k in tables]
+    if not keys:
+        return {}
+    key = max(keys, key=lambda k: int(tables[k].opt.shape[0]))
+    bank = tables[key]
+    field = key[len("nfa_"):]
+    data = arrays[f"{field}_bytes"]
+    lens = arrays[f"{field}_len"]
+    B, L = data.shape
+    W = int(bank.opt.shape[0])
+    variants = {
+        "scan": (None, None),
+        "pair": ("pair", None),
+        "pallas": (None, "pallas"),
+        "pallas_pair": ("pair", "pallas"),
+    }
+    raw = {}
+    for name, (lookup, backend) in variants.items():
+        loop_iters = (L + 1) // 2 if lookup == "pair" else L
+
+        @jax.jit
+        def run_n(data, lens, n, lookup=lookup, backend=backend):
+            def body(i, acc):
+                # salt from the carried checksum + loop index: no
+                # loop-invariant inputs for XLA to hoist (see the
+                # headline bench's measurement notes).
+                salted = data ^ ((acc + i) % 2).astype(jnp.uint8)
+                state = scan_chunk(bank, salted, lens,
+                                   init_scan_state(B, W), 0,
+                                   lookup=lookup, backend=backend)
+                hits = extract_slots(bank, state, lens)
+                return acc + hits.sum().astype(jnp.int64)
+
+            return jax.lax.fori_loop(0, n, body, jnp.int64(0))
+
+        @jax.jit
+        def floor_loop(data, n):
+            def body(i, acc):
+                return acc + data.sum().astype(jnp.int64) + i
+
+            return jax.lax.fori_loop(0, n, body, jnp.int64(0))
+
+        try:
+            int(run_n(data, lens, 2))
+            int(floor_loop(data, 2))
+            t0 = time.time()
+            int(floor_loop(data, iters))
+            floor = time.time() - t0
+            t0 = time.time()
+            int(run_n(data, lens, iters))
+            full = time.time() - t0
+        except Exception:
+            continue  # a strategy that fails to compile is never selected
+        raw[name] = max(full - floor, 1e-9) / iters / loop_iters
+    base = raw.get("scan")
+    if not base:
+        return {}
+    return {k: v / base for k, v in raw.items()}
+
+
 def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
     """Committed end-to-end drive: loadgen_http -> httpd -> ring ->
     sidecar (device lane verdict) -> 403 / proxy -> pong."""
@@ -671,6 +744,32 @@ def _main_impl(result: dict, done=None) -> None:
         "build_s": round(build_s, 1),
         "compile_s": round(compile_s, 1),
     })
+    # Micro-autotune: replace the plan's default cost-model strategy
+    # selection with MEASURED per-iteration costs, and persist the tuned
+    # plan into the artifact cache when one is configured — runs on a
+    # real device backend by default (the CPU backend inverts the
+    # relative costs; BENCH_AUTOTUNE=force measures anyway, =0 skips).
+    autotune = os.environ.get("BENCH_AUTOTUNE", "auto")
+    if autotune != "0" and (result.get("backend") == "device"
+                            or autotune == "force"):
+        try:
+            from pingoo_tpu.compiler.plan import reselect_scan_strategies
+
+            costs = autotune_scan_strategies(plan, tables, arrays)
+            if costs:
+                reselect_scan_strategies(plan, costs)
+                result["autotune_costs"] = {
+                    k: round(v, 4) for k, v in costs.items()}
+                result["autotune_selected"] = {
+                    k: e.strategy.kind + ("+pair" if e.strategy.pair else "")
+                    for k, e in plan.scan_plans.items()}
+                cache_dir = os.environ.get("PINGOO_CACHE_DIR")
+                if cache_dir:
+                    from pingoo_tpu.compiler.cache import update_cached_plan
+
+                    update_cached_plan(rules, lists, plan, cache_dir)
+        except Exception as exc:
+            result["autotune_error"] = repr(exc)[:200]
     if os.environ.get("BENCH_SKIP_BLOCKLIST") != "1":
         try:
             result.update(bench_blocklist_1m())

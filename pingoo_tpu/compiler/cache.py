@@ -23,9 +23,10 @@ from typing import Optional
 
 from ..config.schema import RuleConfig
 from ..expr.values import Ip
-from .plan import RulesetPlan, compile_ruleset
+from .plan import RulesetPlan, compile_ruleset, split_config_token
 
-FORMAT_VERSION = 7  # bump when plan/table layout changes
+FORMAT_VERSION = 8  # bump when plan/table layout changes
+# v8: scan_plans (per-bank strategy selection, halo partition sub-banks)
 
 
 def ruleset_fingerprint(rules: list[RuleConfig], lists: dict,
@@ -34,6 +35,9 @@ def ruleset_fingerprint(rules: list[RuleConfig], lists: dict,
 
     h = hashlib.sha256()
     h.update(str(FORMAT_VERSION).encode())
+    # Plan-shaping env knobs (halo partition on/off + footprint budget)
+    # change the np_tables layout, so they are part of the identity.
+    h.update(split_config_token().encode())
     h.update(repr(sorted((field_specs or DEFAULT_FIELD_SPECS).items())).encode())
     for rule in rules:
         h.update(rule.name.encode())
@@ -73,6 +77,25 @@ def compile_ruleset_cached(
     plan = compile_ruleset(rules, lists, field_specs, routes=routes)
     _save(path, fingerprint, plan)
     return plan
+
+
+def update_cached_plan(
+    rules: list[RuleConfig],
+    lists: dict,
+    plan: RulesetPlan,
+    cache_dir: str,
+    field_specs=None,
+    routes=None,
+) -> str:
+    """Re-persist a (mutated) plan under its ruleset fingerprint — the
+    path bench.py's micro-autotune uses to record measured scan-strategy
+    selections (plan.scan_plans) into the artifact cache so the next
+    boot starts from the tuned choice. Returns the artifact path."""
+    fingerprint = ruleset_fingerprint(rules, lists, field_specs,
+                                      routes=routes)
+    path = os.path.join(cache_dir, f"ruleset-{fingerprint[:32]}.plan")
+    _save(path, fingerprint, plan)
+    return path
 
 
 def _save(path: str, fingerprint: str, plan: RulesetPlan) -> None:

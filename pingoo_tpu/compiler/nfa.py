@@ -343,6 +343,22 @@ def scan_bits_needed(lp: LinearPattern) -> int:
     return total
 
 
+def pattern_footprint(lp: LinearPattern) -> int:
+    """Largest single-alternative footprint (guard + positions + sticky)
+    after expansion — an upper bound on the byte memory the halo scans
+    must warm up for this pattern. 0 for never/always patterns (they
+    carry no device state)."""
+    if lp.never_match:
+        return 0
+    ends = lp.anchor_end or lp.anchor_end_abs
+    if lp.min_len == 0 and not (lp.anchor_start and ends):
+        return 0
+    subs = _expand_scan_patterns(lp)
+    if not subs:
+        return 0
+    return max(2 + len(s.positions) + (1 if s.sticky else 0) for s in subs)
+
+
 class _BankBuilder:
     """Mutable word-table state shared by both packing paths."""
 

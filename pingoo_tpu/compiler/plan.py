@@ -20,7 +20,8 @@ one XLA program per batch shape.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field as dc_field
+import os
+from dataclasses import dataclass, field as dc_field, replace as dc_replace
 from typing import Any, Optional
 
 import numpy as np
@@ -47,6 +48,192 @@ from ..ops.cidr import build_cidr_table, build_int_set, build_v4_buckets, ip_to_
 from ..ops.match_ops import build_pattern_table, build_suffix_table
 from ..ops.nfa_scan import bank_to_tables
 from ..ops.window_match import build_window_table
+
+
+# -- NFA scan strategy selection ---------------------------------------------
+#
+# The roofline (docs/ROOFLINE.md) showed the verdict kernel bound by the
+# serial NFA scan chain: per-LOOP-ITERATION dispatch/dependency latency,
+# not per-byte work. The levers that cut iterations (pair stepping, the
+# within-device halo split) and the fused Pallas kernel that cuts
+# per-iteration cost used to hang off env knobs (PINGOO_NFA_LOOKUP /
+# PINGOO_HALO_SPLIT); they are now selected PER BANK at plan time, the
+# choice travels with the plan through the ruleset artifact cache
+# (compiler/cache.py), and bench.py's micro-autotune hook can re-select
+# from measured per-iteration costs (`reselect_scan_strategies`).
+
+# Relative cost of ONE scan-loop iteration per strategy kind. The
+# defaults are placeholders that encode the dispatch-bound ordering the
+# roofline measured (a fused kernel iteration ~ the execution floor, a
+# pair iteration slightly dearer than a single gather but half as many
+# of them); bench.py --autotune replaces them with measured values on a
+# live backend.
+DEFAULT_STEP_COSTS = {
+    "scan": 1.0,        # lax.scan, one [256/C, W] gather per byte
+    "pair": 1.3,        # lax.scan, one [C^2, 2W] gather per TWO bytes
+    "pallas": 0.25,     # fused kernel, one fused lookup+advance per byte
+    "pallas_pair": 0.35,  # fused kernel, two bytes per loop iteration
+}
+
+
+@dataclass(frozen=True)
+class ScanStrategy:
+    """One bank's selected scan execution strategy (static plan metadata).
+
+    kind    — "scan" (lax.scan) or "pallas" (fused kernel,
+              ops/pallas_scan.py)
+    pair    — advance two bytes per loop iteration (the pair lookup for
+              lax.scan, 2x-unrolled stepping inside the Pallas kernel)
+    halo_k  — maximum within-device halo split factor to ATTEMPT at
+              trace time (halo_split_k re-checks eligibility against the
+              actual bucketed length; 1 disables)
+    source  — "default" (cost model), "measured" (bench autotune),
+              "env" (PINGOO_SCAN_STRATEGY override)
+    cost    — modeled relative per-iteration cost at selection time
+    """
+
+    kind: str = "scan"
+    pair: bool = False
+    halo_k: int = 1
+    source: str = "default"
+    cost: float = 0.0
+
+
+@dataclass(frozen=True)
+class NfaScanPlan:
+    """Plan-time scan decisions for one field's NFA bank (static; rides
+    the plan pickle into the artifact cache).
+
+    When the halo partition is active, `split` names the two np_tables
+    sub-bank keys ("<key>@short" halo-splittable, "<key>@rest"
+    residual) and `slot_perm[p]` maps logical pattern slot p to its
+    column in concat(short_hits, rest_hits); the whole-bank table stays
+    at `key` for the parallel (mesh/ring) paths."""
+
+    key: str
+    strategy: ScanStrategy
+    split: tuple[str, str] | None = None
+    short_strategy: ScanStrategy | None = None
+    rest_strategy: ScanStrategy | None = None
+    slot_perm: tuple[int, ...] | None = None
+    extended: bool = False  # footprint-extension rewrote the main bank
+
+
+def _pallas_ok() -> bool:
+    try:
+        from ..ops.pallas_scan import pallas_available
+
+        return pallas_available()
+    except Exception:
+        return False
+
+
+def select_scan_strategy(tables, costs: dict | None = None,
+                         pallas_ok: bool | None = None,
+                         source: str = "default") -> ScanStrategy:
+    """Pick the cheapest (kind, pair) for one bank under a per-iteration
+    cost model; iteration counts scale the pair variants by 1/2, so the
+    ranking is independent of the (trace-time) field length. halo_k is
+    eligibility metadata: halo re-checks profitability at trace time."""
+    c = dict(DEFAULT_STEP_COSTS)
+    c.update(costs or {})
+    if pallas_ok is None:
+        pallas_ok = _pallas_ok()
+    cands = [("scan", False, c["scan"]), ("scan", True, c["pair"] / 2)]
+    if pallas_ok:
+        cands += [("pallas", False, c["pallas"]),
+                  ("pallas", True, c["pallas_pair"] / 2)]
+    kind, pair, cost = min(cands, key=lambda x: x[2])
+    halo_k = 8 if tables.halo_ok else 1
+    return ScanStrategy(kind=kind, pair=pair, halo_k=halo_k,
+                        source=source, cost=cost)
+
+
+def strategy_steps(tables, L: int, strat: ScanStrategy) -> int:
+    """Dependent-step count of `strat` on this bank at bucketed length L
+    (the roofline convention: loop iterations x opt-propagation passes).
+    Accounts for a trace-time halo split when the strategy would take
+    it."""
+    from ..ops.nfa_scan import halo_split_k
+
+    passes = 1 + tables.extra_passes
+    iters = (L + 1) // 2 if strat.pair else L
+    if strat.halo_k > 1:
+        k = halo_split_k(tables, L, max_k=strat.halo_k)
+        if k > 1:
+            halo_iters = L // k + int(tables.max_footprint)
+            if halo_iters < iters:
+                iters = halo_iters
+    return iters * passes
+
+
+def _halo_fp_budget() -> int:
+    return int(os.environ.get("PINGOO_HALO_FP_BUDGET", "16"))
+
+
+def _split_enabled() -> bool:
+    return os.environ.get("PINGOO_NFA_SPLIT", "0") != "0"
+
+
+def split_config_token() -> str:
+    """The plan-shaping env knobs, hashed into the artifact-cache
+    fingerprint: plans built under different split settings have
+    different np_tables layouts."""
+    return f"nfa_split={int(_split_enabled())}:fp={_halo_fp_budget()}"
+
+
+def _halo_partition(patterns, field_len: int):
+    """Footprint-extension pass + partition for one field's patterns.
+
+    Each pattern is made halo-compatible when possible: rep-free already,
+    or rewritten by repat.extend_footprint (exact over the field's
+    device byte cap). Patterns whose bounded footprint fits the halo
+    budget form the `short` (halo-splittable) set; the rest keep their
+    original form. Returns (short_idx, rest_idx, short_pats, rest_pats)
+    or None when the partition is degenerate (no residual bank needed —
+    caller handles the all-short case via whole-bank extension)."""
+    from .nfa import MAX_SCAN_BITS, pattern_footprint, scan_bits_needed
+
+    budget = _halo_fp_budget()
+    short_idx, rest_idx = [], []
+    short_pats, rest_pats = [], []
+    for i, lp in enumerate(patterns):
+        cand = lp
+        if repat.has_unbounded_rep(lp):
+            cand = repat.extend_footprint(lp, field_len)
+        ok = cand is not None and not repat.has_unbounded_rep(cand)
+        if ok:
+            try:
+                ok = (pattern_footprint(cand) <= budget
+                      and scan_bits_needed(cand) <= MAX_SCAN_BITS)
+            except repat.Unsupported:
+                ok = False
+        if ok:
+            short_idx.append(i)
+            short_pats.append(cand)
+        else:
+            rest_idx.append(i)
+            rest_pats.append(lp)
+    if not short_idx or not rest_idx:
+        return None
+    return short_idx, rest_idx, short_pats, rest_pats
+
+
+def reselect_scan_strategies(plan: "RulesetPlan",
+                             costs: dict | None = None,
+                             source: str = "measured") -> None:
+    """Re-run strategy selection (e.g. with measured per-iteration costs
+    from bench.py's autotune hook) and update the plan in place. Callers
+    persist via compiler.cache.update_cached_plan."""
+    for key, entry in list(plan.scan_plans.items()):
+        kwargs = {"strategy": select_scan_strategy(
+            plan.np_tables[key], costs, source=source)}
+        if entry.split:
+            kwargs["short_strategy"] = select_scan_strategy(
+                plan.np_tables[entry.split[0]], costs, source=source)
+            kwargs["rest_strategy"] = select_scan_strategy(
+                plan.np_tables[entry.split[1]], costs, source=source)
+        plan.scan_plans[key] = dc_replace(entry, **kwargs)
 
 
 @dataclass
@@ -85,6 +272,8 @@ class RulesetPlan:
     stats: dict[str, int] = dc_field(default_factory=dict)
     # service name -> pseudo-rule column for its route predicate
     route_index: dict[str, int] = dc_field(default_factory=dict)
+    # per-NFA-bank scan strategy decisions (static; cached with the plan)
+    scan_plans: dict[str, NfaScanPlan] = dc_field(default_factory=dict)
 
     def device_tables(self) -> dict[str, Any]:
         """Materialize all tables as device arrays (a pytree)."""
@@ -259,8 +448,7 @@ def _assemble_tables(plan: RulesetPlan) -> None:
                 kind="nfa", field=field, span=(start, len(patterns)),
                 table_key=f"nfa_{field}")
         if patterns:
-            bank = build_bank(patterns)
-            plan.np_tables[f"nfa_{field}"] = bank_to_tables(bank)
+            _plan_nfa_bank(plan, field, patterns)
         if win_patterns:
             plan.np_tables[f"win_{field}"] = build_window_table(win_patterns)
 
@@ -276,3 +464,83 @@ def _assemble_tables(plan: RulesetPlan) -> None:
             plan.bindings[leaf_id] = LeafBinding(kind="ip_one", col=col,
                                                  table_key="ip_preds")
         plan.np_tables["ip_preds"] = {"nets": nets, "masks": masks}
+
+
+def _plan_nfa_bank(plan: RulesetPlan, field: str,
+                   patterns: list) -> None:
+    """Build one field's NFA tables + scan plan.
+
+    Footprint-extension / halo pipeline (docs/ROOFLINE.md lever 1):
+
+      * if EVERY pattern is halo-compatible after repat.extend_footprint
+        (exact over the field's device byte cap), the main bank itself is
+        rebuilt bounded — whole-bank halo_ok, no extra tables;
+      * else, with PINGOO_NFA_SPLIT=1, the bank is PARTITIONED: patterns
+        whose bounded footprint fits the halo budget form a
+        halo-splittable `@short` sub-bank, the rest (wide spans,
+        unboundable reps) a `@rest` residual sub-bank stepping by pairs —
+        the whole-bank table stays for the mesh/ring parallel paths;
+      * the scan strategy (lax.scan vs fused Pallas, single vs pair
+        step) is selected per bank by the cost model and recorded in
+        plan.scan_plans, so it persists through the artifact cache.
+    """
+    from .nfa import MAX_SCAN_BITS, pattern_footprint, scan_bits_needed
+
+    key = f"nfa_{field}"
+    field_len = plan.field_specs.get(field, 2048)
+    bank = build_bank(patterns)
+    tables = bank_to_tables(bank)
+    extended = False
+    if not tables.halo_ok:
+        # Whole-bank footprint extension: only worth the extra width if
+        # every rep pattern bounds within the device caps.
+        cands = []
+        for lp in patterns:
+            cand = repat.extend_footprint(lp, field_len) \
+                if repat.has_unbounded_rep(lp) else lp
+            if cand is None or repat.has_unbounded_rep(cand):
+                cands = None
+                break
+            try:
+                if scan_bits_needed(cand) > MAX_SCAN_BITS:
+                    cands = None
+                    break
+            except repat.Unsupported:
+                cands = None
+                break
+            cands.append(cand)
+        if cands is not None:
+            ext_tables = bank_to_tables(build_bank(cands))
+            if ext_tables.halo_ok:
+                tables = ext_tables
+                extended = True
+    plan.np_tables[key] = tables
+
+    split = None
+    short_strategy = rest_strategy = None
+    slot_perm = None
+    if _split_enabled() and not tables.halo_ok:
+        parts = _halo_partition(patterns, field_len)
+        if parts is not None:
+            short_idx, rest_idx, short_pats, rest_pats = parts
+            short_tables = bank_to_tables(build_bank(short_pats))
+            rest_tables = bank_to_tables(build_bank(rest_pats))
+            plan.np_tables[f"{key}@short"] = short_tables
+            plan.np_tables[f"{key}@rest"] = rest_tables
+            order = short_idx + rest_idx
+            perm = [0] * len(order)
+            for col, p in enumerate(order):
+                perm[p] = col
+            slot_perm = tuple(perm)
+            split = (f"{key}@short", f"{key}@rest")
+            short_strategy = select_scan_strategy(short_tables)
+            rest_strategy = select_scan_strategy(rest_tables)
+    plan.scan_plans[key] = NfaScanPlan(
+        key=key,
+        strategy=select_scan_strategy(tables),
+        split=split,
+        short_strategy=short_strategy,
+        rest_strategy=rest_strategy,
+        slot_perm=slot_perm,
+        extended=extended,
+    )

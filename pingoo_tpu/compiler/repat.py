@@ -877,3 +877,75 @@ def _merge_single_char_alts(alts: list[list[_Item]]) -> Pos | None:
             return None
         members |= item.pos.bytes
     return Pos(bytes=frozenset(members))
+
+
+# -- footprint extension (halo enablement) ------------------------------------
+#
+# The halo-parallel scans (ops/nfa_scan.halo_split_scan within a device,
+# parallel/ring.halo_nfa_scan across devices) require BOUNDED automaton
+# memory: every self-loop must be a sticky accept accumulator, which a
+# true x* / x+ self-loop (Quant.STAR / Quant.PLUS rep bit) is not. This
+# pass trades the unbounded loop for an EXTENDED bounded footprint: each
+# repeat run is rewritten into an optional run long enough that, over
+# the engine's truncated field view (every input the scan ever sees is
+# at most `max_len` bytes), no match is lost — so the rewrite is exact
+# by construction, not an approximation. The price is width: a run can
+# need up to max_len - min_len optional positions, so the pass only
+# succeeds for patterns/fields where that fits the device caps; callers
+# (compiler/plan.py's halo partitioner) treat None as "keep the rep
+# form and exclude from halo".
+
+
+def has_unbounded_rep(lp: LinearPattern) -> bool:
+    """True when the pattern carries a real (non-sticky) self-loop."""
+    return any(p.quant in (Quant.STAR, Quant.PLUS) for p in lp.positions)
+
+
+def extend_footprint(lp: LinearPattern, max_len: int) -> LinearPattern | None:
+    """Rewrite every x*/x+ into a bounded optional run, exact for inputs
+    of length <= max_len (the field's device byte cap).
+
+    x+ becomes x x{0,r} (or x{0,r} x when the position must stay the
+    pattern's last for a trailing \b); x* becomes x{0,r}; r is
+    max_len - min_len, the longest any single run can be inside a
+    max_len-byte window with the pattern's other required positions
+    still present. Returns None when the expansion exceeds
+    MAX_POSITIONS or a boundary constraint cannot be preserved.
+    """
+    if lp.never_match or not has_unbounded_rep(lp):
+        return lp
+    r = max(max_len - lp.min_len, 0)
+    out: list[Pos] = []
+    last_i = len(lp.positions) - 1
+    for i, p in enumerate(lp.positions):
+        if p.quant == Quant.STAR:
+            if (i == 0 and lp.boundary_start) or \
+                    (i == last_i and lp.boundary_end):
+                return None  # parser rejects these; stay conservative
+            out.extend(Pos(bytes=p.bytes, quant=Quant.OPT) for _ in range(r))
+        elif p.quant == Quant.PLUS:
+            opts = [Pos(bytes=p.bytes, quant=Quant.OPT) for _ in range(r)]
+            if i == last_i and lp.boundary_end:
+                if i == 0 and lp.boundary_start and r > 0:
+                    # one position that must stay both first and last:
+                    # no placement satisfies both boundary checks
+                    return None
+                out.extend(opts)
+                out.append(Pos(bytes=p.bytes, quant=Quant.ONE))
+            else:
+                out.append(Pos(bytes=p.bytes, quant=Quant.ONE))
+                out.extend(opts)
+        else:
+            out.append(p)
+    if len(out) > MAX_POSITIONS:
+        return None
+    ext = LinearPattern(
+        positions=out,
+        anchor_start=lp.anchor_start,
+        anchor_end=lp.anchor_end,
+        anchor_end_abs=lp.anchor_end_abs,
+        boundary_start=lp.boundary_start,
+        boundary_end=lp.boundary_end,
+        never_match=lp.never_match,
+    )
+    return ext

@@ -42,43 +42,66 @@ from ..compiler.lowering import (
     NNeg,
     NumCmp,
 )
-from ..compiler.plan import RulesetPlan
+from ..compiler.plan import NfaScanPlan, RulesetPlan, ScanStrategy
 from ..config.schema import Action
 from ..expr import execute_as_bool
 from ..ops.cidr import cidr_contains, int_set_contains, v4_buckets_contains
 from ..ops.match_ops import eq_match, prefix_match, suffix_match
 from ..ops.nfa_scan import (extract_slots, halo_split_k, halo_split_scan,
-                            nfa_scan, packed_scan_states)
+                            init_scan_state, packed_scan_states, scan_chunk)
 from ..ops.window_match import window_hits
 
 I64_MIN = -(2**63)
 
-# Scan layout knobs (see bench.py for the measurement method):
+# Scan execution selection: the default comes from the PLAN-TIME
+# strategy selector (compiler/plan.py select_scan_strategy — recorded in
+# plan.scan_plans, persisted through the artifact cache, re-tunable from
+# measurement via bench.py's autotune hook). The env knobs below are now
+# OVERRIDES, not the source of defaults:
 #
-# MEASUREMENT CAVEAT (round 3): the numbers in earlier revisions of
-# these notes (field 1.73M vs fill 0.74M / halo a wash) came from a
-# timing loop whose scan inputs were loop-invariant, which XLA's
-# while-loop code motion could hoist — they overstate absolute
-# throughput (honest loop: ~2x lower) and the RELATIVE comparisons are
-# suspect in proportion to how much of each variant was hoisted. The
-# knobs remain selectable; defaults will follow honest re-measurement
-# (salted-input chained loops, as bench.py now does).
+# PINGOO_SCAN_STRATEGY: force one strategy for every bank — "scan"
+# (lax.scan single-byte), "pair" (lax.scan pair lookup), "pallas"
+# (fused kernel, pair stepping), "pallas_single", "halo" (keep the
+# selected kind, force the halo-split attempt).
 #
-# PINGOO_SCAN_PACK: lane/row grouping strategy for the NFA scans
+# PINGOO_SCAN_PACK: legacy lane/row grouping for lax.scan banks
 # (ops/nfa_scan.pack_scan_groups / _batch_stacked_states): "field" (one
 # scan per field, the default), "length"/"fill" lane-packing, "single",
-# "batch" row-stacking.
+# "batch" row-stacking. A non-"field" value routes non-split banks
+# through the legacy packed path.
 #
-# PINGOO_HALO_SPLIT: within-device sequence split for bounded-memory
-# banks (ops/nfa_scan.halo_split_scan) — trades serial steps for batch
-# rows (user_agent: 128 steps -> 52 at 4x rows). Default off.
+# PINGOO_HALO_SPLIT: legacy knob forcing the within-device halo-split
+# attempt for bounded-memory banks (the strategy's halo_k normally
+# gates this).
 #
 # PINGOO_NFA_LOOKUP (read in ops/nfa_scan.py): byte-class lookup
-# strategy per scan step — take / cls_take / oh_f32 / pair / auto.
+# strategy per lax.scan step — take / cls_take / oh_f32 / pair / auto.
 import os as _os
 
 SCAN_PACK_MODE = _os.environ.get("PINGOO_SCAN_PACK", "field")
 HALO_SPLIT = _os.environ.get("PINGOO_HALO_SPLIT", "0") != "0"
+
+_ENV_STRATEGIES = {
+    "scan": ("scan", False),
+    "pair": ("scan", True),
+    "pallas": ("pallas", True),
+    "pallas_pair": ("pallas", True),
+    "pallas_single": ("pallas", False),
+}
+
+
+def _resolve_strategy(strat: ScanStrategy) -> ScanStrategy:
+    """Apply the PINGOO_SCAN_STRATEGY override (read per trace so tests
+    can monkeypatch it)."""
+    env = _os.environ.get("PINGOO_SCAN_STRATEGY", "")
+    if not env:
+        return strat
+    if env == "halo":
+        return ScanStrategy(kind=strat.kind, pair=strat.pair, halo_k=8,
+                            source="env")
+    kind, pair = _ENV_STRATEGIES[env]
+    return ScanStrategy(kind=kind, pair=pair, halo_k=strat.halo_k,
+                        source="env")
 
 
 # -- numeric IR evaluation ---------------------------------------------------
@@ -167,33 +190,75 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
     nfa_cache: dict[str, Any] = {}
 
     def nfa_result(key, field):
-        if key not in nfa_cache:
-            nfa_cache[key] = nfa_scan(
-                tables[key], arrays[f"{field}_bytes"], arrays[f"{field}_len"])
-        return nfa_cache[key]
+        return nfa_cache[key]  # pre-filled by run_packed_scans
+
+    def bank_hits(bank, strat: ScanStrategy, data, lens):
+        """One bank's [B, P] hits under its selected strategy: the
+        trace-time halo-split attempt (when the strategy's halo_k and
+        the bucketed length make it strictly cheaper than the base
+        stepping), then the pair/single step on the lax.scan or fused
+        Pallas backend."""
+        B, L = data.shape
+        backend = "pallas" if strat.kind == "pallas" else None
+        lookup = "pair" if strat.pair else None
+        k_cap = strat.halo_k if strat.halo_k > 1 else (8 if HALO_SPLIT else 1)
+        if k_cap > 1:
+            k = halo_split_k(bank, int(L), max_k=k_cap)
+            base_iters = (L + 1) // 2 if strat.pair else L
+            if k > 1 and (L // k + int(bank.max_footprint)) < base_iters:
+                return halo_split_scan(bank, data, lens, k,
+                                       lookup=lookup, backend=backend)
+        state = scan_chunk(bank, data, lens,
+                           init_scan_state(B, bank.opt.shape[0]), 0,
+                           lookup=lookup, backend=backend)
+        return extract_slots(bank, state, lens)
 
     def run_packed_scans(groups: dict[str, tuple[str, list]]) -> None:
-        """Run every bank's scan through the measured-fastest layout
-        (VERDICT r2 item 3; see the module-level knob notes): per-field
-        scans by default, with bounded-memory banks sequence-split
-        within the device so their serial step count drops from L to
-        L/k + footprint."""
-        banks = {key: tables[key] for key in groups}
-        datas = {key: arrays[f"{groups[key][0]}_bytes"] for key in groups}
-        lens = {key: arrays[f"{groups[key][0]}_len"] for key in groups}
-        if HALO_SPLIT:
-            for key in list(banks):
-                k = halo_split_k(banks[key], int(datas[key].shape[1]))
-                if k > 1:
-                    nfa_cache[key] = halo_split_scan(
-                        banks[key], datas[key], lens[key], k)
-                    del banks[key]
-        if banks:
-            states = packed_scan_states(banks, datas, lens,
-                                        mode=SCAN_PACK_MODE)
-            for key in banks:
-                nfa_cache[key] = extract_slots(
-                    banks[key], states[key], lens[key])
+        """Run every NFA bank through its plan-selected strategy
+        (compiler/plan.py scan_plans; module-level knobs override).
+        Partitioned banks run their halo-splittable @short sub-bank and
+        pair-stepped @rest residual separately and recombine columns by
+        the recorded slot permutation."""
+        packed: dict[str, tuple] = {}  # legacy lane/row-packing jobs
+        for key, (field, _members) in groups.items():
+            data = arrays[f"{field}_bytes"]
+            lens = arrays[f"{field}_len"]
+            entry = plan.scan_plans.get(key) or NfaScanPlan(
+                key=key, strategy=ScanStrategy())
+            if entry.split is not None:
+                skey, rkey = entry.split
+                hits = jnp.concatenate(
+                    [bank_hits(tables[skey],
+                               _resolve_strategy(entry.short_strategy),
+                               data, lens),
+                     bank_hits(tables[rkey],
+                               _resolve_strategy(entry.rest_strategy),
+                               data, lens)], axis=1)
+                perm = jnp.asarray(entry.slot_perm, dtype=jnp.int32)
+                nfa_cache[key] = jnp.take(hits, perm, axis=1)
+                continue
+            strat = _resolve_strategy(entry.strategy)
+            if strat.source != "env" and SCAN_PACK_MODE != "field":
+                strat = ScanStrategy()  # legacy packed path wants lax.scan
+            if strat.kind == "scan" and not strat.pair \
+                    and SCAN_PACK_MODE != "field":
+                if HALO_SPLIT:  # legacy halo-first, as before packing
+                    k = halo_split_k(tables[key], int(data.shape[1]))
+                    if k > 1:
+                        nfa_cache[key] = halo_split_scan(
+                            tables[key], data, lens, k)
+                        continue
+                packed[key] = (tables[key], data, lens)
+                continue
+            nfa_cache[key] = bank_hits(tables[key], strat, data, lens)
+        if packed:
+            states = packed_scan_states(
+                {k: v[0] for k, v in packed.items()},
+                {k: v[1] for k, v in packed.items()},
+                {k: v[2] for k, v in packed.items()},
+                mode=SCAN_PACK_MODE)
+            for k, (bank, _data, lens) in packed.items():
+                nfa_cache[k] = extract_slots(bank, states[k], lens)
 
     # Per-leaf NFA/window extraction: leaves own contiguous slot spans;
     # doing a per-leaf slice+any would issue hundreds of tiny ops, so

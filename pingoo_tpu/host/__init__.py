@@ -6,18 +6,40 @@ geoip.rs); the C++ native plane (pingoo_tpu/native) carries the
 shared-memory ring and high-throughput listener.
 """
 
-from .captcha import CaptchaManager, generate_captcha_client_id
-from .discovery import ServiceRegistry
-from .geoip import GeoipDB, GeoipRecord
-from .httpd import HttpListener, Request
-from .server import Server, run
-from .services import (
-    HttpProxyService,
-    StaticSiteService,
-    TcpProxyService,
-    build_http_services,
-)
-from .tlsmgr import TlsManager, generate_self_signed
+# Lazy attribute resolution (PEP 562): several submodules need optional
+# packages (`cryptography` for tlsmgr/acme x509, zstd for geoip blobs) —
+# importing `pingoo_tpu.host.services` for e.g. route matching must not
+# drag those in. Each public name resolves to its submodule on first
+# access; a missing optional dependency surfaces where it is USED.
+_EXPORTS = {
+    "CaptchaManager": "captcha",
+    "generate_captcha_client_id": "captcha",
+    "ServiceRegistry": "discovery",
+    "GeoipDB": "geoip",
+    "GeoipRecord": "geoip",
+    "HttpListener": "httpd",
+    "Request": "httpd",
+    "Server": "server",
+    "run": "server",
+    "HttpProxyService": "services",
+    "StaticSiteService": "services",
+    "TcpProxyService": "services",
+    "build_http_services": "services",
+    "TlsManager": "tlsmgr",
+    "generate_self_signed": "tlsmgr",
+}
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        val = getattr(mod, name)
+        globals()[name] = val  # cache for subsequent lookups
+        return val
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 
 __all__ = [
     "CaptchaManager",

@@ -21,13 +21,44 @@ import time
 from dataclasses import dataclass, field
 from typing import Iterable, Optional
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec, ed25519
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    decode_dss_signature,
-    encode_dss_signature,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec, ed25519
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+
+    HAVE_CRYPTOGRAPHY = True
+except ModuleNotFoundError:  # gated: HS512 (hmac/hashlib) needs no backend
+    # Environments without the `cryptography` package still get the
+    # symmetric JWT path (captcha cookies use HS512); the asymmetric
+    # algorithms raise JwtError at key-construction/use time instead of
+    # breaking every importer of host.services at import time.
+    HAVE_CRYPTOGRAPHY = False
+
+    class InvalidSignature(Exception):  # type: ignore[no-redef]
+        pass
+
+    class _MissingCrypto:
+        def __init__(self, name):
+            self._name = name
+
+        def __getattr__(self, attr):
+            raise JwtError(
+                f"{self._name}.{attr} requires the 'cryptography' package, "
+                "which is not installed")
+
+    hashes = _MissingCrypto("hashes")
+    ec = _MissingCrypto("ec")
+    ed25519 = _MissingCrypto("ed25519")
+
+    def decode_dss_signature(*_a, **_k):  # type: ignore[no-redef]
+        raise JwtError("ECDSA requires the 'cryptography' package")
+
+    def encode_dss_signature(*_a, **_k):  # type: ignore[no-redef]
+        raise JwtError("ECDSA requires the 'cryptography' package")
 
 DEFAULT_DRIFT_TOLERANCE_S = 60
 
@@ -55,8 +86,10 @@ ALG_EDDSA = "EdDSA"
 ALG_ES256 = "ES256"
 ALG_ES512 = "ES512"
 
-_EC_CURVES = {ALG_ES256: (ec.SECP256R1(), hashes.SHA256(), 32),
-              ALG_ES512: (ec.SECP521R1(), hashes.SHA512(), 66)}
+_EC_CURVES = {
+    ALG_ES256: (ec.SECP256R1(), hashes.SHA256(), 32),
+    ALG_ES512: (ec.SECP521R1(), hashes.SHA512(), 66),
+} if HAVE_CRYPTOGRAPHY else {}
 
 
 @dataclass

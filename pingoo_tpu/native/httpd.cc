@@ -132,6 +132,10 @@ constexpr ssize_t kNghttp2ErrDeferred = -508;  // NGHTTP2_ERR_DEFERRED
 // the upstream read side is paused (per stream).
 constexpr size_t kH2PendingCap = 256 * 1024;
 constexpr int kH2MaxStreamUpstreams = 32;  // concurrent upstreams per conn
+// Connection-level receive window: 8x the (default 64KB) per-stream
+// window, so one debt-parked upload stream cannot exhaust the window
+// shared by its siblings (see start_h2).
+constexpr int32_t kH2ConnRecvWindow = 8 * 65535;
 constexpr time_t kProxyIdleTimeoutS = 60;
 constexpr int kMaxRequestsPerConn = 1000;
 
@@ -1500,7 +1504,14 @@ class Server {
     data.resize(size);
     size_t got = fread(data.data(), 1, size, f);
     fclose(f);
-    data.resize(got);
+    if (got != size) {
+      // stat-then-read race: the file was truncated/replaced between
+      // the stat and the read. Serving `got` bytes under the stat'd
+      // content-length would corrupt the client's framing, and caching
+      // the short body would pin the corruption until the mtime
+      // changes again — fail the request and cache nothing.
+      return plain(500, "Internal Server Error");
+    }
     if (file_cache_.size() >= kStaticCacheEntries)
       file_cache_.erase(file_cache_.begin());
     file_cache_[full] = StaticFile{size, mtime_ns, data};
@@ -3061,6 +3072,16 @@ class Server {
     nghttp2_settings_entry iv[] = {
         {NGHTTP2_SETTINGS_MAX_CONCURRENT_STREAMS, 128}};
     nghttp2_submit_settings(c->h2, 0, iv, 1);
+    // Upload head-of-line blocking: with manual window management, one
+    // stream whose body is debt-parked behind a slow upstream holds its
+    // received-but-unconsumed bytes against BOTH windows — and the
+    // connection-level window defaults to the same 64KB as one stream,
+    // so a single parked upload could close the shared window for every
+    // other stream on the connection. Raise the connection window to
+    // several per-stream windows so per-stream flow control is the
+    // binding limit and siblings keep flowing.
+    nghttp2_session_set_local_window_size(c->h2, NGHTTP2_FLAG_NONE, 0,
+                                          kH2ConnRecvWindow);
     c->state = ConnState::kH2;
     return true;
   }

@@ -50,6 +50,7 @@ typedef struct {
 } nghttp2_data_provider;
 
 #define NGHTTP2_NV_FLAG_NONE 0
+#define NGHTTP2_FLAG_NONE 0
 #define NGHTTP2_FLAG_END_STREAM 0x1
 #define NGHTTP2_FRAME_DATA 0
 #define NGHTTP2_FRAME_HEADERS 1
@@ -106,6 +107,9 @@ int nghttp2_session_server_new2(nghttp2_session** out,
                                 const nghttp2_option* opt);
 int nghttp2_session_consume(nghttp2_session* session, int32_t stream_id,
                             size_t size);
+int nghttp2_session_set_local_window_size(nghttp2_session* session,
+                                          uint8_t flags, int32_t stream_id,
+                                          int32_t window_size);
 int nghttp2_session_consume_connection(nghttp2_session* session,
                                        size_t size);
 int nghttp2_session_server_new(nghttp2_session** out,

@@ -29,6 +29,10 @@ struct UpH2Link {
   bool data_deferred = false;
   int status = 0;
   std::vector<std::pair<std::string, std::string>> resp_headers;
+  // Response TRAILERS (a HEADERS frame after the head): forwarded as h1
+  // chunked trailers after the 0-chunk — gRPC's grpc-status/-message
+  // live there and must survive the h2->h1 bridge.
+  std::vector<std::pair<std::string, std::string>> resp_trailers;
   bool resp_headers_done = false;
   bool resp_done = false;  // END_STREAM seen
   bool failed = false;     // stream/session error: caller 502s/aborts
@@ -69,6 +73,12 @@ struct UpH2Link {
       return 0;
     std::string n(reinterpret_cast<const char*>(name), namelen);
     std::string v(reinterpret_cast<const char*>(value), valuelen);
+    if (l->head_emitted) {
+      // A HEADERS frame after the emitted head is the trailer section.
+      if (!n.empty() && n[0] != ':')
+        l->resp_trailers.emplace_back(std::move(n), std::move(v));
+      return 0;
+    }
     if (n == ":status") {
       l->status = atoi(v.c_str());
     } else if (!n.empty() && n[0] != ':') {
@@ -126,7 +136,17 @@ struct UpH2Link {
       l->emit_head(end_stream);
     }
     if (end_stream && l->head_emitted && !l->resp_done) {
-      if (l->chunked_out) l->synth += "0\r\n\r\n";
+      if (l->chunked_out) {
+        // h1 chunked framing carries trailers between the 0-chunk and
+        // the final CRLF; the downstream h1 relay passes the raw bytes
+        // through, and the BodyFramer's kTrailer state consumes them
+        // where the body is de-chunked (h2 downstream re-framing drops
+        // them — recorded in COMPONENTS.md Known deltas).
+        l->synth += "0\r\n";
+        for (const auto& kv : l->resp_trailers)
+          l->synth += kv.first + ": " + kv.second + "\r\n";
+        l->synth += "\r\n";
+      }
       l->resp_done = true;
     }
     return 0;
@@ -183,6 +203,7 @@ struct UpH2Link {
     data_deferred = false;
     status = 0;
     resp_headers.clear();
+    resp_trailers.clear();
     resp_headers_done = false;
     resp_done = false;
     head_emitted = false;

@@ -260,6 +260,7 @@ def scan_chunk(
     state: jax.Array,
     t_offset,
     lookup: str | None = None,
+    backend: str | None = None,
 ) -> jax.Array:
     """Advance the NFA over one [B, Lc] byte chunk whose first column sits
     at global position `t_offset`; returns the new [B, W] state. Chunks
@@ -267,8 +268,18 @@ def scan_chunk(
     devices via ppermute. `t_offset` may also be a PER-ROW [B] array
     (the within-device halo split stacks chunks as extra rows, each with
     its own global offset).
+
+    `backend="pallas"` routes the loop through the fused Pallas kernel
+    (ops/pallas_scan.py) — bit-identical semantics, state resident in
+    VMEM across the whole chunk; `lookup == "pair"` there selects the
+    two-bytes-per-iteration stepping.
     """
     lookup = _resolve_lookup(lookup)
+    if backend == "pallas":
+        from .pallas_scan import fused_scan_chunk
+
+        return fused_scan_chunk(tables, data, lengths, state, t_offset,
+                                pair=lookup == "pair")
     if lookup == "pair":
         C_, W_ = tables.cls_table.shape
         if C_ * C_ * 2 * W_ * 4 > PAIR_TABLE_MAX_BYTES:
@@ -406,7 +417,8 @@ def extract_slots(tables: NfaTables, state: jax.Array, lengths: jax.Array,
 
 
 def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array,
-             lookup: str | None = None) -> jax.Array:
+             lookup: str | None = None,
+             backend: str | None = None) -> jax.Array:
     """Run the bank over a byte batch.
 
     data: [B, L] uint8 (zero-padded), lengths: [B] int32
@@ -415,7 +427,7 @@ def nfa_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array,
     B, L = data.shape
     state = scan_chunk(
         tables, data, lengths, init_scan_state(B, tables.opt.shape[0]), 0,
-        lookup=lookup)
+        lookup=lookup, backend=backend)
     return extract_slots(tables, state, lengths)
 
 
@@ -439,7 +451,8 @@ def halo_split_k(tables: NfaTables, L: int, max_k: int = 8) -> int:
 
 
 def halo_split_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array,
-                    k: int) -> jax.Array:
+                    k: int, lookup: str | None = None,
+                    backend: str | None = None) -> jax.Array:
     """Sequence-split scan WITHIN one device: the length axis is cut into
     k chunks that become extra BATCH rows, each prefixed by an H-byte
     halo of its predecessor — the same construction as the sp halo scan
@@ -467,7 +480,8 @@ def halo_split_scan(tables: NfaTables, data: jax.Array, lengths: jax.Array,
         (jnp.arange(k, dtype=jnp.int32) * Lc - H)[None, :], (B, k)
     ).reshape(-1)
     state = scan_chunk(tables, rows, row_lens,
-                       init_scan_state(B * k, tables.opt.shape[0]), offs)
+                       init_scan_state(B * k, tables.opt.shape[0]), offs,
+                       lookup=lookup, backend=backend)
     lanes = jnp.take(state, tables.accept_word, axis=1)  # [B*k, J]
     lanes = lanes.reshape(B, k, -1)
     masks = tables.accept_mask[None, None, :]

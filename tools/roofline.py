@@ -43,6 +43,7 @@ def main():
 
     jax.config.update("jax_platforms", "cpu")
     from pingoo_tpu.compiler import compile_ruleset
+    from pingoo_tpu.compiler.plan import ScanStrategy, strategy_steps
     from pingoo_tpu.engine import encode_requests
     from pingoo_tpu.engine.batch import bucket_arrays
     from pingoo_tpu.utils.crs import generate_ruleset, generate_traffic
@@ -69,10 +70,11 @@ def main():
     # verdict lanes out: [3 + G, B] int32
     hbm_bytes += 4 * BATCH * 4
 
+    selected_steps = 0
     for key, val in plan.np_tables.items():
         leaves = jax.tree_util.tree_leaves(val)
         tbytes = sum(np.asarray(x).nbytes for x in leaves)
-        if key.startswith("nfa_"):
+        if key.startswith("nfa_") and "@" not in key:
             field = key[4:]
             W = val.byte_table.shape[1]
             C = val.cls_table.shape[0]
@@ -91,6 +93,45 @@ def main():
             detail[key] = {"W": W, "classes": C, "len": L,
                            "passes": passes, "steps": steps,
                            "table_KiB": round(tbytes / 1024, 1)}
+            # Per-strategy dependent-step counts at THIS bucketed length
+            # (loop iterations x passes — the roofline's serial unit),
+            # plus the plan's selected strategy (compiler/plan.py;
+            # persisted through the ruleset artifact cache).
+            entry = plan.scan_plans.get(key)
+            variants = {
+                "scan": strategy_steps(val, L, ScanStrategy()),
+                "pair": strategy_steps(val, L, ScanStrategy(pair=True)),
+                "pallas": strategy_steps(
+                    val, L, ScanStrategy(kind="pallas", pair=True)),
+                "halo": strategy_steps(
+                    val, L, ScanStrategy(halo_k=8)),
+            }
+            detail[key]["strategy_steps"] = variants
+            if entry is not None:
+                if entry.split is not None:
+                    short_t = plan.np_tables[entry.split[0]]
+                    rest_t = plan.np_tables[entry.split[1]]
+                    sel = (strategy_steps(short_t, L, entry.short_strategy)
+                           + strategy_steps(rest_t, L, entry.rest_strategy))
+                    sel_desc = {
+                        "kind": "split",
+                        "short": entry.short_strategy.kind
+                        + ("+pair" if entry.short_strategy.pair else "")
+                        + (f"+halo{entry.short_strategy.halo_k}"
+                           if entry.short_strategy.halo_k > 1 else ""),
+                        "rest": entry.rest_strategy.kind
+                        + ("+pair" if entry.rest_strategy.pair else ""),
+                    }
+                else:
+                    sel = strategy_steps(val, L, entry.strategy)
+                    sel_desc = {
+                        "kind": entry.strategy.kind
+                        + ("+pair" if entry.strategy.pair else ""),
+                        "source": entry.strategy.source,
+                    }
+                detail[key]["selected"] = sel_desc
+                detail[key]["selected_steps"] = sel
+                selected_steps += sel
         elif key.startswith("win_"):
             # windowed correlation: [B, L] bytes against K signatures of
             # width 8 (nibble-SSD): [B*L, 8*2] x [16, K] -ish
@@ -122,6 +163,10 @@ def main():
         "batch": BATCH,
         "bucketed_lens": blen,
         "serial_nfa_steps": serial_steps,
+        # dependent steps under the PLAN-SELECTED strategies (pair /
+        # pallas / halo-split; see per-bank strategy_steps): the serial
+        # chain the selected kernels actually execute.
+        "selected_serial_steps": selected_steps,
         "per_batch": {
             "hbm_bytes": int(hbm_bytes),
             "mxu_macs": int(mxu_macs),
@@ -132,6 +177,11 @@ def main():
             "mxu": round(BATCH / t_mxu),
             "vpu": round(BATCH / t_vpu),
             "serial_0p5us_per_step": round(BATCH / t_serial_opt),
+            # same 0.5 us dependent-step floor, under the SELECTED
+            # per-bank strategies (pair/pallas/halo): the ceiling the
+            # step-count reduction pipeline actually unlocks.
+            "serial_0p5us_selected": round(
+                BATCH / (max(selected_steps, 1) * 0.5e-6)),
         },
         "banks": detail,
     }
