@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: all native test check bench audit asan clean
+.PHONY: all native test check bench audit asan metrics-smoke clean
 
 all: native
 
@@ -34,6 +34,15 @@ audit:
 	@ldconfig -p | grep -E 'libssl|libcrypto|libnghttp2' || true
 	@if [ -x pingoo_tpu/native/httpd ]; then \
 		ldd pingoo_tpu/native/httpd | grep -E 'ssl|crypto|nghttp2'; fi
+	@echo "-- metrics schema parity --"
+	$(PY) tools/check_metrics_schema.py
+
+# Live observability smoke: boot the native plane + ring sidecar + a
+# Python listener, scrape both /__pingoo/metrics endpoints in both
+# formats, and validate them against the documented inventory
+# (docs/OBSERVABILITY.md / pingoo_tpu/obs/schema.py).
+metrics-smoke: native
+	env JAX_PLATFORMS=cpu $(PY) tools/metrics_smoke.py
 
 # ASAN/UBSAN build of the native data plane (httpd_asan).
 asan:

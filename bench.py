@@ -246,16 +246,14 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
         # deadline fail open (attacks pass rather than stall), so
         # e2e_blocked alone under-reports the WAF (e2e_fail_open says
         # how many requests the timeout released).
-        stats = {}
-        try:
-            import urllib.request
+        stats = _scrape_metrics_json(hport)
+        # Per-stage sidecar latency + shm ring telemetry: the registry
+        # snapshot rides the artifact so a perf run carries its own
+        # stage breakdown (queue/encode/dispatch/compute/post).
+        from pingoo_tpu.obs import REGISTRY
 
-            with urllib.request.urlopen(
-                    f"http://127.0.0.1:{hport}/__pingoo/metrics",
-                    timeout=5) as resp:
-                stats = json.loads(resp.read())
-        except Exception:
-            pass
+        stage_latency = REGISTRY.stage_snapshot()
+        ring_tel = sidecar.ring_telemetry()
     finally:
         pong.kill()
         httpd.kill()
@@ -263,6 +261,8 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
         ring.close()
     p50, p99 = _hist_percentiles(stats.get("verdict_wait_ms_hist"))
     return {
+        "e2e_stage_latency": stage_latency,
+        "e2e_ring_telemetry": ring_tel,
         "e2e_req_per_s": res["req_per_s"],
         "e2e_added_p50_ms": res["p50_ms"],
         "e2e_added_p99_ms": res["p99_ms"],
@@ -280,6 +280,22 @@ def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
                      "native plane's 3 s deadline fail open, so blocked "
                      "counts only verdicts that beat the tunnel"),
     }
+
+
+def _scrape_metrics_json(port: int) -> dict:
+    """Scrape /__pingoo/metrics in its JSON form. The endpoint now
+    content-negotiates (Prometheus text by default, ISSUE 2), so the
+    legacy-schema consumer must ask for application/json explicitly."""
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/__pingoo/metrics",
+            headers={"accept": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return json.loads(resp.read())
+    except Exception:
+        return {}
 
 
 def _hist_percentiles(hist):
@@ -385,16 +401,7 @@ def bench_dataplane(n_requests: int = 200_000) -> dict:
         for p in procs:
             out, _ = p.communicate(timeout=300)
             results.append(json.loads(out.strip()))
-        dp_stats = {}
-        try:
-            import urllib.request
-
-            with urllib.request.urlopen(
-                    f"http://127.0.0.1:{hport}/__pingoo/metrics",
-                    timeout=5) as resp:
-                dp_stats = json.loads(resp.read())
-        except Exception:
-            pass
+        dp_stats = _scrape_metrics_json(hport)
     finally:
         drain.terminate()
         try:
@@ -785,6 +792,17 @@ def _main_impl(result: dict, done=None) -> None:
             result.update(bench_dataplane())
         except Exception as exc:
             result["dataplane_error"] = repr(exc)[:200]
+    try:
+        # Whole-run stage-latency snapshot (ISSUE 2): whatever verdict
+        # pipeline stages ran in-process (the e2e sidecar, any engine
+        # warm-up) ride the artifact for offline breakdowns.
+        from pingoo_tpu.obs import REGISTRY
+
+        stages = REGISTRY.stage_snapshot()
+        if stages:
+            result["stage_latency"] = stages
+    except Exception:
+        pass
     if done is not None:
         done.set()
     # The emit-once gate, not print(): a watchdog that timed out a

@@ -44,6 +44,11 @@ class RequestTuple:
     remote_port: int = 0
     asn: int = 0
     country: str = "XX"
+    # Observability correlation id (obs/trace.py): assigned at the edge,
+    # rides the tuple through batching so engine-side logs can join a
+    # request to its response header / access-log line. Never encoded
+    # into device arrays and never consulted by any rule.
+    trace_id: str = ""
 
 
 @dataclass

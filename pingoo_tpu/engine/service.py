@@ -20,7 +20,7 @@ from __future__ import annotations
 import asyncio
 import os
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
@@ -37,7 +37,7 @@ from .batch import (
     pad_batch,
     tuple_to_context,
 )
-from .verdict import action_lanes, evaluate_batch, make_verdict_fn
+from .verdict import action_lanes, finish_batch, make_verdict_fn
 
 
 def force_cpu_backend() -> None:
@@ -142,16 +142,48 @@ class Verdict:
 
 @dataclass
 class ServiceStats:
+    """Per-service counters + the shared-registry instruments.
+
+    The pre-registry `verdict_ms` list grew to 65536 floats and then
+    deleted half (unbounded resident memory, O(n) truncation on the hot
+    path, and percentile math over a python list per scrape); the
+    fixed-bucket registry histograms replace it — O(1) observe, O(1)
+    snapshot — while `snapshot()` keeps returning the same percentile
+    keys (now bucket-upper-bound estimates, the same convention the
+    native plane's histogram percentiles use)."""
+
     batches: int = 0
     requests: int = 0
     device_errors: int = 0
     score_errors: int = 0
     host_fallback_batches: int = 0
     batch_occupancy_sum: int = 0
-    verdict_ms: list = field(default_factory=list)
+
+    def __post_init__(self):
+        from ..obs import REGISTRY
+        from ..obs.registry import LATENCY_BUCKETS_MS, WAIT_BUCKETS_MS
+        from ..obs.schema import VERDICT_STAGES
+
+        self.wait_hist = REGISTRY.histogram(
+            "pingoo_verdict_wait_ms",
+            "verdict wait: evaluate() -> resolve (ms)",
+            buckets=WAIT_BUCKETS_MS, labels={"plane": "python"})
+        self.stage_hist = {
+            stage: REGISTRY.histogram(
+                "pingoo_verdict_stage_ms",
+                "verdict pipeline stage latency (ms)",
+                buckets=LATENCY_BUCKETS_MS,
+                labels={"plane": "python", "stage": stage})
+            for stage in VERDICT_STAGES}
+
+    def observe_stage(self, stage: str, ms: float, n: int = 1) -> None:
+        h = self.stage_hist[stage]
+        if n == 1:
+            h.observe(ms)
+        else:
+            h.observe_n(ms, n)
 
     def snapshot(self) -> dict:
-        lat = np.array(self.verdict_ms[-4096:] or [0.0])
         return {
             "batches": self.batches,
             "requests": self.requests,
@@ -160,8 +192,15 @@ class ServiceStats:
             "host_fallback_batches": self.host_fallback_batches,
             "mean_occupancy": (self.batch_occupancy_sum / self.batches
                                if self.batches else 0.0),
-            "verdict_p50_ms": float(np.percentile(lat, 50)),
-            "verdict_p99_ms": float(np.percentile(lat, 99)),
+            "verdict_p50_ms": self.wait_hist.percentile(0.50),
+            "verdict_p99_ms": self.wait_hist.percentile(0.99),
+            "stages": {
+                stage: {"count": h.count,
+                        "p50_ms": h.percentile(0.50),
+                        "p99_ms": h.percentile(0.99),
+                        "mean_ms": round(h.sum / h.count, 4)
+                        if h.count else 0.0}
+                for stage, h in self.stage_hist.items()},
         }
 
 
@@ -240,6 +279,23 @@ class VerdictService:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        task = getattr(self, "_profile_task", None)
+        if task is not None and not task.done():
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+            self._profile_task = None
+        self.ensure_trace_stopped()
+
+    def ensure_trace_stopped(self) -> None:
+        """Flush any live jax.profiler trace (the boot-time
+        PINGOO_PROFILE_DIR capture or an on-demand /__pingoo/profile
+        window). Idempotent and synchronous so the SIGTERM drain path
+        can call it even when the graceful-stop deadline expired —
+        without the explicit stop_trace the trace files are simply
+        never written (the profiler buffers in memory)."""
         if getattr(self, "_tracing", False):
             try:
                 import jax
@@ -249,19 +305,54 @@ class VerdictService:
                 pass
             self._tracing = False
 
+    async def capture_profile(self, seconds: float,
+                              out_dir: Optional[str] = None) -> dict:
+        """On-demand bounded jax.profiler window (the /__pingoo/profile
+        endpoint): generalizes the boot-only PINGOO_PROFILE_DIR hook to
+        any serving moment. One capture at a time; the window is capped
+        at 30 s so a forgotten curl cannot leave tracing overhead on."""
+        seconds = max(0.1, min(float(seconds), 30.0))
+        if getattr(self, "_tracing", False):
+            return {"error": "a profiler trace is already active"}
+        out_dir = out_dir or os.environ.get("PINGOO_PROFILE_DIR")
+        if not out_dir:
+            import tempfile
+
+            out_dir = tempfile.mkdtemp(prefix="pingoo-profile-")
+        try:
+            import jax
+
+            jax.profiler.start_trace(out_dir)
+        except Exception as exc:
+            return {"error": f"profiler unavailable: {exc!r}"}
+        self._tracing = True
+
+        async def _stop_after_window():
+            try:
+                await asyncio.sleep(seconds)
+            finally:
+                # Cancellation (service stop) must still flush.
+                self.ensure_trace_stopped()
+
+        self._profile_task = asyncio.create_task(_stop_after_window())
+        return {"profiling": True, "dir": out_dir, "seconds": seconds}
+
     async def evaluate(self, req: RequestTuple) -> Verdict:
         """Await the verdict for one request (the per-request hot call)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((req, fut))
+        await self._queue.put((req, fut, time.monotonic()))
         return await fut
 
     # -- batching loop -------------------------------------------------------
 
     async def _collector(self) -> None:
         while True:
-            req, fut = await self._queue.get()
-            pending = [(req, fut)]
-            deadline = time.monotonic() + self.max_wait_s
+            item = await self._queue.get()
+            t_first = time.monotonic()
+            self.stats.observe_stage(
+                "queue_wait", (t_first - item[2]) * 1e3)
+            pending = [item]
+            deadline = t_first + self.max_wait_s
             while len(pending) < self.max_batch:
                 timeout = deadline - time.monotonic()
                 if timeout <= 0:
@@ -270,7 +361,11 @@ class VerdictService:
                     item = await asyncio.wait_for(self._queue.get(), timeout)
                 except asyncio.TimeoutError:
                     break
+                self.stats.observe_stage(
+                    "queue_wait", (time.monotonic() - item[2]) * 1e3)
                 pending.append(item)
+            self.stats.observe_stage(
+                "batch_assembly", (time.monotonic() - t_first) * 1e3)
             try:
                 await self._run_batch(pending)
             except asyncio.CancelledError:
@@ -280,37 +375,41 @@ class VerdictService:
                 # fail-open (no-match) and keep serving.
                 self.stats.device_errors += 1
                 R = len(self.plan.rules)
-                for _, fut in pending:
+                for _, fut, _t in pending:
                     if not fut.done():
                         fut.set_result(Verdict(
                             action=0, matched=np.zeros(R, dtype=bool),
                             degraded=True))
 
     async def _run_batch(self, pending: list) -> None:
-        reqs = [r for r, _ in pending]
-        t0 = time.monotonic()
+        reqs = [r for r, _, _ in pending]
         loop = asyncio.get_running_loop()
         matched, scores = await loop.run_in_executor(
             None, self._evaluate_with_scores, reqs)
-        dt_ms = (time.monotonic() - t0) * 1000
+        t_resolve = time.monotonic()
         actions, verified_block = action_lanes(self.plan, matched)
         self.stats.batches += 1
         self.stats.requests += len(reqs)
         self.stats.batch_occupancy_sum += len(reqs)
-        self.stats.verdict_ms.append(dt_ms)
-        if len(self.stats.verdict_ms) > 65536:
-            del self.stats.verdict_ms[:32768]
-        for i, (_, fut) in enumerate(pending):
+        for i, (_, fut, t_enq) in enumerate(pending):
+            # The shared verdict-wait histogram measures the full
+            # evaluate() -> resolve wall per REQUEST (queue wait
+            # included) — the <2ms p99 budget is about this number.
+            self.stats.wait_hist.observe((t_resolve - t_enq) * 1e3)
             if not fut.done():
                 fut.set_result(
                     Verdict(action=int(actions[i]), matched=matched[i],
                             bot_score=float(scores[i]),
                             verified_block=bool(verified_block[i])))
+        self.stats.observe_stage(
+            "resolve", (time.monotonic() - t_resolve) * 1e3)
 
     def _evaluate_with_scores(self, reqs: list[RequestTuple]):
         """-> (matched [B, R], bot scores [B]). Scores ride the same
         encoded batch (BASELINE config 5: the vectorized bot head)."""
+        t0 = time.monotonic()
         batch = encode_requests(reqs, self.plan.field_specs)
+        self.stats.observe_stage("encode", (time.monotonic() - t0) * 1e3)
         matched = self._evaluate_sync(reqs, batch)
         n = len(reqs)
         scores = np.zeros(n, dtype=np.float32)
@@ -356,9 +455,18 @@ class VerdictService:
                 fast = pad_batch(
                     RequestBatch(size=batch.size, arrays=arrays),
                     self._pow2_size(n))
-                matched = evaluate_batch(
-                    self.plan, self._verdict_fn, self._tables, fast,
-                    self.lists)[:n]
+                t0 = time.monotonic()
+                dev = self._verdict_fn(self._tables, fast.arrays)
+                # jax dispatch is async: this stage is issue + host->
+                # device transfer; the on-device execution residual is
+                # timed inside finish_batch via block_until_ready,
+                # AFTER the host-interpreted rules overlapped it.
+                self.stats.observe_stage(
+                    "device_dispatch", (time.monotonic() - t0) * 1e3)
+                matched = finish_batch(
+                    self.plan, dev, fast, self.lists,
+                    on_device_wait=lambda ms: self.stats.observe_stage(
+                        "device_compute", ms))[:n]
             except Exception:
                 self.stats.device_errors += 1
         if matched is None:

@@ -596,17 +596,23 @@ def merge_lanes(dev_lanes, host_lanes) -> tuple[np.ndarray, np.ndarray]:
     return unverified, verified_block
 
 
-def evaluate_batch(plan, verdict_fn, tables, batch, lists) -> np.ndarray:
+def evaluate_batch(plan, verdict_fn, tables, batch, lists,
+                   on_device_wait=None) -> np.ndarray:
     """Full match matrix [B, R] in original rule order (device + host)."""
     dev = verdict_fn(tables, batch.arrays)  # async dispatch (jax)
-    return finish_batch(plan, dev, batch, lists)
+    return finish_batch(plan, dev, batch, lists,
+                        on_device_wait=on_device_wait)
 
 
-def finish_batch(plan, dev, batch, lists) -> np.ndarray:
+def finish_batch(plan, dev, batch, lists, on_device_wait=None) -> np.ndarray:
     """Combine an in-flight device verdict with the host-interpreted
     rules. Host rules run FIRST — jax dispatch is asynchronous, so the
     interpreter work overlaps the device execution (and any transport
-    latency to a remote chip) instead of serializing after it."""
+    latency to a remote chip) instead of serializing after it.
+
+    `on_device_wait(ms)` (optional) receives the residual wall time
+    blocked on the device result AFTER the host-rule overlap — the
+    per-stage `device_compute` histogram (obs/schema.VERDICT_STAGES)."""
     R = len(plan.rules)
     B = batch.size
     out = np.zeros((B, R), dtype=bool)
@@ -620,6 +626,14 @@ def finish_batch(plan, dev, batch, lists) -> np.ndarray:
             col_vals = out[:, rule.index]
             for i, ctx in enumerate(contexts):
                 col_vals[i] = execute_as_bool(prog, ctx)
+    if on_device_wait is not None:
+        import time as _time
+
+        t0 = _time.monotonic()
+        block = getattr(dev, "block_until_ready", None)
+        if block is not None:
+            block()
+        on_device_wait((_time.monotonic() - t0) * 1e3)
     dev = np.asarray(dev)  # block on the device result
     for col, idx in enumerate(plan.device_rule_indices):
         out[:, idx] = dev[:, col]

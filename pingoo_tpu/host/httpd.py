@@ -40,6 +40,8 @@ import h11
 from ..engine.batch import RequestTuple
 from ..engine.service import VerdictService
 from ..expr import Context
+from ..obs import REGISTRY, schema as obs_schema
+from ..obs.trace import TRACE_HEADER, AccessLogSampler, new_trace_id
 from .captcha import (
     CAPTCHA_PATH_PREFIX,
     CAPTCHA_VERIFIED_COOKIE,
@@ -82,6 +84,7 @@ class ListenerStats:
     requests: int = 0
     blocked: int = 0
     captcha_served: int = 0
+    fail_open: int = 0  # degraded verdicts served (engine fail-open)
     started_at: float = field(default_factory=time.time)
 
 
@@ -190,6 +193,29 @@ class HttpListener:
         self.route_indices = route_indices
         self.stats = ListenerStats()
         self._server: Optional[asyncio.AbstractServer] = None
+        # Unified telemetry (obs/): the listener's counters fold into
+        # the shared registry at scrape time (one collector per
+        # listener, labels disambiguate), the access-log sampler emits
+        # trace-id-carrying structured lines.
+        self._access_log = AccessLogSampler(name)
+        REGISTRY.register_collector(self._export_metrics)
+
+    def _export_metrics(self) -> None:
+        """Registry collector: mirror ListenerStats into the shared
+        metric names (obs/schema.SHARED_METRICS) so the Prometheus
+        exposition carries this listener next to the verdict pipeline
+        histograms and (under the native plane) the ring telemetry."""
+        lab = {"plane": "python", "listener": self.name}
+        for name, value in (
+                ("pingoo_requests_total", self.stats.requests),
+                ("pingoo_blocked_total", self.stats.blocked),
+                ("pingoo_captcha_total", self.stats.captcha_served),
+                ("pingoo_fail_open_total", self.stats.fail_open)):
+            REGISTRY.counter(name, obs_schema.SHARED_METRICS[name],
+                             labels=lab).set_total(value)
+        uptime = time.time() - self.stats.started_at
+        REGISTRY.gauge("pingoo_uptime_seconds", "listener uptime",
+                       labels=lab).set(round(uptime, 1))
 
     async def bind(self) -> None:
         # reuse_port: N processes can share the port for zero-downtime
@@ -210,6 +236,7 @@ class HttpListener:
             await self._server.serve_forever()
 
     async def close(self) -> None:
+        REGISTRY.unregister_collector(self._export_metrics)
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -476,6 +503,23 @@ class HttpListener:
     # -- the hot path --------------------------------------------------------
 
     async def handle_request(self, req: Request, peer) -> Response:
+        """Trace-instrumented entry: every request gets a trace id that
+        propagates into the verdict batch (RequestTuple.trace_id),
+        returns in the x-pingoo-trace-id response header, and lands in
+        the sampled structured access log."""
+        t0 = time.monotonic()
+        trace_id = new_trace_id()
+        response = await self._handle_request(req, peer, trace_id)
+        response.headers = list(response.headers) + [
+            (TRACE_HEADER, trace_id)]
+        self._access_log.maybe_log(
+            trace_id=trace_id, method=req.method, path=req.path,
+            status=response.status, client_ip=str(peer[0]),
+            duration_ms=(time.monotonic() - t0) * 1e3)
+        return response
+
+    async def _handle_request(self, req: Request, peer,
+                              trace_id: str = "") -> Response:
         self.stats.requests += 1
         client_ip, client_port = str(peer[0]), int(peer[1])
         trusted = self.trust_xff
@@ -553,7 +597,10 @@ class HttpListener:
             return Response(status, headers, body)
 
         if req.path == "/__pingoo/metrics":
-            return self._metrics_response()
+            return self._metrics_response(req)
+
+        if req.path == "/__pingoo/profile":
+            return await self._profile_response(req)
 
         # Captcha-verified cookie: invalid -> challenge page (:222-236).
         captcha_verified = False
@@ -567,13 +614,16 @@ class HttpListener:
         tup = RequestTuple(
             host=host, url=req.target, path=req.path, method=req.method,
             user_agent=user_agent, ip=client_ip, remote_port=client_port,
-            asn=geoip_record.asn, country=geoip_record.country)
+            asn=geoip_record.asn, country=geoip_record.country,
+            trace_id=trace_id)
 
         # RULES LOOP (:251-264): the engine's action lanes reproduce the
         # reference loop for both captcha states (engine/verdict.py
         # action_lanes — verified clients skip Captcha actions but still
         # block on any matched Block).
         verdict = await self.verdict.evaluate(tup)
+        if verdict.degraded:
+            self.stats.fail_open += 1
         action = verdict.action_for(captcha_verified)
         if action == 1:
             self.stats.blocked += 1
@@ -610,7 +660,23 @@ class HttpListener:
         return Response(403, [("content-type", "text/html; charset=utf-8"),
                               ("server", "pingoo")], CAPTCHA_PAGE.encode())
 
-    def _metrics_response(self) -> Response:
+    @staticmethod
+    def _accepts_json(req: Request) -> bool:
+        for name, value in req.headers:
+            if name.lower() == "accept":
+                return "application/json" in value.lower()
+        return False
+
+    def _metrics_response(self, req: Request) -> Response:
+        """Content-negotiated exposition: Prometheus text by default
+        (what a scraper or plain curl sees), the back-compatible JSON
+        schema under Accept: application/json."""
+        if not self._accepts_json(req):
+            return Response(
+                200,
+                [("content-type",
+                  "text/plain; version=0.0.4; charset=utf-8")],
+                REGISTRY.prometheus_text().encode())
         uptime = time.time() - self.stats.started_at
         payload = {
             "listener": self.name,
@@ -618,8 +684,30 @@ class HttpListener:
             "requests": self.stats.requests,
             "blocked": self.stats.blocked,
             "captcha_served": self.stats.captcha_served,
+            "fail_open": self.stats.fail_open,
             "req_per_s": round(self.stats.requests / uptime, 2) if uptime else 0,
             "verdict": self.verdict.stats.snapshot(),
         }
         return Response(200, [("content-type", "application/json")],
                         json.dumps(payload).encode())
+
+    async def _profile_response(self, req: Request) -> Response:
+        """On-demand bounded jax.profiler window:
+        GET /__pingoo/profile?seconds=N (default 3, cap 30). 409 when a
+        capture (or the boot-time PINGOO_PROFILE_DIR trace) is live."""
+        seconds = 3.0
+        query = req.target.partition("?")[2]
+        for part in query.split("&"):
+            k, _, v = part.partition("=")
+            if k == "seconds":
+                try:
+                    seconds = float(v)
+                except ValueError:
+                    pass
+        result = await self.verdict.capture_profile(seconds)
+        if "error" in result:
+            status = 409 if "already active" in result["error"] else 503
+        else:
+            status = 200
+        return Response(status, [("content-type", "application/json")],
+                        json.dumps(result).encode())

@@ -230,3 +230,10 @@ async def run(config: Config, **kwargs) -> None:
         await asyncio.wait_for(server.stop(), timeout=20)
     except asyncio.TimeoutError:
         pass
+    finally:
+        # The SIGTERM drain must flush any live jax.profiler trace even
+        # when stop() hit the 20 s cap mid-way: without stop_trace the
+        # PINGOO_PROFILE_DIR capture is buffered in memory and silently
+        # lost on exit.
+        if server.verdict is not None:
+            server.verdict.ensure_trace_stopped()

@@ -6,6 +6,12 @@ info). Python logging is adapted to the same shape:
   {"timestamp": ..., "level": "INFO", "target": "pingoo_tpu.host.httpd",
    "message": ..., **fields}
 Use `log = get_logger(__name__); log.info("msg", extra={"fields": {...}})`.
+
+The sampled access log (obs/trace.AccessLogSampler) emits through the
+same pipeline under the `pingoo_tpu.access` target: one line per
+sampled request with `trace_id`, method/path/status, client_ip and
+duration_ms — the trace id matches the response's x-pingoo-trace-id
+header, so a slow response in hand joins directly against the log.
 """
 
 from __future__ import annotations
@@ -32,7 +38,10 @@ class JsonFormatter(logging.Formatter):
             payload.update(fields)
         if record.exc_info:
             payload["exception"] = self.formatException(record.exc_info)
-        return json.dumps(payload)
+        # default=repr: a non-JSON-safe field value (Path, bytes, an
+        # exception object in access-log extras) must degrade to its
+        # repr, never take down the logging pipeline mid-request.
+        return json.dumps(payload, default=repr)
 
 
 def init_logging(level: str | None = None) -> None:

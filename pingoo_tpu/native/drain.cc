@@ -62,6 +62,7 @@ int main(int argc, char** argv) {
 
   std::vector<PingooRequestSlot> slots(cap_max);
   std::vector<uint64_t> tickets(cap_max);
+  std::vector<uint64_t> enq_ms(cap_max);
   std::vector<uint8_t> actions(cap_max);
   static const char* kMarkers[] = {"<script", "eval("};
   unsigned long long drained = 0, blocked = 0;
@@ -75,6 +76,7 @@ int main(int argc, char** argv) {
       for (uint32_t j = 0; j < n; ++j) {
         const PingooRequestSlot& s = slots[j];
         tickets[j] = s.ticket;
+        enq_ms[j] = s.enq_ms;
         uint8_t act = 0;
         for (const char* m : kMarkers) {
           if (memmem(s.url, s.url_len, m, strlen(m)) != nullptr) {
@@ -94,6 +96,9 @@ int main(int argc, char** argv) {
           nanosleep(&ts, nullptr);
         }
       }
+      // Feed the telemetry block's enqueue->post wait histogram so the
+      // dataplane bench's scrape carries ring waits too.
+      pingoo_ring_record_waits(ring, enq_ms.data(), n);
       drained += n;
     }
     if (total == 0) {

@@ -623,6 +623,7 @@ struct BodyFramer {
 
 struct Parsed {
   std::string method, target, path, host, user_agent;
+  std::string accept;           // Accept header (metrics content nego)
   std::string verified_cookie;  // __pingoo_captcha_verified value
   long long content_length = 0;
   bool has_content_length = false;
@@ -745,6 +746,8 @@ Parsed parse_head(const std::string& head) {
         p.host = strip_host_port(value);
       } else if (name == "user-agent") {
         p.user_agent = value;
+      } else if (name == "accept") {
+        p.accept = lower(value);
       } else if (name == "content-length") {
         // RFC 7230 §3.3.3: reject non-numeric values and duplicates
         // that disagree — silent last-wins framing would desync the
@@ -1879,8 +1882,11 @@ class Server {
     uint64_t upstream_tls_fail = 0;  // client handshake/verify failures
     uint64_t verdicts = 0;        // verdict bytes applied
     // log-scale verdict wait histogram (enqueue -> apply), upper bounds
-    // in ms: 1, 2, 5, 10, 50, 100, +inf
-    uint64_t wait_hist[7] = {0, 0, 0, 0, 0, 0, 0};
+    // in ms: 1, 2, 5, 10, 50, 100, 1000, +inf — the SHARED bucket set
+    // (pingoo_tpu/obs/schema.py SHARED_WAIT_BUCKETS_MS); the JSON
+    // surface folds the last two into its legacy "inf" key.
+    uint64_t wait_hist[8] = {0, 0, 0, 0, 0, 0, 0, 0};
+    uint64_t wait_sum_ms = 0;     // for the histogram _sum series
   };
 
   static uint64_t now_ms() {
@@ -1891,58 +1897,158 @@ class Server {
   }
 
   void record_wait(uint64_t ms) {
-    static const uint64_t bounds[6] = {1, 2, 5, 10, 50, 100};
-    int b = 6;
-    for (int i = 0; i < 6; ++i) {
+    static const uint64_t bounds[7] = {1, 2, 5, 10, 50, 100, 1000};
+    int b = 7;
+    for (int i = 0; i < 7; ++i) {
       if (ms < bounds[i]) {
         b = i;
         break;
       }
     }
     stats_.wait_hist[b]++;
+    stats_.wait_sum_ms += ms;
   }
 
+  // JSON body, built with std::string: the old fixed 1024-byte snprintf
+  // buffer was ~100 bytes from silent truncation (= invalid JSON on the
+  // wire) and every new field raised the risk. Schema is back-compat:
+  // the legacy keys keep their names, the ring telemetry block rides
+  // under "ring", and the legacy 7-bucket "verdict_wait_ms_hist" folds
+  // the new le1000 bucket into its "inf" key.
   std::string metrics_body() {
-    auto* rh = static_cast<PingooRingHeader*>(ring_);
-    uint64_t ring_pending = rh->req_head - rh->req_tail;
+    uint64_t tel[PINGOO_TELEMETRY_WORDS];
+    pingoo_ring_telemetry_snapshot(ring_, tel);
+    uint64_t ring_pending = tel[3];
     size_t pooled = 0;
     for (const auto& kv : upstream_pool_) pooled += kv.second.size();
-    char buf[1024];
-    int n = snprintf(
-        buf, sizeof(buf),
-        "{\"requests\": %llu, \"blocked\": %llu, \"captcha\": %llu, "
-        "\"ua_rejected\": %llu, \"fail_open\": %llu, \"no_service\": %llu, "
-        "\"upstream_fail\": %llu, \"upstream_tls_fail\": %llu, "
-        "\"verdicts\": %llu, "
-        "\"verdict_wait_ms_hist\": {\"le1\": %llu, \"le2\": %llu, "
-        "\"le5\": %llu, \"le10\": %llu, \"le50\": %llu, \"le100\": %llu, "
-        "\"inf\": %llu}, \"ring_pending\": %llu, \"awaiting\": %zu, "
-        "\"connections\": %zu, \"pooled_upstreams\": %zu}",
-        (unsigned long long)stats_.requests,
-        (unsigned long long)stats_.blocked,
-        (unsigned long long)stats_.captcha,
-        (unsigned long long)stats_.ua_rejected,
-        (unsigned long long)stats_.fail_open,
-        (unsigned long long)stats_.no_service,
-        (unsigned long long)stats_.upstream_fail,
-        (unsigned long long)stats_.upstream_tls_fail,
-        (unsigned long long)stats_.verdicts,
-        (unsigned long long)stats_.wait_hist[0],
-        (unsigned long long)stats_.wait_hist[1],
-        (unsigned long long)stats_.wait_hist[2],
-        (unsigned long long)stats_.wait_hist[3],
-        (unsigned long long)stats_.wait_hist[4],
-        (unsigned long long)stats_.wait_hist[5],
-        (unsigned long long)stats_.wait_hist[6],
-        (unsigned long long)ring_pending, awaiting_.size(), conns_.size(),
-        pooled);
-    return std::string(buf, n > 0 ? static_cast<size_t>(n) : 0);
+    std::string out = "{";
+    auto kv_u64 = [&out](const char* key, uint64_t v, bool first = false) {
+      if (!first) out += ", ";
+      out += "\"";
+      out += key;
+      out += "\": ";
+      out += std::to_string(v);
+    };
+    kv_u64("requests", stats_.requests, true);
+    kv_u64("blocked", stats_.blocked);
+    kv_u64("captcha", stats_.captcha);
+    kv_u64("ua_rejected", stats_.ua_rejected);
+    kv_u64("fail_open", stats_.fail_open);
+    kv_u64("no_service", stats_.no_service);
+    kv_u64("upstream_fail", stats_.upstream_fail);
+    kv_u64("upstream_tls_fail", stats_.upstream_tls_fail);
+    kv_u64("verdicts", stats_.verdicts);
+    out += ", \"verdict_wait_ms_hist\": {";
+    static const char* kHistKeys[6] = {"le1",  "le2",  "le5",
+                                       "le10", "le50", "le100"};
+    for (int i = 0; i < 6; ++i) {
+      if (i) out += ", ";
+      out += "\"";
+      out += kHistKeys[i];
+      out += "\": ";
+      out += std::to_string(stats_.wait_hist[i]);
+    }
+    out += ", \"inf\": " +
+           std::to_string(stats_.wait_hist[6] + stats_.wait_hist[7]);
+    out += "}";
+    kv_u64("ring_pending", ring_pending);
+    kv_u64("awaiting", awaiting_.size());
+    kv_u64("connections", conns_.size());
+    kv_u64("pooled_upstreams", pooled);
+    out += ", \"ring\": {";
+    kv_u64("enqueued", tel[0], true);
+    kv_u64("enqueue_full", tel[1]);
+    kv_u64("dequeued", tel[2]);
+    kv_u64("depth", tel[3]);
+    kv_u64("depth_hwm", tel[4]);
+    kv_u64("verdicts_posted", tel[5]);
+    kv_u64("verdict_post_full", tel[6]);
+    kv_u64("wait_sum_ms", tel[7]);
+    out += "}}";
+    return out;
   }
 
-  std::string metrics_json() {
-    std::string body = metrics_body();
-    return "HTTP/1.1 200 OK\r\nserver: pingoo\r\n"
-           "content-type: application/json\r\ncontent-length: " +
+  // Prometheus text exposition, metric names shared with the Python
+  // plane (pingoo_tpu/obs/schema.py — the parity test's contract).
+  std::string metrics_prometheus() {
+    uint64_t tel[PINGOO_TELEMETRY_WORDS];
+    pingoo_ring_telemetry_snapshot(ring_, tel);
+    size_t pooled = 0;
+    for (const auto& kv : upstream_pool_) pooled += kv.second.size();
+    const std::string plane = "{plane=\"native\"}";
+    std::string out;
+    auto metric = [&out, &plane](const char* type, const char* name,
+                                 uint64_t v) {
+      out += "# TYPE ";
+      out += name;
+      out += " ";
+      out += type;
+      out += "\n";
+      out += name;
+      out += plane;
+      out += " " + std::to_string(v) + "\n";
+    };
+    metric("counter", "pingoo_requests_total", stats_.requests);
+    metric("counter", "pingoo_blocked_total", stats_.blocked);
+    metric("counter", "pingoo_captcha_total", stats_.captcha);
+    metric("counter", "pingoo_fail_open_total", stats_.fail_open);
+    metric("counter", "pingoo_ua_rejected_total", stats_.ua_rejected);
+    metric("counter", "pingoo_no_service_total", stats_.no_service);
+    metric("counter", "pingoo_upstream_fail_total", stats_.upstream_fail);
+    metric("counter", "pingoo_upstream_tls_fail_total",
+           stats_.upstream_tls_fail);
+    metric("counter", "pingoo_verdicts_total", stats_.verdicts);
+    metric("gauge", "pingoo_connections", conns_.size());
+    metric("gauge", "pingoo_pooled_upstreams", pooled);
+    metric("counter", "pingoo_ring_enqueued_total", tel[0]);
+    metric("counter", "pingoo_ring_enqueue_full_total", tel[1]);
+    metric("counter", "pingoo_ring_dequeued_total", tel[2]);
+    metric("gauge", "pingoo_ring_depth", tel[3]);
+    metric("gauge", "pingoo_ring_depth_hwm", tel[4]);
+    metric("counter", "pingoo_ring_verdicts_posted_total", tel[5]);
+    metric("counter", "pingoo_ring_verdict_post_full_total", tel[6]);
+    // Verdict wait histogram (enqueue -> verdict-apply), shared bucket
+    // bounds with the Python plane's pingoo_verdict_wait_ms.
+    static const char* kLe[7] = {"1", "2", "5", "10", "50", "100", "1000"};
+    out += "# TYPE pingoo_verdict_wait_ms histogram\n";
+    uint64_t cum = 0, total = 0;
+    for (int i = 0; i < 8; ++i) total += stats_.wait_hist[i];
+    for (int i = 0; i < 7; ++i) {
+      cum += stats_.wait_hist[i];
+      out += "pingoo_verdict_wait_ms_bucket{plane=\"native\",le=\"";
+      out += kLe[i];
+      out += "\"} " + std::to_string(cum) + "\n";
+    }
+    out += "pingoo_verdict_wait_ms_bucket{plane=\"native\",le=\"+Inf\"} " +
+           std::to_string(total) + "\n";
+    out += "pingoo_verdict_wait_ms_sum" + plane + " " +
+           std::to_string(stats_.wait_sum_ms) + "\n";
+    out += "pingoo_verdict_wait_ms_count" + plane + " " +
+           std::to_string(total) + "\n";
+    return out;
+  }
+
+  // Accept-negotiated body + content type: Prometheus text by default
+  // (what a scraper's GET or plain curl sees), the back-compat JSON
+  // under Accept: application/json.
+  static bool accept_wants_json(const Parsed& p) {
+    return p.accept.find("application/json") != std::string::npos;
+  }
+
+  std::string metrics_negotiated(const Parsed& p, const char** ctype) {
+    if (accept_wants_json(p)) {
+      *ctype = "application/json";
+      return metrics_body();
+    }
+    *ctype = "text/plain; version=0.0.4; charset=utf-8";
+    return metrics_prometheus();
+  }
+
+  std::string metrics_response(const Parsed& p) {
+    const char* ctype = nullptr;
+    std::string body = metrics_negotiated(p, &ctype);
+    return "HTTP/1.1 200 OK\r\nserver: pingoo\r\ncontent-type: " +
+           std::string(ctype) + "\r\ncontent-length: " +
            std::to_string(body.size()) + "\r\nconnection: close\r\n\r\n" +
            body;
   }
@@ -2918,7 +3024,7 @@ class Server {
     c->req_body_forwarded = c->req_body.done;
 
     if (c->req.path == "/__pingoo/metrics") {
-      respond_close(c, metrics_json().c_str());
+      respond_close(c, metrics_response(c->req).c_str());
       return;
     }
     Policy outcome = run_policy(c);
@@ -3162,9 +3268,9 @@ class Server {
       auto it = c->h2_streams.find(sid);
       if (it == c->h2_streams.end()) continue;  // reset meanwhile
       if (it->second.p.path == "/__pingoo/metrics") {
-        std::string body = metrics_body();
-        h2_submit(c, sid, 200,
-                  {{"content-type", "application/json"}}, std::move(body));
+        const char* ctype = nullptr;
+        std::string body = metrics_negotiated(it->second.p, &ctype);
+        h2_submit(c, sid, 200, {{"content-type", ctype}}, std::move(body));
         continue;
       }
       Policy outcome = run_policy(c, sid);
@@ -3984,6 +4090,7 @@ class Server {
       // other pseudo-headers ignored
     } else {
       if (n == "user-agent") p.user_agent = trim(v);
+      if (n == "accept") p.accept = lower(trim(v));
       if (n == "cookie" && p.verified_cookie.empty())
         p.verified_cookie = extract_verified_cookie(v);
       p.h2_headers.emplace_back(lower(n), v);

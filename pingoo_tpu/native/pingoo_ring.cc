@@ -2,6 +2,8 @@
 
 #include "pingoo_ring.h"
 
+#include <time.h>
+
 #include <atomic>
 #include <cstring>
 
@@ -9,6 +11,33 @@ namespace {
 
 inline std::atomic<uint64_t>* as_atomic(uint64_t* p) {
   return reinterpret_cast<std::atomic<uint64_t>*>(p);
+}
+
+inline void tel_add(uint64_t* field, uint64_t n) {
+  as_atomic(field)->fetch_add(n, std::memory_order_relaxed);
+}
+
+// CAS-max: racing producers may publish interleaved highs; the final
+// value is the max of all observed depths, which is what a high-water
+// mark means.
+inline void tel_max(uint64_t* field, uint64_t v) {
+  auto* a = as_atomic(field);
+  uint64_t cur = a->load(std::memory_order_relaxed);
+  while (v > cur &&
+         !a->compare_exchange_weak(cur, v, std::memory_order_relaxed)) {
+  }
+}
+
+// Verdict-wait bucket upper bounds in ms (last bucket +inf); keep in
+// sync with PINGOO_WAIT_BUCKETS and obs/schema.SHARED_WAIT_BUCKETS_MS.
+const uint64_t kWaitBoundsMs[PINGOO_WAIT_BUCKETS - 1] = {1,  2,   5,   10,
+                                                         50, 100, 1000};
+
+inline uint32_t wait_bucket(uint64_t ms) {
+  for (uint32_t i = 0; i < PINGOO_WAIT_BUCKETS - 1; ++i) {
+    if (ms < kWaitBoundsMs[i]) return i;
+  }
+  return PINGOO_WAIT_BUCKETS - 1;
 }
 
 struct Layout {
@@ -109,6 +138,7 @@ uint64_t pingoo_ring_enqueue_request(
       if (head->compare_exchange_weak(pos, pos + 1,
                                       std::memory_order_relaxed)) {
         slot->ticket = pos;
+        slot->enq_ms = pingoo_ring_now_ms();
         bool truncated = false;
         truncated |= copy_capped(slot->method, PINGOO_METHOD_CAP, method,
                                  method_len, &slot->method_len);
@@ -144,9 +174,15 @@ uint64_t pingoo_ring_enqueue_request(
           }
         }
         as_atomic(&slot->seq)->store(pos + 1, std::memory_order_release);
+        PingooRingTelemetry* tel = &header->telemetry;
+        tel_add(&tel->enqueued, 1);
+        uint64_t tail =
+            as_atomic(&header->req_tail)->load(std::memory_order_relaxed);
+        if (pos + 1 > tail) tel_max(&tel->depth_hwm, pos + 1 - tail);
         return pos;
       }
     } else if (diff < 0) {
+      tel_add(&header->telemetry.enqueue_full, 1);
       return UINT64_MAX;  // full
     } else {
       pos = head->load(std::memory_order_relaxed);
@@ -179,6 +215,7 @@ uint32_t pingoo_ring_dequeue_requests(void* mem, PingooRequestSlot* out,
       break;  // empty
     }
   }
+  if (count) tel_add(&header->telemetry.dequeued, count);
   return count;
 }
 
@@ -201,9 +238,11 @@ int pingoo_ring_post_verdict(void* mem, uint64_t ticket, uint8_t action,
         slot->action = action;
         slot->bot_score = bot_score;
         as_atomic(&slot->seq)->store(pos + 1, std::memory_order_release);
+        tel_add(&header->telemetry.verdicts_posted, 1);
         return 0;
       }
     } else if (diff < 0) {
+      tel_add(&header->telemetry.verdict_post_full, 1);
       return -1;  // full
     } else {
       pos = head->load(std::memory_order_relaxed);
@@ -241,6 +280,53 @@ uint32_t pingoo_ring_post_verdicts(void* mem, const uint64_t* tickets,
       return i;  // ring full: caller resumes from index i
   }
   return n;
+}
+
+uint64_t pingoo_ring_now_ms(void) {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000 +
+         static_cast<uint64_t>(ts.tv_nsec) / 1000000;
+}
+
+void pingoo_ring_record_waits(void* mem, const uint64_t* enq_ms,
+                              uint32_t n) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  PingooRingTelemetry* tel = &header->telemetry;
+  uint64_t now = pingoo_ring_now_ms();
+  uint64_t sum = 0;
+  uint64_t bucket_n[PINGOO_WAIT_BUCKETS] = {0};
+  for (uint32_t i = 0; i < n; ++i) {
+    // A clock-skewed (or zero) enq_ms clamps to 0 rather than wrapping
+    // into the +inf bucket.
+    uint64_t ms = enq_ms[i] && now > enq_ms[i] ? now - enq_ms[i] : 0;
+    sum += ms;
+    bucket_n[wait_bucket(ms)]++;
+  }
+  tel_add(&tel->wait_sum_ms, sum);
+  for (uint32_t b = 0; b < PINGOO_WAIT_BUCKETS; ++b) {
+    if (bucket_n[b]) tel_add(&tel->wait_hist[b], bucket_n[b]);
+  }
+}
+
+void pingoo_ring_telemetry_snapshot(void* mem, uint64_t* out) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  PingooRingTelemetry* tel = &header->telemetry;
+  auto rd = [](uint64_t* p) {
+    return as_atomic(p)->load(std::memory_order_relaxed);
+  };
+  uint64_t head = rd(&header->req_head);
+  uint64_t tail = rd(&header->req_tail);
+  out[0] = rd(&tel->enqueued);
+  out[1] = rd(&tel->enqueue_full);
+  out[2] = rd(&tel->dequeued);
+  out[3] = head > tail ? head - tail : 0;  // current depth
+  out[4] = rd(&tel->depth_hwm);
+  out[5] = rd(&tel->verdicts_posted);
+  out[6] = rd(&tel->verdict_post_full);
+  out[7] = rd(&tel->wait_sum_ms);
+  for (uint32_t b = 0; b < PINGOO_WAIT_BUCKETS; ++b)
+    out[8 + b] = rd(&tel->wait_hist[b]);
 }
 
 int pingoo_ring_poll_verdict(void* mem, uint64_t* ticket_out,

@@ -40,7 +40,9 @@ extern "C" {
 #endif
 
 #define PINGOO_RING_MAGIC 0x50474f52u  // "PGOR"
-#define PINGOO_RING_VERSION 3u
+// v4: slot carries enq_ms (monotonic enqueue timestamp) and the header
+// grows an atomic telemetry block (ISSUE 2 observability).
+#define PINGOO_RING_VERSION 4u
 
 #define PINGOO_METHOD_CAP 16
 #define PINGOO_HOST_CAP 256
@@ -74,6 +76,10 @@ typedef struct {
   // when seq == pos + 1.
   PINGOO_ALIGN8 uint64_t seq;
   uint64_t ticket;  // request id chosen by the producer
+  uint64_t enq_ms;  // CLOCK_MONOTONIC ms at enqueue (set by the ring);
+                    // consumers feed it back via pingoo_ring_record_waits
+                    // so the telemetry block's verdict-wait histogram
+                    // measures enqueue -> verdict-post per request
   uint16_t method_len, host_len, path_len, url_len, ua_len;
   uint16_t remote_port;
   uint8_t ip[16];  // big-endian, v4 addresses v6-mapped (::ffff:a.b.c.d)
@@ -100,6 +106,34 @@ typedef struct {
   float bot_score;
 } PingooVerdictSlot;
 
+// Verdict-wait histogram bucket upper bounds (ms); the last bucket is
+// +inf. Shared with both planes' Prometheus exposition
+// (pingoo_verdict_wait_ms, pingoo_tpu/obs/schema.py).
+#define PINGOO_WAIT_BUCKETS 8u
+// bounds: 1, 2, 5, 10, 50, 100, 1000, +inf
+
+// Atomic telemetry block inside the shared header (v4): counters the
+// producers/consumers maintain with relaxed fetch-adds so queue health
+// (depth high-water mark, full-ring stalls, enqueue->verdict-post wait)
+// is visible to BOTH planes' /__pingoo/metrics scrape without any
+// side-channel. All fields monotonic except depth (derived).
+typedef struct {
+  PINGOO_ALIGN64 uint64_t enqueued;     // request slots enqueued
+  uint64_t enqueue_full;                // enqueues refused: request ring full
+  uint64_t dequeued;                    // request slots dequeued
+  uint64_t depth_hwm;                   // high-water mark of queued requests
+  uint64_t verdicts_posted;             // verdict slots posted
+  uint64_t verdict_post_full;           // posts refused: verdict ring full
+  uint64_t wait_sum_ms;                 // sum of recorded waits (ms)
+  uint64_t wait_hist[PINGOO_WAIT_BUCKETS];  // enqueue -> verdict-post
+} PingooRingTelemetry;
+
+// Flat snapshot order for pingoo_ring_telemetry_snapshot (one uint64
+// array keeps the ctypes binding to a single pointer): enqueued,
+// enqueue_full, dequeued, depth (head - tail, sampled now), depth_hwm,
+// verdicts_posted, verdict_post_full, wait_sum_ms, wait_hist[8].
+#define PINGOO_TELEMETRY_WORDS (8u + PINGOO_WAIT_BUCKETS)
+
 typedef struct {
   uint32_t magic;
   uint32_t version;
@@ -111,6 +145,7 @@ typedef struct {
   PINGOO_ALIGN64 uint64_t req_tail;  // consumer counter
   PINGOO_ALIGN64 uint64_t ver_head;
   PINGOO_ALIGN64 uint64_t ver_tail;
+  PINGOO_ALIGN64 PingooRingTelemetry telemetry;
 } PingooRingHeader;
 
 // Size of the full mapping for a given capacity.
@@ -157,6 +192,21 @@ int pingoo_ring_spill_read(void* mem, uint8_t idx, const char** url,
 // Release a spill slot back to the free pool (consumer side, after the
 // row's verdict was computed over the untruncated strings).
 void pingoo_ring_spill_release(void* mem, uint8_t idx);
+
+// Copy the telemetry block into out[PINGOO_TELEMETRY_WORDS] (flat
+// order documented at PINGOO_TELEMETRY_WORDS above). Relaxed loads:
+// a scrape-time snapshot, not a linearization point.
+void pingoo_ring_telemetry_snapshot(void* mem, uint64_t* out);
+
+// Record n enqueue->now waits into the telemetry wait histogram; the
+// consumer passes the dequeued slots' enq_ms values at verdict-post
+// time (one FFI hop per batch for the Python sidecar).
+void pingoo_ring_record_waits(void* mem, const uint64_t* enq_ms,
+                              uint32_t n);
+
+// CLOCK_MONOTONIC milliseconds — the enq_ms time base, exported so
+// out-of-process consumers compute waits against the same clock.
+uint64_t pingoo_ring_now_ms(void);
 
 #ifdef __cplusplus
 }  // extern "C"

@@ -37,6 +37,7 @@ SPILL_NONE = 0xFF  # PINGOO_SPILL_NONE
 REQUEST_SLOT_DTYPE = np.dtype([
     ("seq", "<u8"),
     ("ticket", "<u8"),
+    ("enq_ms", "<u8"),  # CLOCK_MONOTONIC ms at enqueue (ring v4)
     ("method_len", "<u2"), ("host_len", "<u2"), ("path_len", "<u2"),
     ("url_len", "<u2"), ("ua_len", "<u2"),
     ("remote_port", "<u2"),
@@ -50,9 +51,17 @@ REQUEST_SLOT_DTYPE = np.dtype([
     ("path", "u1", 2048),
     ("url", "u1", 2048),
     ("user_agent", "u1", 256),
-    ("_tail_pad", "S4"),  # C struct pads to 8-byte alignment (4680 bytes)
+    ("_tail_pad", "S4"),  # C struct pads to 8-byte alignment (4688 bytes)
 ])
-assert REQUEST_SLOT_DTYPE.itemsize == 4680, REQUEST_SLOT_DTYPE.itemsize
+assert REQUEST_SLOT_DTYPE.itemsize == 4688, REQUEST_SLOT_DTYPE.itemsize
+
+# Flat order of pingoo_ring_telemetry_snapshot (pingoo_ring.h
+# PINGOO_TELEMETRY_WORDS); the 8 wait_hist buckets follow.
+TELEMETRY_FIELDS = ("enqueued", "enqueue_full", "dequeued", "depth",
+                    "depth_hwm", "verdicts_posted", "verdict_post_full",
+                    "wait_sum_ms")
+TELEMETRY_WORDS = len(TELEMETRY_FIELDS) + 8
+WAIT_BUCKET_BOUNDS_MS = (1, 2, 5, 10, 50, 100, 1000)  # last bucket +inf
 
 
 def ensure_built() -> bool:
@@ -106,6 +115,12 @@ def _load_lib():
         ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_uint32)]
     lib.pingoo_ring_spill_release.argtypes = [ctypes.c_void_p,
                                               ctypes.c_uint8]
+    lib.pingoo_ring_telemetry_snapshot.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.pingoo_ring_record_waits.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32]
+    lib.pingoo_ring_now_ms.restype = ctypes.c_uint64
+    lib.pingoo_ring_now_ms.argtypes = []
     return lib
 
 
@@ -191,6 +206,29 @@ class Ring:
 
     def spill_release(self, idx: int) -> None:
         self.lib.pingoo_ring_spill_release(self.addr, idx)
+
+    def telemetry(self) -> dict:
+        """Snapshot of the shm header's atomic telemetry block (ring
+        v4): queue counters, depth + high-water mark, full-ring stalls,
+        and the enqueue->verdict-post wait histogram (bucket upper
+        bounds WAIT_BUCKET_BOUNDS_MS, last bucket +inf)."""
+        buf = (ctypes.c_uint64 * TELEMETRY_WORDS)()
+        if not self.map.closed:  # post-close scrape reads zeros, not UB
+            self.lib.pingoo_ring_telemetry_snapshot(self.addr, buf)
+        out = {name: int(buf[i]) for i, name in enumerate(TELEMETRY_FIELDS)}
+        out["wait_hist"] = [int(buf[len(TELEMETRY_FIELDS) + b])
+                            for b in range(8)]
+        return out
+
+    def record_waits(self, enq_ms: np.ndarray) -> None:
+        """Feed dequeued slots' enq_ms back at verdict-post time (one
+        FFI hop per batch) so the telemetry wait histogram measures
+        enqueue -> verdict-post per request."""
+        if self.map.closed:
+            return
+        enq = np.ascontiguousarray(enq_ms, dtype=np.uint64)
+        self.lib.pingoo_ring_record_waits(
+            self.addr, enq.ctypes.data_as(ctypes.c_void_p), len(enq))
 
     def poll_verdict(self) -> Optional[tuple[int, int, float]]:
         ticket = ctypes.c_uint64()
@@ -417,6 +455,22 @@ class RingSidecar:
         self._ring_rr = -1  # rotating drain start (multi-ring fairness)
         self._thread = None  # set by run(); joined by stop()
         self._stop = False
+        # Unified telemetry (obs/): per-stage drain-loop histograms plus
+        # a collector that folds the rings' shm telemetry blocks into
+        # the shared registry, so the Python control-plane scrape
+        # carries native-plane queue state in the same exposition.
+        from .obs import REGISTRY
+
+        self._registry = REGISTRY
+        self._stage = {
+            stage: REGISTRY.histogram(
+                "pingoo_verdict_stage_ms",
+                "verdict pipeline stage latency (ms)",
+                labels={"plane": "sidecar", "stage": stage})
+            for stage in ("encode", "device_dispatch", "device_compute",
+                          "resolve")}
+        self._collector_live = True
+        REGISTRY.register_collector(self._export_ring_telemetry)
 
     def run(self, max_requests: Optional[int] = None) -> int:
         """Blocking drain loop; returns requests processed.
@@ -476,11 +530,16 @@ class RingSidecar:
                 # two so the NFA scan walks the batch's longest value,
                 # not the 2048-byte slot capacity (at most log2(cap)
                 # shapes per field).
+                t0 = time.monotonic()
                 raw = RequestBatch(size=n, arrays=slots_to_arrays(slots))
                 batch = pad_batch(
                     RequestBatch(size=n, arrays=bucket_arrays(raw.arrays)),
                     self.max_batch)
+                t1 = time.monotonic()
                 dev = self._lane_fn(self._tables, batch.arrays)  # async
+                t2 = time.monotonic()
+                self._stage["encode"].observe((t1 - t0) * 1e3)
+                self._stage["device_dispatch"].observe((t2 - t1) * 1e3)
                 inflight.append((parts, slots, raw, dev, n))
             if inflight and (len(inflight) >= self.pipeline_depth or n == 0):
                 self._complete(*inflight.popleft())
@@ -527,7 +586,10 @@ class RingSidecar:
         host = host_rule_lanes(self.plan, raw_batch, self.lists)
         t0 = time.time()
         dev_lanes = np.asarray(dev)[:, :n]  # drop batch-padding rows
-        self.device_wait_s += time.time() - t0
+        wait_s = time.time() - t0
+        self.device_wait_s += wait_s
+        self._stage["device_compute"].observe(wait_s * 1e3)
+        t_resolve = time.monotonic()
         self.batches += 1
         unverified, verified_block = merge_lanes(dev_lanes, host)
         # Rows the producer flagged as truncated (a field exceeded its
@@ -627,7 +689,12 @@ class RingSidecar:
                     if self._stop:  # a dead consumer must not wedge stop()
                         return
                     time.sleep(self.idle_sleep_s)
+            # Telemetry: enqueue -> verdict-post wall time for this
+            # ring's rows lands in the shm wait histogram (one FFI hop).
+            ring.record_waits(part["enq_ms"])
             off += m
+        self._stage["resolve"].observe(
+            (time.monotonic() - t_resolve) * 1e3)
         self.processed += n
 
     def _interpret_overflow_row(self, slot, url: bytes, path: bytes,
@@ -670,6 +737,56 @@ class RingSidecar:
                 break
         return int(unv[0]), bool(vblk[0]), rt
 
+    def ring_telemetry(self) -> dict:
+        """Aggregate shm telemetry across this sidecar's rings: sum the
+        monotonic counters and the wait histogram, max the depth marks
+        (the per-ring blocks stay available via Ring.telemetry())."""
+        agg = {name: 0 for name in TELEMETRY_FIELDS}
+        agg["wait_hist"] = [0] * 8
+        for ring in self.rings:
+            t = ring.telemetry()
+            for name in TELEMETRY_FIELDS:
+                if name in ("depth", "depth_hwm"):
+                    agg[name] = max(agg[name], t[name])
+                else:
+                    agg[name] += t[name]
+            agg["wait_hist"] = [a + b for a, b in
+                                zip(agg["wait_hist"], t["wait_hist"])]
+        return agg
+
+    def _export_ring_telemetry(self) -> None:
+        """Registry collector: fold the rings' telemetry blocks into the
+        shared exposition (pingoo_ring_* metrics, obs/schema.py). Runs
+        at scrape time; must never touch a ring after stop()."""
+        if not self._collector_live:
+            return
+        from .obs import schema
+
+        t = self.ring_telemetry()
+        reg = self._registry
+        lab = {"plane": "sidecar"}
+        for name, field in (
+                ("pingoo_ring_enqueued_total", "enqueued"),
+                ("pingoo_ring_dequeued_total", "dequeued"),
+                ("pingoo_ring_enqueue_full_total", "enqueue_full"),
+                ("pingoo_ring_verdicts_posted_total", "verdicts_posted"),
+                ("pingoo_ring_verdict_post_full_total",
+                 "verdict_post_full")):
+            reg.counter(name, schema.RING_METRICS[name],
+                        labels=lab).set_total(t[field])
+        reg.gauge("pingoo_ring_depth",
+                  schema.RING_METRICS["pingoo_ring_depth"],
+                  labels=lab).set(t["depth"])
+        reg.gauge("pingoo_ring_depth_hwm",
+                  schema.RING_METRICS["pingoo_ring_depth_hwm"],
+                  labels=lab).set(t["depth_hwm"])
+        reg.histogram(
+            schema.SHARED_WAIT_HISTOGRAM,
+            "verdict wait: ring enqueue -> verdict post (ms)",
+            buckets=WAIT_BUCKET_BOUNDS_MS,
+            labels=lab).set_bucket_counts(
+                t["wait_hist"], total_sum=float(t["wait_sum_ms"]))
+
     def stats(self) -> dict:
         """Observability surface for the serving path (SURVEY §5):
         scraped by operators next to the native plane's
@@ -685,6 +802,7 @@ class RingSidecar:
             "truncated_rows": self.truncated_rows,
             "spilled_rows": self.spilled_rows,
             "rings": len(self.rings),
+            "ring_telemetry": self.ring_telemetry(),
         }
 
     def stop(self, join_timeout_s: float = 10.0) -> None:
@@ -695,6 +813,11 @@ class RingSidecar:
         not an exception."""
         import threading as _threading
 
+        # Detach the registry collector FIRST: a scrape after the
+        # caller unmaps the rings would be a use-after-munmap in the
+        # telemetry snapshot FFI call.
+        self._collector_live = False
+        self._registry.unregister_collector(self._export_ring_telemetry)
         self._stop = True
         t = self._thread
         if t is not None and t.is_alive()                 and t is not _threading.current_thread():

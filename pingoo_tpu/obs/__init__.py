@@ -1,0 +1,20 @@
+"""Unified telemetry: metric registry, Prometheus/JSON exposition,
+trace ids, and the shared metric inventory (ISSUE 2 / SURVEY.md §5).
+
+Import surface:
+    from pingoo_tpu.obs import REGISTRY, get_registry
+    from pingoo_tpu.obs.trace import new_trace_id, AccessLogSampler
+    from pingoo_tpu.obs import schema
+"""
+
+from .registry import (  # noqa: F401
+    LATENCY_BUCKETS_MS,
+    WAIT_BUCKETS_MS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    REGISTRY,
+    get_registry,
+)
+from . import schema  # noqa: F401

@@ -1,0 +1,93 @@
+"""The documented metric inventory — the parity contract between the
+three telemetry surfaces (Python listener, native httpd, ring sidecar).
+
+WAFFLED (PAPERS.md) turns parsing discrepancies between WAF planes into
+bypasses; the counter-measure on the telemetry side is that both planes
+export the SAME metric names for shared concepts so divergence (e.g.
+native `requests` minus sidecar `processed`) is one subtraction on one
+scrape, not a join across incompatible schemas. tests/test_obs.py and
+tools/check_metrics_schema.py enforce this inventory against the actual
+expositions; docs/OBSERVABILITY.md is the human-readable copy.
+"""
+
+from __future__ import annotations
+
+# Metric names every plane that handles requests must expose (with a
+# `plane` label distinguishing the source: python | native).
+SHARED_METRICS = {
+    "pingoo_requests_total": "requests entering the WAF hot path",
+    "pingoo_blocked_total": "requests answered 403 by a verdict",
+    "pingoo_captcha_total": "captcha challenges served/redirected",
+    "pingoo_fail_open_total":
+        "requests released without a verdict (ring full, verdict "
+        "deadline, engine error)",
+}
+
+# Shared verdict-wait histogram: identical bucket upper bounds (ms) on
+# every surface. Native plane: enqueue -> verdict-apply wall time
+# (httpd.cc record_wait). Python plane: evaluate() -> resolve wall time
+# (the pre-registry `verdict_ms`). Ring telemetry block: enqueue ->
+# verdict-post (pingoo_ring.cc record_waits).
+SHARED_WAIT_HISTOGRAM = "pingoo_verdict_wait_ms"
+SHARED_WAIT_BUCKETS_MS = (1, 2, 5, 10, 50, 100, 1000)
+
+# Python-plane verdict pipeline stages, in hot-path order
+# (engine/service.py): each is a pingoo_verdict_stage_ms{stage=...}
+# histogram.
+VERDICT_STAGES = (
+    "queue_wait",      # evaluate() enqueue -> collector pop
+    "batch_assembly",  # collector pop -> batch dispatch (the wait window)
+    "encode",          # RequestTuple list -> fixed-shape arrays
+    "device_dispatch", # jitted call issue (async) incl. host->device
+    "device_compute",  # block_until_ready on the device result
+    "resolve",         # lanes/actions + future resolution
+)
+
+# Ring telemetry block metrics (source: the shm header's atomic
+# telemetry block, pingoo_ring.h PingooRingTelemetry), exported by BOTH
+# the native httpd (it maps the ring) and the sidecar drainer (so the
+# Python control-plane scrape carries native-plane queue state).
+RING_METRICS = {
+    "pingoo_ring_enqueued_total": "request slots enqueued",
+    "pingoo_ring_dequeued_total": "request slots dequeued",
+    "pingoo_ring_enqueue_full_total":
+        "enqueue attempts refused because the request ring was full",
+    "pingoo_ring_verdicts_posted_total": "verdict slots posted",
+    "pingoo_ring_verdict_post_full_total":
+        "verdict posts that hit a full verdict ring (retried)",
+    "pingoo_ring_depth": "request slots currently queued",
+    "pingoo_ring_depth_hwm": "high-water mark of queued request slots",
+}
+
+# Native-plane-only counters (httpd.cc Stats), exported with
+# plane="native" under these names.
+NATIVE_METRICS = {
+    "pingoo_ua_rejected_total": "empty/oversized UA pre-ring 403s",
+    "pingoo_no_service_total": "route bits said no service (404)",
+    "pingoo_upstream_fail_total": "upstream connect/response failures (502)",
+    "pingoo_upstream_tls_fail_total":
+        "upstream TLS handshake/verify failures",
+    "pingoo_verdicts_total": "verdict bytes applied",
+    "pingoo_connections": "open client connections",
+    "pingoo_pooled_upstreams": "idle pooled upstream connections",
+}
+
+# JSON back-compat keys (the pre-registry schemas, still served under
+# Accept: application/json). Maps JSON key -> metric name, per plane.
+PYTHON_JSON_KEYS = {
+    "requests": "pingoo_requests_total",
+    "blocked": "pingoo_blocked_total",
+    "captcha_served": "pingoo_captcha_total",
+}
+NATIVE_JSON_KEYS = {
+    "requests": "pingoo_requests_total",
+    "blocked": "pingoo_blocked_total",
+    "captcha": "pingoo_captcha_total",
+    "fail_open": "pingoo_fail_open_total",
+    "verdict_wait_ms_hist": "pingoo_verdict_wait_ms",
+}
+
+
+def all_metric_names() -> set[str]:
+    return (set(SHARED_METRICS) | set(RING_METRICS) | set(NATIVE_METRICS)
+            | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

@@ -225,11 +225,66 @@ class TestEndToEnd:
 
     def test_metrics_endpoint(self, env):
         env.run(http_get(env.port, "/"))
-        status, _, body = env.run(http_get(env.port, "/__pingoo/metrics"))
+        # JSON (back-compat schema) under Accept: application/json.
+        status, _, body = env.run(http_get(
+            env.port, "/__pingoo/metrics",
+            headers={"accept": "application/json"}))
         assert status == 200
         payload = json.loads(body)
         assert payload["requests"] >= 1
         assert "verdict" in payload
+        assert "stages" in payload["verdict"]  # per-stage breakdown
+        # Prometheus text is the default exposition.
+        status, headers, body = env.run(
+            http_get(env.port, "/__pingoo/metrics"))
+        assert status == 200
+        assert "text/plain" in headers["content-type"]
+        text = body.decode()
+        assert "pingoo_requests_total" in text
+        assert "pingoo_verdict_stage_ms_bucket" in text
+        from pingoo_tpu.obs.registry import lint_prometheus_text
+
+        assert lint_prometheus_text(text) == []
+
+    def test_trace_id_header_and_sampled_access_log(self, env, caplog):
+        import logging
+
+        from pingoo_tpu.obs.trace import TRACE_HEADER
+
+        listener = env.server.http_listeners[0]
+        old_every = listener._access_log.sample_every
+        listener._access_log.sample_every = 1  # log every request
+        try:
+            with caplog.at_level(logging.INFO, logger="pingoo_tpu.access"):
+                status, headers, _ = env.run(http_get(env.port, "/"))
+        finally:
+            listener._access_log.sample_every = old_every
+        assert status == 200
+        trace_id = headers[TRACE_HEADER]
+        assert len(trace_id) == 16
+        logged = [r for r in caplog.records
+                  if getattr(r, "fields", {}).get("trace_id") == trace_id]
+        assert logged, "trace id missing from sampled access log"
+        assert logged[0].fields["status"] == 200
+
+    def test_profile_endpoint_bounded_window(self, env):
+        status, _, body = env.run(http_get(
+            env.port, "/__pingoo/profile?seconds=0.2"))
+        payload = json.loads(body)
+        if status == 200:
+            assert payload["profiling"] is True and payload["dir"]
+            # A second capture while the window is live must 409.
+            status2, _, body2 = env.run(http_get(
+                env.port, "/__pingoo/profile?seconds=0.2"))
+            assert status2 == 409
+            assert "already active" in json.loads(body2)["error"]
+            import time as _time
+
+            _time.sleep(0.4)  # window closes on its own
+        else:
+            # Profiler unavailable on this backend build: must still be
+            # a clean, typed refusal, never a 500.
+            assert status == 503 and "error" in payload
 
     def test_unknown_file_404(self, env):
         status, _, _ = env.run(http_get(env.port, "/nope.xyz"))

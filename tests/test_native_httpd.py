@@ -27,10 +27,10 @@ def _free_port():
     return port
 
 
-def _raw_get(port, path, ua="Mozilla/5.0", timeout=10):
+def _raw_get(port, path, ua="Mozilla/5.0", timeout=10, extra=""):
     s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
     ua_line = f"user-agent: {ua}\r\n" if ua is not None else ""
-    s.sendall(f"GET {path} HTTP/1.1\r\nhost: n.test\r\n{ua_line}"
+    s.sendall(f"GET {path} HTTP/1.1\r\nhost: n.test\r\n{ua_line}{extra}"
               f"connection: close\r\n\r\n".encode())
     data = b""
     s.settimeout(timeout)
@@ -126,6 +126,49 @@ class TestNativeHttpd:
         data = s.recv(4096)
         s.close()
         assert data.startswith(b"HTTP/1.1 400")
+
+    def test_metrics_json_complete(self, native_stack):
+        """The truncation assertion for the metrics body: the old fixed
+        1024-byte snprintf buffer could silently cut the JSON mid-field
+        (invalid on the wire); the std::string builder must always emit
+        a complete, parseable document with every schema field."""
+        import json
+
+        _raw_get(native_stack, "/warm")  # ensure counters are non-zero
+        data = _raw_get(native_stack, "/__pingoo/metrics",
+                        extra="accept: application/json\r\n")
+        head, _, body = data.partition(b"\r\n\r\n")
+        assert head.startswith(b"HTTP/1.1 200")
+        assert b"application/json" in head
+        clen = int([line for line in head.split(b"\r\n")
+                    if line.lower().startswith(b"content-length")][0]
+                   .split(b":")[1])
+        assert len(body) == clen  # body not truncated mid-flight
+        m = json.loads(body)  # complete + valid (the assertion proper)
+        from pingoo_tpu.obs import schema
+
+        for key in schema.NATIVE_JSON_KEYS:
+            assert key in m, key
+        assert set(m["ring"]) >= {"enqueued", "dequeued", "depth",
+                                  "depth_hwm", "enqueue_full",
+                                  "verdicts_posted", "verdict_post_full"}
+        assert m["ring"]["enqueued"] >= 1
+
+    def test_metrics_prometheus_default(self, native_stack):
+        from pingoo_tpu.obs import schema
+        from pingoo_tpu.obs.registry import lint_prometheus_text
+
+        data = _raw_get(native_stack, "/__pingoo/metrics")
+        head, _, body = data.partition(b"\r\n\r\n")
+        assert head.startswith(b"HTTP/1.1 200")
+        assert b"text/plain" in head
+        text = body.decode()
+        assert lint_prometheus_text(text) == []
+        for name in schema.SHARED_METRICS:
+            assert f'{name}{{plane="native"}}' in text, name
+        assert 'pingoo_verdict_wait_ms_bucket{plane="native",le="+Inf"}' \
+            in text
+        assert 'pingoo_ring_depth{plane="native"}' in text
 
     def test_many_concurrent(self, native_stack):
         results = []

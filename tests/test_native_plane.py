@@ -1360,7 +1360,8 @@ class TestNativeMetrics:
             out = raw_request(
                 stack.port,
                 b"GET /__pingoo/metrics HTTP/1.1\r\nhost: t\r\n"
-                b"user-agent: u\r\nconnection: close\r\n\r\n")
+                b"user-agent: u\r\naccept: application/json\r\n"
+                b"connection: close\r\n\r\n")
             head, _, body = out.partition(b"\r\n\r\n")
             assert head.startswith(b"HTTP/1.1 200")
             m = json.loads(body)
@@ -1371,11 +1372,31 @@ class TestNativeMetrics:
             hist_total = sum(m["verdict_wait_ms_hist"].values())
             assert hist_total == m["verdicts"]
             assert "ring_pending" in m and "pooled_upstreams" in m
+            # shm ring telemetry block (ring v4) rides the same scrape.
+            assert m["ring"]["enqueued"] >= 3
+            assert m["ring"]["verdicts_posted"] >= 3
+            assert m["ring"]["depth_hwm"] >= 1
+            # Default exposition (no Accept) is Prometheus text with
+            # the shared metric names.
+            out = raw_request(
+                stack.port,
+                b"GET /__pingoo/metrics HTTP/1.1\r\nhost: t\r\n"
+                b"user-agent: u\r\nconnection: close\r\n\r\n")
+            head, _, body = out.partition(b"\r\n\r\n")
+            assert head.startswith(b"HTTP/1.1 200")
+            assert b"text/plain" in head
+            text = body.decode()
+            assert "pingoo_requests_total{plane=\"native\"}" in text
+            assert "pingoo_verdict_wait_ms_bucket" in text
+            from pingoo_tpu.obs.registry import lint_prometheus_text
+
+            assert lint_prometheus_text(text) == []
             st = stack.sidecar.stats()
             assert st["processed"] >= 3
             assert st["batches"] >= 1
             assert st["batch_occupancy"] > 0
             assert st["device_wait_ms_per_batch"] >= 0
+            assert st["ring_telemetry"]["dequeued"] >= 3
         finally:
             stack.stop()
 
@@ -1729,8 +1750,11 @@ class TestNativePlaneRunner:
         loop_runner.run(plane.start(), timeout=180)
         try:
             def get(path):
+                # accept json keeps the metrics scrape on the legacy
+                # schema (the default exposition is Prometheus now).
                 req = urllib.request.Request(
-                    f"http://127.0.0.1:{port}{path}")
+                    f"http://127.0.0.1:{port}{path}",
+                    headers={"accept": "application/json"})
                 try:
                     with urllib.request.urlopen(req, timeout=30) as r:
                         return r.status, r.read()
@@ -1978,7 +2002,8 @@ class TestTlsUpstreamNative:
         out = raw_request(
             port,
             b"GET /__pingoo/metrics HTTP/1.1\r\nhost: t\r\n"
-            b"user-agent: m/1.0\r\nconnection: close\r\n\r\n")
+            b"user-agent: m/1.0\r\naccept: application/json\r\n"
+            b"connection: close\r\n\r\n")
         return json.loads(out.split(b"\r\n\r\n", 1)[1])
 
     def test_tls_upstream_proxied_verified_and_pooled(self, tmp_path):
@@ -2225,7 +2250,8 @@ class TestTlsUpstreamTruncation:
             m = json.loads(raw_request(
                 stack.port,
                 b"GET /__pingoo/metrics HTTP/1.1\r\nhost: t\r\n"
-                b"user-agent: m\r\nconnection: close\r\n\r\n"
+                b"user-agent: m\r\naccept: application/json\r\n"
+                b"connection: close\r\n\r\n"
             ).split(b"\r\n\r\n", 1)[1])
             assert m["upstream_tls_fail"] == 0  # handshakes all fine
         finally:

@@ -1,0 +1,257 @@
+"""Unified telemetry layer (pingoo_tpu/obs): registry semantics,
+Prometheus exposition lint, the cross-plane metrics-schema parity
+contract, trace ids, and access-log sampling. No accelerator needed —
+the one jax-touching test (per-stage service histograms) runs via the
+CPU-pinned VerdictService like the rest of tier 1."""
+
+import json
+import logging
+
+import pytest
+
+from pingoo_tpu.obs import schema
+from pingoo_tpu.obs.registry import (
+    LATENCY_BUCKETS_MS,
+    WAIT_BUCKETS_MS,
+    MetricRegistry,
+    lint_prometheus_text,
+)
+from pingoo_tpu.obs.trace import AccessLogSampler, new_trace_id
+
+
+class TestRegistry:
+    def test_counter_gauge_roundtrip(self):
+        reg = MetricRegistry()
+        c = reg.counter("t_total", "help")
+        c.inc()
+        c.inc(4)
+        assert c.value == 5
+        # get-or-create: same (name, labels) -> same instrument
+        assert reg.counter("t_total") is c
+        g = reg.gauge("t_depth", "help", labels={"plane": "x"})
+        g.set(7)
+        g.dec()
+        assert g.value == 6
+        assert reg.gauge("t_depth", labels={"plane": "y"}) is not g
+
+    def test_histogram_buckets_and_percentiles(self):
+        reg = MetricRegistry()
+        h = reg.histogram("t_ms", "help", buckets=WAIT_BUCKETS_MS)
+        for v in (0.4, 1.5, 1.5, 7, 60, 5000):
+            h.observe(v)
+        assert h.count == 6
+        snap = h.snapshot()
+        assert snap["buckets"]["1"] == 1
+        assert snap["buckets"]["2"] == 3
+        assert snap["buckets"]["+Inf"] == 6
+        assert h.percentile(0.5) == 2.0  # bucket-upper-bound estimate
+        # +inf observations report the largest finite bound
+        assert h.percentile(1.0) == 1000.0
+
+    def test_histogram_external_bucket_mirror(self):
+        reg = MetricRegistry()
+        h = reg.histogram("t_wait_ms", "", buckets=WAIT_BUCKETS_MS)
+        h.set_bucket_counts([2, 1, 0, 0, 0, 0, 0, 1], total_sum=2000.0)
+        assert h.count == 4
+        assert h.sum == 2000.0
+        assert h.percentile(0.5) == 1.0
+        with pytest.raises(ValueError):
+            h.set_bucket_counts([1, 2, 3])  # wrong arity
+
+    def test_prometheus_text_lints_clean(self):
+        reg = MetricRegistry()
+        reg.counter("pingoo_requests_total", "requests",
+                    labels={"plane": "python", "listener": "l0"}).inc(3)
+        reg.gauge("pingoo_ring_depth", "depth",
+                  labels={"plane": "native"}).set(2)
+        h = reg.histogram("pingoo_verdict_wait_ms", "wait",
+                          buckets=WAIT_BUCKETS_MS,
+                          labels={"plane": "python"})
+        for v in (0.5, 3, 3, 42, 1500):
+            h.observe(v)
+        text = reg.prometheus_text()
+        assert lint_prometheus_text(text) == []
+        assert ('pingoo_requests_total{listener="l0",plane="python"} 3'
+                in text)
+        assert ('pingoo_verdict_wait_ms_bucket{le="+Inf",plane="python"} 5'
+                in text)
+        assert "# TYPE pingoo_verdict_wait_ms histogram" in text
+
+    def test_lint_catches_broken_exposition(self):
+        bad = ("# TYPE x_total counter\n"
+               "x_total{le=} 3\n")
+        assert lint_prometheus_text(bad)
+        no_type = "lonely_metric 1\n"
+        assert any("without TYPE" in p
+                   for p in lint_prometheus_text(no_type))
+        non_cumulative = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\n'
+            'h_bucket{le="2"} 3\n'
+            'h_bucket{le="+Inf"} 5\n'
+            "h_sum 1\nh_count 5\n")
+        assert any("cumulative" in p
+                   for p in lint_prometheus_text(non_cumulative))
+        missing_inf = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 1\n'
+            "h_sum 1\nh_count 1\n")
+        assert any("+Inf" in p for p in lint_prometheus_text(missing_inf))
+
+    def test_collectors_fold_external_sources(self):
+        reg = MetricRegistry()
+
+        def collect():
+            reg.counter("ext_total", "ext").set_total(42)
+
+        reg.register_collector(collect)
+        assert "ext_total 42" in reg.prometheus_text()
+        snap = reg.json_snapshot()
+        assert snap["ext_total"] == 42
+        reg.unregister_collector(collect)
+
+    def test_broken_collector_never_breaks_scrape(self):
+        reg = MetricRegistry()
+        reg.counter("ok_total", "x").inc()
+
+        def broken():
+            raise RuntimeError("ring unmapped")
+
+        reg.register_collector(broken)
+        assert "ok_total 1" in reg.prometheus_text()
+
+    def test_stage_snapshot_keys_by_plane(self):
+        reg = MetricRegistry()
+        reg.histogram("pingoo_verdict_stage_ms", "",
+                      buckets=LATENCY_BUCKETS_MS,
+                      labels={"plane": "python",
+                              "stage": "encode"}).observe(0.3)
+        reg.histogram("pingoo_verdict_stage_ms", "",
+                      buckets=LATENCY_BUCKETS_MS,
+                      labels={"plane": "sidecar",
+                              "stage": "encode"}).observe(0.4)
+        snap = reg.stage_snapshot()
+        assert set(snap) == {"python:encode", "sidecar:encode"}
+        assert snap["python:encode"]["count"] == 1
+
+
+class TestSchemaParity:
+    """The cross-surface contract (ISSUE 2 satellite): every plane uses
+    the same metric names for shared concepts. The native plane's
+    exposition is C++ string literals, so the source IS the schema."""
+
+    def _native_source(self):
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..",
+                            "pingoo_tpu", "native", "httpd.cc")
+        with open(path) as f:
+            return f.read()
+
+    def test_native_exposes_inventory(self):
+        src = self._native_source()
+        for name in (set(schema.SHARED_METRICS) | set(schema.RING_METRICS)
+                     | set(schema.NATIVE_METRICS)):
+            assert name in src, f"native plane missing {name}"
+        assert schema.SHARED_WAIT_HISTOGRAM + "_bucket" in src
+        for key in schema.NATIVE_JSON_KEYS:
+            assert f'"{key}"' in src
+
+    def test_python_listener_exposes_shared_names(self):
+        import pingoo_tpu.host.httpd as httpd_mod
+        import inspect
+
+        src = inspect.getsource(httpd_mod)
+        for name in schema.SHARED_METRICS:
+            assert name in src, f"python listener missing {name}"
+
+    def test_sidecar_exports_ring_names(self):
+        import pingoo_tpu.native_ring as nr
+        import inspect
+
+        src = inspect.getsource(nr)
+        for name in schema.RING_METRICS:
+            assert name in src, f"sidecar missing {name}"
+
+    def test_wait_buckets_match_everywhere(self):
+        # Python registry bounds == documented shared bounds == the
+        # native record_wait bounds == the ring telemetry bounds.
+        from pingoo_tpu.native_ring import WAIT_BUCKET_BOUNDS_MS
+
+        assert tuple(WAIT_BUCKET_BOUNDS_MS) == schema.SHARED_WAIT_BUCKETS_MS
+        assert tuple(int(b) for b in WAIT_BUCKETS_MS) == \
+            schema.SHARED_WAIT_BUCKETS_MS
+        src = self._native_source()
+        assert "{1, 2, 5, 10, 50, 100, 1000}" in src
+        # audit tool agrees end-to-end
+        import subprocess
+        import sys
+        import os
+
+        repo = os.path.join(os.path.dirname(__file__), "..")
+        proc = subprocess.run(
+            [sys.executable, os.path.join(repo, "tools",
+                                          "check_metrics_schema.py")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+
+
+class TestServiceStages:
+    def test_service_stats_snapshot_backcompat_keys(self):
+        from pingoo_tpu.engine.service import ServiceStats
+
+        stats = ServiceStats()
+        snap = stats.snapshot()
+        # the pre-registry schema keys survive (back-compat contract)
+        for key in ("batches", "requests", "device_errors", "score_errors",
+                    "host_fallback_batches", "mean_occupancy",
+                    "verdict_p50_ms", "verdict_p99_ms"):
+            assert key in snap, key
+        assert set(snap["stages"]) == set(schema.VERDICT_STAGES)
+
+    def test_stage_observation_is_bounded_memory(self):
+        from pingoo_tpu.engine.service import ServiceStats
+
+        stats = ServiceStats()
+        for i in range(100_000):  # the old list grew to 65536 floats
+            stats.wait_hist.observe(i % 7)
+        assert stats.wait_hist.count >= 100_000
+        assert len(stats.wait_hist.counts) == len(WAIT_BUCKETS_MS) + 1
+        assert stats.snapshot()["verdict_p50_ms"] >= 1
+
+
+class TestTrace:
+    def test_trace_ids_unique_and_16_hex(self):
+        ids = {new_trace_id() for _ in range(1000)}
+        assert len(ids) == 1000
+        for tid in list(ids)[:10]:
+            assert len(tid) == 16
+            int(tid, 16)
+
+    def test_access_log_sampler_every_nth(self, caplog):
+        sampler = AccessLogSampler("test-listener", sample_every=3)
+        with caplog.at_level(logging.INFO, logger="pingoo_tpu.access"):
+            logged = [sampler.maybe_log(
+                trace_id=new_trace_id(), method="GET", path="/x",
+                status=200, client_ip="127.0.0.1", duration_ms=1.2)
+                for _ in range(9)]
+        assert sum(logged) == 3
+        rec = [r for r in caplog.records if r.name == "pingoo_tpu.access"]
+        assert len(rec) == 3
+        assert rec[0].fields["sampled_1_in"] == 3
+        assert rec[0].fields["trace_id"]
+
+    def test_sampler_disabled(self):
+        sampler = AccessLogSampler("t", sample_every=0)
+        assert not sampler.maybe_log(
+            trace_id="x", method="GET", path="/", status=200,
+            client_ip="1.2.3.4", duration_ms=0.1)
+
+    def test_json_formatter_survives_non_json_fields(self):
+        from pingoo_tpu.logging_utils import JsonFormatter
+
+        record = logging.LogRecord("t", logging.INFO, "f.py", 1,
+                                   "msg", (), None)
+        record.fields = {"path": object()}  # not JSON-serializable
+        line = JsonFormatter().format(record)
+        assert json.loads(line)["message"] == "msg"

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Metrics-schema parity audit (make audit; ISSUE 2 satellite).
+
+Fast, no-accelerator checks that the three telemetry surfaces agree on
+the documented inventory (pingoo_tpu/obs/schema.py):
+
+  1. The native plane's C++ exposition (native/httpd.cc) emits every
+     shared/native/ring metric name and keeps the legacy JSON keys —
+     checked against the SOURCE (the exposition is string literals, so
+     a renamed or dropped metric is visible without booting the plane).
+  2. The Python listener (host/httpd.py) and sidecar (native_ring.py)
+     reference the same names through obs/schema.py.
+  3. A synthetic registry populated with the full inventory passes the
+     Prometheus exposition lint (obs/registry.lint_prometheus_text).
+  4. docs/OBSERVABILITY.md documents every inventory name.
+
+Exit 0 clean, 1 with a problem list on stderr. The live-boot version of
+this check is `make metrics-smoke` (tools/metrics_smoke.py).
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from pingoo_tpu.obs import schema  # noqa: E402
+from pingoo_tpu.obs.registry import (  # noqa: E402
+    MetricRegistry,
+    WAIT_BUCKETS_MS,
+    lint_prometheus_text,
+)
+
+
+def _read(rel):
+    with open(os.path.join(REPO, rel)) as f:
+        return f.read()
+
+
+def main() -> int:
+    problems = []
+
+    native_src = _read("pingoo_tpu/native/httpd.cc")
+    native_names = (set(schema.SHARED_METRICS) | set(schema.RING_METRICS)
+                    | set(schema.NATIVE_METRICS)
+                    | {schema.SHARED_WAIT_HISTOGRAM})
+    for name in sorted(native_names):
+        if f'"{name}' not in native_src and name not in native_src:
+            problems.append(f"native/httpd.cc: missing metric {name}")
+    for key in schema.NATIVE_JSON_KEYS:
+        if f'"{key}"' not in native_src:
+            problems.append(
+                f"native/httpd.cc: missing legacy JSON key {key!r}")
+
+    py_listener = _read("pingoo_tpu/host/httpd.py")
+    for name in schema.SHARED_METRICS:
+        if name not in py_listener:
+            problems.append(f"host/httpd.py: missing metric {name}")
+    for key in schema.PYTHON_JSON_KEYS:
+        if f'"{key}"' not in py_listener:
+            problems.append(
+                f"host/httpd.py: missing legacy JSON key {key!r}")
+
+    sidecar_src = _read("pingoo_tpu/native_ring.py")
+    for name in schema.RING_METRICS:
+        if name not in sidecar_src:
+            problems.append(f"native_ring.py: missing metric {name}")
+
+    service_src = _read("pingoo_tpu/engine/service.py")
+    if schema.SHARED_WAIT_HISTOGRAM not in service_src:
+        problems.append("engine/service.py: missing shared wait histogram")
+    for stage in schema.VERDICT_STAGES:
+        if f'"{stage}"' not in service_src:
+            problems.append(
+                f"engine/service.py: stage {stage!r} not instrumented")
+
+    docs = _read("docs/OBSERVABILITY.md") if os.path.exists(
+        os.path.join(REPO, "docs/OBSERVABILITY.md")) else ""
+    if not docs:
+        problems.append("docs/OBSERVABILITY.md missing")
+    else:
+        for name in sorted(schema.all_metric_names()):
+            if name not in docs:
+                problems.append(f"docs/OBSERVABILITY.md: undocumented {name}")
+
+    # Synthetic full-inventory registry must pass the exposition lint.
+    reg = MetricRegistry()
+    for name, help_text in {**schema.SHARED_METRICS,
+                            **schema.RING_METRICS}.items():
+        if name.endswith("_total"):
+            reg.counter(name, help_text, labels={"plane": "audit"}).inc()
+        else:
+            reg.gauge(name, help_text, labels={"plane": "audit"}).set(1)
+    h = reg.histogram(schema.SHARED_WAIT_HISTOGRAM, "wait",
+                      buckets=WAIT_BUCKETS_MS, labels={"plane": "audit"})
+    for v in (0.5, 3, 70, 2000):
+        h.observe(v)
+    problems += [f"lint: {p}" for p in
+                 lint_prometheus_text(reg.prometheus_text())]
+
+    if problems:
+        print("metrics schema audit FAILED:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        return 1
+    print(f"metrics schema audit OK "
+          f"({len(schema.all_metric_names())} inventory names)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
