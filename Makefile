@@ -2,7 +2,8 @@
 
 PY ?= python
 
-.PHONY: all native test check bench audit asan metrics-smoke clean
+.PHONY: all native test check bench audit asan metrics-smoke clean \
+	analyze analyze-abi analyze-lint analyze-tidy analyze-tsan
 
 all: native
 
@@ -15,6 +16,31 @@ test: native
 check:
 	$(PY) -m compileall -q pingoo_tpu
 	$(PY) -c "import pingoo_tpu.config, pingoo_tpu.compiler, pingoo_tpu.engine"
+	$(MAKE) analyze
+
+# Static analysis suite (docs/STATIC_ANALYSIS.md) — offline-safe; each
+# pass skips with a warning when its toolchain is missing, and each is
+# individually invocable. `analyze` also re-runs the metrics-schema
+# audit so one target gates every machine-checked invariant:
+#   analyze-abi   C++ header vs numpy dtypes vs committed golden layout
+#   analyze-lint  JAX hot-path AST linter (host syncs, recompile
+#                 hazards, hot-function allocation)
+#   analyze-tidy  clang-tidy bugprone/concurrency vs tracked baseline
+#   analyze-tsan  extended ring_stress under -fsanitize=thread
+analyze: analyze-abi analyze-lint analyze-tidy analyze-tsan
+	$(PY) tools/check_metrics_schema.py
+
+analyze-abi:
+	$(PY) -m tools.analyze abi
+
+analyze-lint:
+	$(PY) -m tools.analyze lint
+
+analyze-tidy:
+	$(PY) -m tools.analyze tidy
+
+analyze-tsan:
+	$(PY) -m tools.analyze tsan
 
 bench: native
 	$(PY) bench.py

@@ -1,8 +1,28 @@
 // Concurrency stress for the verdict ring, built with -fsanitize=thread
-// (`make tsan`): N producer threads hammer enqueue while one consumer
-// drains and posts verdicts and M waiters poll them. The reference gets
-// its data-race guarantees from the Rust type system (SURVEY.md §5
-// "race detection"); the C++ plane gets them from this TSAN job.
+// (`make tsan`, run by `make analyze-tsan`): the reference gets its
+// data-race guarantees from the Rust type system (SURVEY.md §5 "race
+// detection"); the C++ plane gets them from this TSAN job.
+//
+// Three phases, all self-checking (abort on any violated invariant):
+//
+//   1. MPMC soak: N producers hammer enqueue while M consumers drain
+//      batches, post verdicts, and feed enq_ms back through
+//      pingoo_ring_record_waits; M waiters poll verdicts concurrently
+//      and a scraper thread reads pingoo_ring_telemetry_snapshot the
+//      whole time (the v4 atomic telemetry block added by PR 2 must be
+//      race-free under concurrent scrape). The small capacity forces
+//      thousands of wrap-arounds of both rings.
+//   2. Full-ring: two producers fill the drained request ring to
+//      capacity with no consumer — exactly `cap` must fit, the
+//      enqueue_full stall counter must move, depth and the high-water
+//      mark must read exactly `cap`, and a full drain must zero depth.
+//   3. Verdict-ring full: fill the verdict ring, verify the
+//      verdict_post_full stall counter moves, drain it back.
+//
+// After the soak the telemetry identities are checked exactly:
+// enqueued == dequeued == verdicts_posted == produced, the wait
+// histogram buckets sum to one entry per request, and depth returns
+// to zero.
 
 #include <atomic>
 #include <cstdio>
@@ -13,16 +33,63 @@
 
 #include "pingoo_ring.h"
 
+namespace {
+
+#define CHECK(cond, ...)                                     \
+  do {                                                       \
+    if (!(cond)) {                                           \
+      std::fprintf(stderr, "ring_stress CHECK failed: %s — ", #cond); \
+      std::fprintf(stderr, __VA_ARGS__);                     \
+      std::fprintf(stderr, "\n");                            \
+      std::abort();                                          \
+    }                                                        \
+  } while (0)
+
+struct Telemetry {
+  uint64_t v[PINGOO_TELEMETRY_WORDS];
+  uint64_t enqueued() const { return v[0]; }
+  uint64_t enqueue_full() const { return v[1]; }
+  uint64_t dequeued() const { return v[2]; }
+  uint64_t depth() const { return v[3]; }
+  uint64_t depth_hwm() const { return v[4]; }
+  uint64_t verdicts_posted() const { return v[5]; }
+  uint64_t verdict_post_full() const { return v[6]; }
+  uint64_t wait_sum_ms() const { return v[7]; }
+  uint64_t wait_hist_total() const {
+    uint64_t t = 0;
+    for (uint32_t b = 0; b < PINGOO_WAIT_BUCKETS; ++b) t += v[8 + b];
+    return t;
+  }
+};
+
+Telemetry snap(void* ring) {
+  Telemetry t;
+  pingoo_ring_telemetry_snapshot(ring, t.v);
+  return t;
+}
+
+long env_long(const char* name, long fallback) {
+  const char* s = std::getenv(name);
+  return s && *s ? std::atol(s) : fallback;
+}
+
+}  // namespace
+
 int main() {
   const uint32_t cap = 256;
   const int kProducers = 4;
-  const long kPerProducer = 20000;
+  const int kConsumers = 2;
+  const int kWaiters = 2;
+  const long kPerProducer = env_long("PINGOO_STRESS_PER_PRODUCER", 20000);
+  const long kTotal = kProducers * kPerProducer;
   std::vector<char> mem(pingoo_ring_bytes(cap));
   pingoo_ring_init(mem.data(), cap);
   void* ring = mem.data();
 
   std::atomic<long> produced{0}, consumed{0}, verdicts{0};
-  std::atomic<bool> done{false};
+  std::atomic<bool> stop_scraper{false};
+
+  // -- phase 1: MPMC soak -------------------------------------------------
 
   std::vector<std::thread> producers;
   for (int p = 0; p < kProducers; ++p) {
@@ -39,42 +106,169 @@ int main() {
     });
   }
 
-  std::thread consumer([&] {
-    std::vector<PingooRequestSlot> batch(cap);
-    while (consumed.load() < kProducers * kPerProducer) {
-      uint32_t n = pingoo_ring_dequeue_requests(ring, batch.data(), cap);
-      for (uint32_t i = 0; i < n; ++i) {
-        if (batch[i].path_len != 2 || std::memcmp(batch[i].path, "/p", 2)) {
-          std::fprintf(stderr, "corrupt slot!\n");
-          std::abort();
+  std::vector<std::thread> consumers;
+  for (int c = 0; c < kConsumers; ++c) {
+    consumers.emplace_back([&] {
+      std::vector<PingooRequestSlot> batch(cap);
+      std::vector<uint64_t> enq_ms(cap);
+      while (consumed.load() < kTotal) {
+        uint32_t n = pingoo_ring_dequeue_requests(ring, batch.data(), cap);
+        for (uint32_t i = 0; i < n; ++i) {
+          if (batch[i].path_len != 2 ||
+              std::memcmp(batch[i].path, "/p", 2) != 0) {
+            std::fprintf(stderr, "corrupt slot!\n");
+            std::abort();
+          }
+          enq_ms[i] = batch[i].enq_ms;
+          while (pingoo_ring_post_verdict(ring, batch[i].ticket,
+                                          batch[i].ticket % 3, 0.5f) != 0)
+            std::this_thread::yield();
         }
-        while (pingoo_ring_post_verdict(ring, batch[i].ticket,
-                                        batch[i].ticket % 3, 0.5f) != 0)
+        if (n) {
+          // Feed enqueue->verdict-post waits into the shared wait
+          // histogram exactly once per dequeued slot, like the sidecar.
+          pingoo_ring_record_waits(ring, enq_ms.data(), n);
+          consumed.fetch_add(n);
+        } else {
           std::this_thread::yield();
+        }
       }
-      consumed.fetch_add(n);
-      if (n == 0) std::this_thread::yield();
-    }
-    done.store(true);
-  });
+    });
+  }
 
-  std::thread waiter([&] {
-    uint64_t t; uint8_t a; float s;
-    while (!done.load() || verdicts.load() < kProducers * kPerProducer) {
-      if (pingoo_ring_poll_verdict(ring, &t, &a, &s) == 0) {
-        if (a != t % 3) { std::fprintf(stderr, "verdict mismatch\n");
-                          std::abort(); }
-        verdicts.fetch_add(1);
-      } else {
-        std::this_thread::yield();
+  std::vector<std::thread> waiters;
+  for (int w = 0; w < kWaiters; ++w) {
+    waiters.emplace_back([&] {
+      uint64_t t; uint8_t a; float s;
+      while (verdicts.load() < kTotal) {
+        if (pingoo_ring_poll_verdict(ring, &t, &a, &s) == 0) {
+          if (a != t % 3) {
+            std::fprintf(stderr, "verdict mismatch\n");
+            std::abort();
+          }
+          verdicts.fetch_add(1);
+        } else {
+          std::this_thread::yield();
+        }
       }
+    });
+  }
+
+  // Concurrent scraper: the telemetry block must be readable while
+  // every counter is being hammered (TSAN proves the loads race-free;
+  // the asserts prove the snapshot is never wildly inconsistent).
+  std::thread scraper([&] {
+    uint64_t last_enqueued = 0;
+    while (!stop_scraper.load()) {
+      Telemetry t = snap(ring);
+      CHECK(t.depth() <= cap, "live depth %llu > cap",
+            (unsigned long long)t.depth());
+      CHECK(t.depth_hwm() <= cap, "live hwm %llu > cap",
+            (unsigned long long)t.depth_hwm());
+      CHECK(t.enqueued() >= last_enqueued,
+            "enqueued went backwards: %llu < %llu",
+            (unsigned long long)t.enqueued(),
+            (unsigned long long)last_enqueued);
+      last_enqueued = t.enqueued();
+      std::this_thread::yield();
     }
   });
 
   for (auto& th : producers) th.join();
-  consumer.join();
-  waiter.join();
-  std::printf("{\"produced\": %ld, \"consumed\": %ld, \"verdicts\": %ld}\n",
-              produced.load(), consumed.load(), verdicts.load());
+  for (auto& th : consumers) th.join();
+  for (auto& th : waiters) th.join();
+  stop_scraper.store(true);
+  scraper.join();
+
+  CHECK(produced.load() == kTotal, "produced %ld", produced.load());
+  CHECK(consumed.load() == kTotal, "consumed %ld", consumed.load());
+  CHECK(verdicts.load() == kTotal, "verdicts %ld", verdicts.load());
+
+  Telemetry t1 = snap(ring);
+  CHECK(t1.enqueued() == (uint64_t)kTotal, "enqueued %llu != %ld",
+        (unsigned long long)t1.enqueued(), kTotal);
+  CHECK(t1.dequeued() == (uint64_t)kTotal, "dequeued %llu",
+        (unsigned long long)t1.dequeued());
+  CHECK(t1.verdicts_posted() == (uint64_t)kTotal, "posted %llu",
+        (unsigned long long)t1.verdicts_posted());
+  CHECK(t1.depth() == 0, "depth %llu after drain",
+        (unsigned long long)t1.depth());
+  CHECK(t1.depth_hwm() >= 1 && t1.depth_hwm() <= cap, "hwm %llu",
+        (unsigned long long)t1.depth_hwm());
+  CHECK(t1.wait_hist_total() == (uint64_t)kTotal,
+        "wait hist holds %llu entries, want %ld",
+        (unsigned long long)t1.wait_hist_total(), kTotal);
+
+  // -- phase 2: request ring full / wrap-around ---------------------------
+
+  {
+    std::atomic<long> fit{0};
+    std::vector<std::thread> fillers;
+    for (int p = 0; p < 2; ++p) {
+      fillers.emplace_back([&, p] {
+        uint8_t ip[16] = {0};
+        char country[2] = {'D', 'E'};
+        for (;;) {
+          uint64_t t = pingoo_ring_enqueue_request(
+              ring, "GET", 3, "h", 1, "/f", 2, "/f", 2, "UA", 2, ip,
+              static_cast<uint16_t>(p), 2, country);
+          if (t == UINT64_MAX) break;  // ring full: this thread is done
+          fit.fetch_add(1);
+        }
+      });
+    }
+    for (auto& th : fillers) th.join();
+    Telemetry t2 = snap(ring);
+    CHECK(fit.load() == (long)cap, "full ring accepted %ld != cap %u",
+          fit.load(), cap);
+    CHECK(t2.depth() == cap, "full depth %llu",
+          (unsigned long long)t2.depth());
+    CHECK(t2.depth_hwm() == cap, "hwm %llu after deliberate fill",
+          (unsigned long long)t2.depth_hwm());
+    CHECK(t2.enqueue_full() >= t1.enqueue_full() + 2,
+          "enqueue_full did not move: %llu -> %llu",
+          (unsigned long long)t1.enqueue_full(),
+          (unsigned long long)t2.enqueue_full());
+
+    std::vector<PingooRequestSlot> batch(cap);
+    uint32_t drained = 0;
+    while (drained < cap)
+      drained += pingoo_ring_dequeue_requests(ring, batch.data(), cap);
+    Telemetry t3 = snap(ring);
+    CHECK(drained == cap, "drained %u", drained);
+    CHECK(t3.depth() == 0, "depth %llu after full drain",
+          (unsigned long long)t3.depth());
+  }
+
+  // -- phase 3: verdict ring full -----------------------------------------
+
+  {
+    Telemetry before = snap(ring);
+    for (uint32_t i = 0; i < cap; ++i)
+      CHECK(pingoo_ring_post_verdict(ring, i, 1, 0.0f) == 0,
+            "verdict ring refused slot %u of cap", i);
+    CHECK(pingoo_ring_post_verdict(ring, cap, 1, 0.0f) == -1,
+          "post into a full verdict ring must fail");
+    Telemetry after = snap(ring);
+    CHECK(after.verdict_post_full() >= before.verdict_post_full() + 1,
+          "verdict_post_full did not move");
+    uint64_t t; uint8_t a; float s;
+    for (uint32_t i = 0; i < cap; ++i)
+      CHECK(pingoo_ring_poll_verdict(ring, &t, &a, &s) == 0,
+            "poll %u of cap failed", i);
+    CHECK(pingoo_ring_poll_verdict(ring, &t, &a, &s) == -1,
+          "drained verdict ring must read empty");
+  }
+
+  Telemetry tf = snap(ring);
+  std::printf(
+      "{\"produced\": %ld, \"consumed\": %ld, \"verdicts\": %ld, "
+      "\"depth_hwm\": %llu, \"enqueue_full\": %llu, "
+      "\"verdict_post_full\": %llu, \"wait_hist_total\": %llu}\n",
+      produced.load(), consumed.load(), verdicts.load(),
+      (unsigned long long)tf.depth_hwm(),
+      (unsigned long long)tf.enqueue_full(),
+      (unsigned long long)tf.verdict_post_full(),
+      (unsigned long long)tf.wait_hist_total());
   return 0;
 }

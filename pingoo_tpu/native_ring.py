@@ -29,31 +29,99 @@ LIB_PATH = os.path.join(NATIVE_DIR, "libpingoo_ring.so")
 FIELD_CAPS = {"method": 16, "host": 256, "path": 2048, "url": 2048,
               "user_agent": 256}
 
+RING_MAGIC = 0x50474F52  # PINGOO_RING_MAGIC ("PGOR")
 SLOT_FLAG_TRUNCATED = 0x1  # PINGOO_SLOT_FLAG_TRUNCATED
+SPILL_SLOTS = 64  # PINGOO_SPILL_SLOTS
+SPILL_DATA_CAP = 65536  # PINGOO_SPILL_DATA_CAP
 SPILL_NONE = 0xFF  # PINGOO_SPILL_NONE
 
-# numpy mirror of PingooRequestSlot (natural alignment, no padding holes
-# beyond the explicit _pad).
-REQUEST_SLOT_DTYPE = np.dtype([
-    ("seq", "<u8"),
-    ("ticket", "<u8"),
-    ("enq_ms", "<u8"),  # CLOCK_MONOTONIC ms at enqueue (ring v4)
-    ("method_len", "<u2"), ("host_len", "<u2"), ("path_len", "<u2"),
-    ("url_len", "<u2"), ("ua_len", "<u2"),
-    ("remote_port", "<u2"),
-    ("ip", "u1", 16),
-    ("asn", "<u4"),
-    ("country", "S2"),
-    ("flags", "u1"),
-    ("spill_idx", "u1"),  # PINGOO_SPILL_NONE (0xFF) or the spill slot
-    ("method", "u1", 16),
-    ("host", "u1", 256),
-    ("path", "u1", 2048),
-    ("url", "u1", 2048),
-    ("user_agent", "u1", 256),
-    ("_tail_pad", "S4"),  # C struct pads to 8-byte alignment (4688 bytes)
-])
-assert REQUEST_SLOT_DTYPE.itemsize == 4688, REQUEST_SLOT_DTYPE.itemsize
+# -- ABI mirror of pingoo_ring.h -----------------------------------------
+# These constants and structured dtypes are the Python half of the
+# cross-plane ABI contract. They are NOT free-hand: `make analyze-abi`
+# (tools/analyze/abi.py) diffs every size/offset below against a C++
+# emitter compiled from pingoo_ring.h and against the committed golden
+# table (tools/analyze/abi_golden.json). Change the header, the dtypes,
+# and the golden together or the check fails.
+
+RING_FORMAT_VERSION = 4  # PINGOO_RING_VERSION
+REQUEST_SLOT_SIZE = 4688  # sizeof(PingooRequestSlot)
+VERDICT_SLOT_SIZE = 24  # sizeof(PingooVerdictSlot)
+RING_HEADER_SIZE = 448  # sizeof(PingooRingHeader)
+TELEMETRY_BLOCK_SIZE = 128  # sizeof(PingooRingTelemetry)
+SPILL_SLOT_SIZE = 65552  # sizeof(PingooSpillSlot)
+WAIT_BUCKETS = 8  # PINGOO_WAIT_BUCKETS
+
+# numpy mirror of PingooRequestSlot. The explicit itemsize carries the
+# C struct's 8-byte tail padding (4684 -> 4688) so a whole dequeued
+# batch decodes with one structured view.
+REQUEST_SLOT_DTYPE = np.dtype({
+    "names": [
+        "seq", "ticket", "enq_ms",
+        "method_len", "host_len", "path_len", "url_len", "ua_len",
+        "remote_port", "ip", "asn", "country", "flags", "spill_idx",
+        "method", "host", "path", "url", "user_agent",
+    ],
+    "formats": [
+        "<u8", "<u8", "<u8",
+        "<u2", "<u2", "<u2", "<u2", "<u2",
+        "<u2", ("u1", 16), "<u4", "S2", "u1", "u1",
+        ("u1", 16), ("u1", 256), ("u1", 2048), ("u1", 2048), ("u1", 256),
+    ],
+    "offsets": [
+        0, 8, 16,
+        24, 26, 28, 30, 32,
+        34, 36, 52, 56, 58, 59,
+        60, 76, 332, 2380, 4428,
+    ],
+    "itemsize": REQUEST_SLOT_SIZE,
+})
+
+# numpy mirror of PingooVerdictSlot.
+VERDICT_SLOT_DTYPE = np.dtype({
+    "names": ["seq", "ticket", "action", "_pad", "bot_score"],
+    "formats": ["<u8", "<u8", "u1", ("u1", 3), "<f4"],
+    "offsets": [0, 8, 16, 17, 20],
+    "itemsize": VERDICT_SLOT_SIZE,
+})
+
+# numpy mirror of PingooRingTelemetry (the v4 atomic header block;
+# alignas(64) pads the struct to 128 bytes).
+TELEMETRY_DTYPE = np.dtype({
+    "names": ["enqueued", "enqueue_full", "dequeued", "depth_hwm",
+              "verdicts_posted", "verdict_post_full", "wait_sum_ms",
+              "wait_hist"],
+    "formats": ["<u8", "<u8", "<u8", "<u8", "<u8", "<u8", "<u8",
+                ("<u8", WAIT_BUCKETS)],
+    "offsets": [0, 8, 16, 24, 32, 40, 48, 56],
+    "itemsize": TELEMETRY_BLOCK_SIZE,
+})
+
+# numpy mirror of PingooRingHeader (cache-line-aligned counters).
+RING_HEADER_DTYPE = np.dtype({
+    "names": ["magic", "version", "capacity", "request_slot_size",
+              "verdict_slot_size", "_pad", "req_head", "req_tail",
+              "ver_head", "ver_tail", "telemetry"],
+    "formats": ["<u4", "<u4", "<u4", "<u4", "<u4", "<u4", "<u8", "<u8",
+                "<u8", "<u8", TELEMETRY_DTYPE],
+    "offsets": [0, 4, 8, 12, 16, 20, 64, 128, 192, 256, 320],
+    "itemsize": RING_HEADER_SIZE,
+})
+
+# numpy mirror of PingooSpillSlot (overflow url/path strings).
+SPILL_SLOT_DTYPE = np.dtype({
+    "names": ["state", "url_len", "path_len", "data"],
+    "formats": ["<u8", "<u4", "<u4", ("u1", 65536)],
+    "offsets": [0, 8, 12, 16],
+    "itemsize": SPILL_SLOT_SIZE,
+})
+
+for _dt, _size in ((REQUEST_SLOT_DTYPE, REQUEST_SLOT_SIZE),
+                   (VERDICT_SLOT_DTYPE, VERDICT_SLOT_SIZE),
+                   (TELEMETRY_DTYPE, TELEMETRY_BLOCK_SIZE),
+                   (RING_HEADER_DTYPE, RING_HEADER_SIZE),
+                   (SPILL_SLOT_DTYPE, SPILL_SLOT_SIZE)):
+    assert _dt.itemsize == _size, (_dt, _dt.itemsize, _size)
+del _dt, _size
 
 # Flat order of pingoo_ring_telemetry_snapshot (pingoo_ring.h
 # PINGOO_TELEMETRY_WORDS); the 8 wait_hist buckets follow.

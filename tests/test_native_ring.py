@@ -23,6 +23,14 @@ pytestmark = pytest.mark.skipif(
 LOADGEN = os.path.join(native_ring.NATIVE_DIR, "loadgen")
 
 
+def _shm_record(ring, dtype, offset=0):
+    """One record decoded from the ring mapping through a mirrored
+    dtype, COPIED out — a live np.frombuffer view would pin the mmap's
+    exported-buffer count and make Ring.close() raise BufferError."""
+    return np.frombuffer(ring.map, dtype=dtype, count=1,
+                         offset=offset)[0].copy()
+
+
 class TestRingBasics:
     def test_python_roundtrip(self, tmp_path):
         ring = Ring(str(tmp_path / "ring"), capacity=64, create=True)
@@ -100,6 +108,102 @@ class TestRingBasics:
             for _ in range(3):  # wraps
                 assert ring.enqueue() is not None
             assert len(ring.dequeue_batch()) == 3
+        finally:
+            ring.close()
+
+    def test_abi_roundtrip_header_and_slot_views(self, tmp_path):
+        """ISSUE 3 round-trip: C emitter JSON <-> numpy dtypes <->
+        pack/unpack of one live slot. The header and slot bytes the C
+        side wrote are decoded through the mirrored dtypes ALONE (raw
+        buffer views, no FFI) and must read back exactly."""
+        from tools.analyze import abi
+
+        golden = abi.load_golden()
+        ring = Ring(str(tmp_path / "ring"), capacity=8, create=True)
+        try:
+            hdr = _shm_record(ring, native_ring.RING_HEADER_DTYPE)
+            assert int(hdr["magic"]) == native_ring.RING_MAGIC
+            assert int(hdr["version"]) == native_ring.RING_FORMAT_VERSION \
+                == golden["format_version"]
+            assert int(hdr["capacity"]) == 8
+            assert int(hdr["request_slot_size"]) == \
+                native_ring.REQUEST_SLOT_SIZE
+            assert int(hdr["verdict_slot_size"]) == \
+                native_ring.VERDICT_SLOT_SIZE
+
+            t = ring.enqueue(method=b"PATCH", host=b"h.example",
+                             path=b"/pp", url=b"/pp?q=1",
+                             user_agent=b"UA/1", ip=bytes(range(16)),
+                             port=4321, asn=64501, country=b"NL")
+            raw = _shm_record(ring, native_ring.REQUEST_SLOT_DTYPE,
+                              offset=native_ring.RING_HEADER_SIZE)
+            assert int(raw["ticket"]) == t
+            assert bytes(raw["method"][:raw["method_len"]]) == b"PATCH"
+            assert bytes(raw["host"][:raw["host_len"]]) == b"h.example"
+            assert bytes(raw["path"][:raw["path_len"]]) == b"/pp"
+            assert bytes(raw["url"][:raw["url_len"]]) == b"/pp?q=1"
+            assert bytes(raw["user_agent"][:raw["ua_len"]]) == b"UA/1"
+            assert bytes(raw["ip"]) == bytes(range(16))
+            assert int(raw["remote_port"]) == 4321
+            assert int(raw["asn"]) == 64501
+            assert bytes(raw["country"]) == b"NL"
+            assert int(raw["spill_idx"]) == native_ring.SPILL_NONE
+            assert int(raw["enq_ms"]) > 0
+
+            # The dequeued copy equals the raw in-ring bytes field for
+            # field (same dtype both sides of the FFI hop).
+            slot = ring.dequeue_batch()[0]
+            for name in native_ring.REQUEST_SLOT_DTYPE.names:
+                assert np.array_equal(slot[name], raw[name]), name
+
+            assert ring.post_verdict(t, 5, 0.25)
+            voff = (native_ring.RING_HEADER_SIZE
+                    + 8 * native_ring.REQUEST_SLOT_SIZE)
+            ver = _shm_record(ring, native_ring.VERDICT_SLOT_DTYPE,
+                              offset=voff)
+            assert int(ver["ticket"]) == t
+            assert int(ver["action"]) == 5
+            assert float(ver["bot_score"]) == 0.25
+            assert int(ver["seq"]) == 1  # published: seq == pos + 1
+        finally:
+            ring.close()
+
+    def test_telemetry_block_matches_header_view(self, tmp_path):
+        """The ctypes snapshot (Ring.telemetry) and a raw numpy view of
+        the v4 header telemetry block must agree, and the counters must
+        move through full-ring stalls, drains, and record_waits."""
+        ring = Ring(str(tmp_path / "ring"), capacity=8, create=True)
+        try:
+            for _ in range(8):
+                assert ring.enqueue() is not None
+            assert ring.enqueue() is None  # full-ring stall
+            t = ring.telemetry()
+            assert t["enqueued"] == 8
+            assert t["enqueue_full"] >= 1
+            assert t["depth"] == 8
+            assert t["depth_hwm"] == 8
+            slots = ring.dequeue_batch()
+            assert len(slots) == 8
+            ring.record_waits(slots["enq_ms"])
+            for s in slots:
+                assert ring.post_verdict(int(s["ticket"]), 1, 0.0)
+            assert not ring.post_verdict(99, 1, 0.0)  # verdict ring full
+            t = ring.telemetry()
+            assert t["dequeued"] == 8
+            assert t["depth"] == 0
+            assert t["verdicts_posted"] == 8
+            assert t["verdict_post_full"] >= 1
+            assert sum(t["wait_hist"]) == 8
+
+            hdr = _shm_record(ring, native_ring.RING_HEADER_DTYPE)
+            tel = hdr["telemetry"]
+            assert int(tel["enqueued"]) == t["enqueued"]
+            assert int(tel["enqueue_full"]) == t["enqueue_full"]
+            assert int(tel["dequeued"]) == t["dequeued"]
+            assert int(tel["depth_hwm"]) == t["depth_hwm"]
+            assert int(tel["verdicts_posted"]) == t["verdicts_posted"]
+            assert int(tel["verdict_post_full"]) == t["verdict_post_full"]
+            assert [int(x) for x in tel["wait_hist"]] == t["wait_hist"]
         finally:
             ring.close()
 

@@ -1,0 +1,25 @@
+"""pingoo-analyze: project-native static analysis (make analyze).
+
+Four offline-safe passes over the two-plane serving stack
+(docs/STATIC_ANALYSIS.md has the full inventory):
+
+  abi   cross-plane ABI/layout checker: C++ emitter compiled from
+        native/pingoo_ring.h vs the numpy dtypes in native_ring.py vs
+        the committed golden table (tools/analyze/abi_golden.json).
+  lint  JAX hot-path AST linter over engine/, ops/, compiler/:
+        host-sync calls, jit-recompilation hazards, per-request
+        allocation in registered hot functions.
+  tidy  clang-tidy (bugprone/concurrency) over native/*.cc against a
+        tracked baseline; skip-with-warning when clang-tidy is absent.
+  tsan  the extended ring_stress concurrency gate built with
+        -fsanitize=thread; skip-with-warning when the toolchain can't
+        build TSAN binaries.
+
+Every pass is individually invocable (`python -m tools.analyze <pass>`,
+`make analyze-abi` etc.) and exits 0 clean / 1 with findings.
+"""
+
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))

@@ -1,0 +1,52 @@
+"""CLI for the pingoo-analyze suite.
+
+    python -m tools.analyze all            # every pass (make analyze)
+    python -m tools.analyze abi [--regen]  # cross-plane ABI checker
+    python -m tools.analyze lint [files…]  # JAX hot-path linter
+    python -m tools.analyze tidy           # clang-tidy vs baseline
+    python -m tools.analyze tsan           # ring_stress concurrency gate
+
+Passes are offline-safe; missing toolchains (C++ compiler, clang-tidy,
+TSAN runtime) downgrade the affected pass to skip-with-warning.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m tools.analyze")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    p_abi = sub.add_parser("abi", help="cross-plane ABI/layout checker")
+    p_abi.add_argument("--regen", action="store_true",
+                       help="regenerate abi_golden.json from the header")
+    p_lint = sub.add_parser("lint", help="JAX hot-path linter")
+    p_lint.add_argument("files", nargs="*",
+                        help="files to lint (default: configured dirs)")
+    sub.add_parser("tidy", help="clang-tidy (bugprone/concurrency)")
+    sub.add_parser("tsan", help="ring_stress thread-sanitizer gate")
+    sub.add_parser("all", help="run every pass")
+    args = parser.parse_args(argv)
+
+    from . import abi, lint, native
+
+    if args.cmd == "abi":
+        return abi.run(regen=args.regen)
+    if args.cmd == "lint":
+        return lint.run(paths=args.files or None)
+    if args.cmd == "tidy":
+        return native.run_tidy()
+    if args.cmd == "tsan":
+        return native.run_tsan()
+    rc = 0
+    rc |= abi.run()
+    rc |= lint.run()
+    rc |= native.run_tidy()
+    rc |= native.run_tsan()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,201 @@
+"""Cross-plane ABI/layout checker (make analyze-abi).
+
+The shm verdict ring's slot layout exists twice: as C structs in
+pingoo_tpu/native/pingoo_ring.h and as numpy structured dtypes in
+pingoo_tpu/native_ring.py. Until this checker the two were "mirrored by
+construction" — a field added on one side silently corrupted every slot
+decode. Now three tables are diffed pairwise:
+
+  C        abi_emit.cc compiled against the real header: the COMPILER'S
+           sizeof/offsetof/alignof answer (absent without a toolchain).
+  python   derived from the native_ring.py dtypes and constants.
+  golden   tools/analyze/abi_golden.json, committed — so the check
+           still bites in containers with no C++ compiler.
+
+Any mismatch (missing field, moved offset, resized struct, drifted
+constant or format version) is a failure. After an INTENTIONAL layout
+change (which must bump PINGOO_RING_VERSION) regenerate the golden:
+
+    python -m tools.analyze abi --regen
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+from . import REPO_ROOT
+
+EMITTER_SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "abi_emit.cc")
+GOLDEN_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "abi_golden.json")
+NATIVE_DIR = os.path.join(REPO_ROOT, "pingoo_tpu", "native")
+
+# numpy dtype name -> C struct name
+STRUCT_OF_DTYPE = {
+    "REQUEST_SLOT_DTYPE": "PingooRequestSlot",
+    "VERDICT_SLOT_DTYPE": "PingooVerdictSlot",
+    "TELEMETRY_DTYPE": "PingooRingTelemetry",
+    "RING_HEADER_DTYPE": "PingooRingHeader",
+    "SPILL_SLOT_DTYPE": "PingooSpillSlot",
+}
+
+
+def python_table() -> dict:
+    """The Python plane's view of the ABI, shaped like the emitter JSON
+    (structs carry no "align": numpy dtypes don't model C alignment)."""
+    from pingoo_tpu import native_ring as nr
+
+    structs = {}
+    for dtype_name, struct_name in STRUCT_OF_DTYPE.items():
+        dt = getattr(nr, dtype_name)
+        fields = [
+            {"name": name,
+             "offset": int(dt.fields[name][1]),
+             "size": int(dt.fields[name][0].itemsize)}
+            for name in dt.names
+        ]
+        structs[struct_name] = {"size": int(dt.itemsize), "fields": fields}
+    return {
+        "format_version": nr.RING_FORMAT_VERSION,
+        "constants": {
+            "PINGOO_RING_MAGIC": nr.RING_MAGIC,
+            "PINGOO_RING_VERSION": nr.RING_FORMAT_VERSION,
+            "PINGOO_METHOD_CAP": nr.FIELD_CAPS["method"],
+            "PINGOO_HOST_CAP": nr.FIELD_CAPS["host"],
+            "PINGOO_PATH_CAP": nr.FIELD_CAPS["path"],
+            "PINGOO_URL_CAP": nr.FIELD_CAPS["url"],
+            "PINGOO_UA_CAP": nr.FIELD_CAPS["user_agent"],
+            "PINGOO_SLOT_FLAG_TRUNCATED": nr.SLOT_FLAG_TRUNCATED,
+            "PINGOO_SPILL_SLOTS": nr.SPILL_SLOTS,
+            "PINGOO_SPILL_DATA_CAP": nr.SPILL_DATA_CAP,
+            "PINGOO_SPILL_NONE": nr.SPILL_NONE,
+            "PINGOO_WAIT_BUCKETS": nr.WAIT_BUCKETS,
+            "PINGOO_TELEMETRY_WORDS": nr.TELEMETRY_WORDS,
+        },
+        "structs": structs,
+    }
+
+
+def compiler() -> str | None:
+    for cxx in (os.environ.get("CXX") or "", "g++", "clang++", "c++"):
+        if cxx and shutil.which(cxx):
+            return cxx
+    return None
+
+
+def emitter_table(header_dir: str = NATIVE_DIR,
+                  emitter_src: str = EMITTER_SRC) -> dict | None:
+    """Compile and run the C emitter; None when no toolchain exists.
+    `header_dir` is overridable so the negative tests can point the
+    same emitter at a MUTATED copy of pingoo_ring.h."""
+    cxx = compiler()
+    if cxx is None:
+        return None
+    with tempfile.TemporaryDirectory(prefix="pingoo-abi-") as tmp:
+        binary = os.path.join(tmp, "abi_emit")
+        subprocess.run(
+            [cxx, "-O0", "-std=c++17", "-I", header_dir, "-o", binary,
+             emitter_src],
+            check=True, capture_output=True)
+        out = subprocess.run([binary], check=True, capture_output=True)
+    return json.loads(out.stdout)
+
+
+def load_golden(path: str = GOLDEN_PATH) -> dict:
+    with open(path) as f:
+        return json.load(f)
+
+
+def diff_tables(a: dict, b: dict, a_name: str, b_name: str) -> list[str]:
+    """Symmetric diff of two ABI tables -> list of human mismatches
+    (empty == identical layout). "align" is compared only when both
+    sides define it (the python table doesn't)."""
+    out: list[str] = []
+    if a.get("format_version") != b.get("format_version"):
+        out.append(f"format_version: {a_name}={a.get('format_version')} "
+                   f"{b_name}={b.get('format_version')}")
+    ca, cb = a.get("constants", {}), b.get("constants", {})
+    for k in sorted(set(ca) | set(cb)):
+        if ca.get(k) != cb.get(k):
+            out.append(f"constant {k}: {a_name}={ca.get(k)} "
+                       f"{b_name}={cb.get(k)}")
+    sa, sb = a.get("structs", {}), b.get("structs", {})
+    for name in sorted(set(sa) | set(sb)):
+        if name not in sa or name not in sb:
+            missing = a_name if name not in sa else b_name
+            out.append(f"struct {name}: missing from {missing}")
+            continue
+        ta, tb = sa[name], sb[name]
+        if ta["size"] != tb["size"]:
+            out.append(f"struct {name}: sizeof {a_name}={ta['size']} "
+                       f"{b_name}={tb['size']}")
+        if "align" in ta and "align" in tb and ta["align"] != tb["align"]:
+            out.append(f"struct {name}: alignof {a_name}={ta['align']} "
+                       f"{b_name}={tb['align']}")
+        fa = {f["name"]: f for f in ta["fields"]}
+        fb = {f["name"]: f for f in tb["fields"]}
+        for fname in sorted(set(fa) | set(fb)):
+            if fname not in fa or fname not in fb:
+                missing = a_name if fname not in fa else b_name
+                out.append(f"struct {name}.{fname}: missing from {missing}")
+                continue
+            for attr in ("offset", "size"):
+                if fa[fname][attr] != fb[fname][attr]:
+                    out.append(
+                        f"struct {name}.{fname}: {attr} "
+                        f"{a_name}={fa[fname][attr]} "
+                        f"{b_name}={fb[fname][attr]}")
+    return out
+
+
+def run(regen: bool = False) -> int:
+    """The analyze-abi pass. Exit 0 clean, 1 on any layout drift."""
+    py = python_table()
+    try:
+        c = emitter_table()
+    except subprocess.CalledProcessError as exc:
+        print("analyze-abi: FAIL — emitter did not compile against "
+              "pingoo_ring.h (header syntax drift?):\n"
+              f"{exc.stderr.decode(errors='replace')[-2000:]}",
+              file=sys.stderr)
+        return 1
+
+    if regen:
+        if c is None:
+            print("analyze-abi: cannot --regen without a C++ compiler",
+                  file=sys.stderr)
+            return 1
+        with open(GOLDEN_PATH, "w") as f:
+            json.dump(c, f, indent=4, sort_keys=False)
+            f.write("\n")
+        print(f"analyze-abi: regenerated {os.path.relpath(GOLDEN_PATH, REPO_ROOT)}")
+
+    golden = load_golden()
+    problems = diff_tables(py, golden, "python", "golden")
+    if c is None:
+        print("analyze-abi: WARNING — no C++ compiler; checked python "
+              "dtypes against the committed golden only", file=sys.stderr)
+    else:
+        problems += diff_tables(c, golden, "C", "golden")
+        problems += diff_tables(c, py, "C", "python")
+    if problems:
+        print("analyze-abi: FAIL — cross-plane ABI drift:", file=sys.stderr)
+        for p in problems:
+            print(f"  - {p}", file=sys.stderr)
+        print("  (intentional layout change? bump PINGOO_RING_VERSION, "
+              "mirror the dtypes, then `python -m tools.analyze abi "
+              "--regen`)", file=sys.stderr)
+        return 1
+    n_structs = len(golden["structs"])
+    n_fields = sum(len(s["fields"]) for s in golden["structs"].values())
+    sides = "python==golden" if c is None else "C==python==golden"
+    print(f"analyze-abi: OK ({sides}; ring format v"
+          f"{golden['format_version']}, {n_structs} structs, "
+          f"{n_fields} fields)")
+    return 0

@@ -1,0 +1,147 @@
+// ABI/layout emitter for the shared-memory verdict ring (make
+// analyze-abi). Compiled against pingoo_tpu/native/pingoo_ring.h, it
+// prints the COMPILER'S answer — sizeof/offsetof/alignof for every
+// struct the Python plane mirrors, plus the wire constants — as JSON on
+// stdout. tools/analyze/abi.py diffs this against the numpy structured
+// dtypes in pingoo_tpu/native_ring.py and the committed golden table
+// (tools/analyze/abi_golden.json), so a field added on one side without
+// the other (and the golden) is a hard failure, not a latent slot-
+// corruption bug. Regenerate the golden with:
+//   python -m tools.analyze abi --regen
+
+#include <cstddef>
+#include <cstdio>
+
+#include "pingoo_ring.h"
+
+namespace {
+
+bool first_item = true;
+
+void sep() {
+  if (!first_item) std::printf(",\n");
+  first_item = false;
+}
+
+#define FIELD(S, f)                                                      \
+  do {                                                                   \
+    sep();                                                               \
+    std::printf("      {\"name\": \"%s\", \"offset\": %zu, \"size\": %zu}", \
+                #f, offsetof(S, f), sizeof(S{}.f));                      \
+  } while (0)
+
+#define STRUCT_OPEN(S)                                                  \
+  do {                                                                  \
+    sep();                                                              \
+    std::printf("    \"%s\": {\"size\": %zu, \"align\": %zu,\n"         \
+                "     \"fields\": [\n",                                 \
+                #S, sizeof(S), alignof(S));                             \
+    first_item = true;                                                  \
+  } while (0)
+
+#define STRUCT_CLOSE()           \
+  do {                           \
+    std::printf("\n    ]}");     \
+    first_item = false;          \
+  } while (0)
+
+#define CONSTANT(name)                                      \
+  do {                                                      \
+    sep();                                                  \
+    std::printf("    \"%s\": %llu", #name,                  \
+                static_cast<unsigned long long>(name));     \
+  } while (0)
+
+}  // namespace
+
+int main() {
+  std::printf("{\n");
+  std::printf("  \"format_version\": %u,\n",
+              static_cast<unsigned>(PINGOO_RING_VERSION));
+
+  std::printf("  \"constants\": {\n");
+  first_item = true;
+  CONSTANT(PINGOO_RING_MAGIC);
+  CONSTANT(PINGOO_RING_VERSION);
+  CONSTANT(PINGOO_METHOD_CAP);
+  CONSTANT(PINGOO_HOST_CAP);
+  CONSTANT(PINGOO_PATH_CAP);
+  CONSTANT(PINGOO_URL_CAP);
+  CONSTANT(PINGOO_UA_CAP);
+  CONSTANT(PINGOO_SLOT_FLAG_TRUNCATED);
+  CONSTANT(PINGOO_SPILL_SLOTS);
+  CONSTANT(PINGOO_SPILL_DATA_CAP);
+  CONSTANT(PINGOO_SPILL_NONE);
+  CONSTANT(PINGOO_WAIT_BUCKETS);
+  CONSTANT(PINGOO_TELEMETRY_WORDS);
+  std::printf("\n  },\n");
+  first_item = false;
+
+  std::printf("  \"structs\": {\n");
+  first_item = true;
+
+  STRUCT_OPEN(PingooRequestSlot);
+  FIELD(PingooRequestSlot, seq);
+  FIELD(PingooRequestSlot, ticket);
+  FIELD(PingooRequestSlot, enq_ms);
+  FIELD(PingooRequestSlot, method_len);
+  FIELD(PingooRequestSlot, host_len);
+  FIELD(PingooRequestSlot, path_len);
+  FIELD(PingooRequestSlot, url_len);
+  FIELD(PingooRequestSlot, ua_len);
+  FIELD(PingooRequestSlot, remote_port);
+  FIELD(PingooRequestSlot, ip);
+  FIELD(PingooRequestSlot, asn);
+  FIELD(PingooRequestSlot, country);
+  FIELD(PingooRequestSlot, flags);
+  FIELD(PingooRequestSlot, spill_idx);
+  FIELD(PingooRequestSlot, method);
+  FIELD(PingooRequestSlot, host);
+  FIELD(PingooRequestSlot, path);
+  FIELD(PingooRequestSlot, url);
+  FIELD(PingooRequestSlot, user_agent);
+  STRUCT_CLOSE();
+
+  STRUCT_OPEN(PingooVerdictSlot);
+  FIELD(PingooVerdictSlot, seq);
+  FIELD(PingooVerdictSlot, ticket);
+  FIELD(PingooVerdictSlot, action);
+  FIELD(PingooVerdictSlot, _pad);
+  FIELD(PingooVerdictSlot, bot_score);
+  STRUCT_CLOSE();
+
+  STRUCT_OPEN(PingooRingTelemetry);
+  FIELD(PingooRingTelemetry, enqueued);
+  FIELD(PingooRingTelemetry, enqueue_full);
+  FIELD(PingooRingTelemetry, dequeued);
+  FIELD(PingooRingTelemetry, depth_hwm);
+  FIELD(PingooRingTelemetry, verdicts_posted);
+  FIELD(PingooRingTelemetry, verdict_post_full);
+  FIELD(PingooRingTelemetry, wait_sum_ms);
+  FIELD(PingooRingTelemetry, wait_hist);
+  STRUCT_CLOSE();
+
+  STRUCT_OPEN(PingooRingHeader);
+  FIELD(PingooRingHeader, magic);
+  FIELD(PingooRingHeader, version);
+  FIELD(PingooRingHeader, capacity);
+  FIELD(PingooRingHeader, request_slot_size);
+  FIELD(PingooRingHeader, verdict_slot_size);
+  FIELD(PingooRingHeader, _pad);
+  FIELD(PingooRingHeader, req_head);
+  FIELD(PingooRingHeader, req_tail);
+  FIELD(PingooRingHeader, ver_head);
+  FIELD(PingooRingHeader, ver_tail);
+  FIELD(PingooRingHeader, telemetry);
+  STRUCT_CLOSE();
+
+  STRUCT_OPEN(PingooSpillSlot);
+  FIELD(PingooSpillSlot, state);
+  FIELD(PingooSpillSlot, url_len);
+  FIELD(PingooSpillSlot, path_len);
+  FIELD(PingooSpillSlot, data);
+  STRUCT_CLOSE();
+
+  std::printf("\n  }\n}\n");
+  return 0;
+}

@@ -1,0 +1,433 @@
+"""JAX hot-path linter (make analyze-lint).
+
+An AST pass over the serving-path Python (lint_config.LINT_DIRS) that
+makes the latency invariants PR 1 bought machine-checked: the verdict
+path must stay free of hidden host-device synchronization points,
+jit-recompilation hazards, and per-batch allocation churn. PAPERS.md
+(ModSec-Learn) argues WAF correctness must be checked mechanically, not
+by convention; this extends that to the performance contract.
+
+Rule inventory (docs/STATIC_ANALYSIS.md):
+
+  sync-item            .item() forces a blocking device->host transfer
+  sync-tolist          .tolist() forces a blocking transfer + pyobj churn
+  sync-device-get      jax.device_get() is an explicit blocking transfer
+  sync-block           block_until_ready outside the explicit allowlist
+                       (lint_config.BLOCK_UNTIL_READY_ALLOW)
+  sync-asarray-hot     np.asarray/np.array/np.ascontiguousarray inside a
+                       registered hot function (device input -> implicit
+                       sync; host input -> a copy per batch)
+  sync-scalar-cast     float()/int()/bool() over the result of a jitted
+                       dispatch callable (blocks per call)
+  hot-alloc            fresh numpy allocation inside a hot function
+  recompile-jit-in-loop    jax.jit(...) constructed inside a loop (fresh
+                           cache entry per iteration)
+  recompile-const-upload   jnp.asarray/jnp.array of a host constant
+                           captured from OUTSIDE the traced region
+                           (re-staged on every retrace; hoist it)
+  suppression-missing-reason   # pingoo: allow(...) without a reason
+
+Suppression syntax — the rule name AND a reason are mandatory:
+
+    x = np.asarray(dev)  # pingoo: allow(sync-asarray-hot): the one
+                         # deliberate sync point for this plane
+
+A standalone `# pingoo: allow(rule): reason` comment line suppresses
+the line below it. Multiple rules: allow(rule-a, rule-b): reason.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+
+from . import REPO_ROOT
+from . import lint_config as cfg
+
+RULES = {
+    "sync-item": "blocking .item() device->host sync",
+    "sync-tolist": "blocking .tolist() device->host sync",
+    "sync-device-get": "blocking jax.device_get()",
+    "sync-block": "block_until_ready outside the allowlist",
+    "sync-asarray-hot": "numpy materialization inside a hot function",
+    "sync-scalar-cast": "python scalar cast of a jitted-dispatch result",
+    "hot-alloc": "numpy allocation inside a hot function",
+    "recompile-jit-in-loop": "jax.jit constructed inside a loop",
+    "recompile-const-upload":
+        "jnp constant captured from outside the traced region",
+    "suppression-missing-reason": "allow() without a reason",
+}
+
+_NP_NAMES = frozenset({"np", "numpy"})
+_JNP_NAMES = frozenset({"jnp"})
+
+_ALLOW_RE = re.compile(
+    r"#\s*pingoo:\s*allow\(([^)]*)\)(?:\s*:\s*(\S.*))?")
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str  # repo-relative
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class _Suppression:
+    line: int  # line the comment sits on
+    rules: tuple[str, ...]
+    has_reason: bool
+    used: bool = False
+
+    def covers(self, line: int) -> bool:
+        # Same line, or a standalone comment suppressing the line below.
+        return line in (self.line, self.line + 1)
+
+
+def _parse_suppressions(source: str) -> list[_Suppression]:
+    out = []
+    for i, text in enumerate(source.splitlines(), start=1):
+        m = _ALLOW_RE.search(text)
+        if not m:
+            continue
+        rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+        out.append(_Suppression(line=i, rules=rules,
+                                has_reason=bool(m.group(2))))
+    return out
+
+
+def _attr_chain_root(node: ast.AST):
+    """Root Name(s) feeding an expression — Attribute/Subscript chains,
+    containers and comprehensions unwrap; Call results and literals are
+    locally produced and yield nothing."""
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, (ast.Attribute, ast.Subscript, ast.Starred)):
+        yield from _attr_chain_root(node.value)
+    elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+        for elt in node.elts:
+            yield from _attr_chain_root(elt)
+    elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+        for gen in node.generators:
+            yield from _attr_chain_root(gen.iter)
+    elif isinstance(node, ast.BinOp):
+        yield from _attr_chain_root(node.left)
+        yield from _attr_chain_root(node.right)
+    elif isinstance(node, ast.UnaryOp):
+        yield from _attr_chain_root(node.operand)
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Attribute) and node.attr == "jit") or (
+        isinstance(node, ast.Name) and node.id == "jit")
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    if _is_jit_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        if _is_jit_expr(dec.func):
+            return True  # @jax.jit(...)
+        is_partial = (isinstance(dec.func, ast.Name)
+                      and dec.func.id == "partial") or (
+            isinstance(dec.func, ast.Attribute)
+            and dec.func.attr == "partial")
+        if is_partial and dec.args and _is_jit_expr(dec.args[0]):
+            return True  # @partial(jax.jit, ...)
+    return False
+
+
+def _bound_names(fn: ast.AST) -> set[str]:
+    """Every name bound anywhere inside `fn`: params, assignments, loop
+    targets, comprehension targets, withitems, nested def/class names."""
+    bound: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(node.name)
+            a = node.args
+            for arg in (list(a.posonlyargs) + list(a.args)
+                        + list(a.kwonlyargs)):
+                bound.add(arg.arg)
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+        elif isinstance(node, ast.ClassDef):
+            bound.add(node.name)
+        elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            bound.add(node.id)
+    return bound
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[Finding] = []
+        self._scope: list[str] = []  # ClassDef/FunctionDef names
+        self._hot_depth = 0
+        self._traced_depth = 0
+        self._loop_depth = 0
+        self._trace_locals: set[str] | None = None
+        self._device_names: list[set[str]] = []  # per function frame
+
+    # -- helpers -------------------------------------------------------------
+
+    def _qualname(self) -> str:
+        return f"{self.path}::{'.'.join(self._scope)}"
+
+    def _flag(self, node: ast.AST, rule: str, message: str) -> None:
+        self.findings.append(Finding(self.path, node.lineno, rule, message))
+
+    def _in_registry(self, registry) -> bool:
+        return self._qualname() in registry
+
+    # -- scope tracking ------------------------------------------------------
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._scope.append(node.name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def _visit_function(self, node) -> None:
+        self._scope.append(node.name)
+        qual = self._qualname()
+        hot = qual in cfg.HOT_FUNCTIONS
+        traced = (qual in cfg.TRACED_FUNCTIONS
+                  or any(_is_jit_decorator(d) for d in node.decorator_list))
+        self._hot_depth += hot
+        entered_trace = traced and self._traced_depth == 0
+        self._traced_depth += traced
+        if entered_trace:
+            self._trace_locals = _bound_names(node)
+        loop_depth, self._loop_depth = self._loop_depth, 0
+        self._device_names.append(set())
+        self.generic_visit(node)
+        self._device_names.pop()
+        self._loop_depth = loop_depth
+        if entered_trace:
+            self._trace_locals = None
+        self._traced_depth -= traced
+        self._hot_depth -= hot
+        self._scope.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+
+    def _visit_loop(self, node) -> None:
+        self._loop_depth += 1
+        self.generic_visit(node)
+        self._loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # Dataflow-lite for sync-scalar-cast: names assigned from a
+        # jitted dispatch call hold unmaterialized device values.
+        if self._device_names and isinstance(node.value, ast.Call):
+            f = node.value.func
+            callee = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else None)
+            if callee in cfg.JITTED_DISPATCH_NAMES:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._device_names[-1].add(tgt.id)
+        self.generic_visit(node)
+
+    # -- the rules -----------------------------------------------------------
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if node.attr == "block_until_ready":
+            self._check_block(node)
+        self.generic_visit(node)
+
+    def _check_block(self, node: ast.AST) -> None:
+        scopes = {f"{self.path}::{'.'.join(self._scope[:i + 1])}"
+                  for i in range(len(self._scope))}
+        if not scopes & cfg.BLOCK_UNTIL_READY_ALLOW:
+            self._flag(node, "sync-block",
+                       "block_until_ready outside the allowlist "
+                       "(BLOCK_UNTIL_READY_ALLOW) serializes the pipeline")
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        # getattr(x, "block_until_ready", ...) counts as a block ref.
+        if (isinstance(f, ast.Name) and f.id == "getattr" and node.args
+                and len(node.args) >= 2
+                and isinstance(node.args[1], ast.Constant)
+                and node.args[1].value == "block_until_ready"):
+            self._check_block(node)
+        if isinstance(f, ast.Attribute):
+            self._call_on_attribute(node, f)
+        elif isinstance(f, ast.Name):
+            self._call_on_name(node, f)
+        self.generic_visit(node)
+
+    def _call_on_attribute(self, node: ast.Call, f: ast.Attribute) -> None:
+        if f.attr == "item" and not node.args and not node.keywords:
+            self._flag(node, "sync-item",
+                       ".item() blocks on the device result; keep the "
+                       "value as an array or sync once per batch")
+        elif f.attr == "tolist" and not node.args:
+            self._flag(node, "sync-tolist",
+                       ".tolist() blocks and builds python objects per "
+                       "element; slice the array instead")
+        elif f.attr == "device_get":
+            self._flag(node, "sync-device-get",
+                       "jax.device_get() is a blocking transfer")
+        elif f.attr == "jit" and self._loop_depth:
+            self._flag(node, "recompile-jit-in-loop",
+                       "jax.jit(...) inside a loop creates a fresh "
+                       "compilation cache entry per iteration")
+        root = f.value.id if isinstance(f.value, ast.Name) else None
+        if root in _NP_NAMES and self._hot_depth:
+            if f.attr in cfg.NP_MATERIALIZERS:
+                self._flag(node, "sync-asarray-hot",
+                           f"np.{f.attr} in hot function "
+                           f"{'.'.join(self._scope)}: an implicit sync "
+                           "on device input, a copy per batch on host "
+                           "input")
+            elif f.attr in cfg.NP_ALLOCATORS:
+                self._flag(node, "hot-alloc",
+                           f"np.{f.attr} allocates per call in hot "
+                           f"function {'.'.join(self._scope)}; hoist or "
+                           "reuse a scratch buffer")
+        if (root in _JNP_NAMES and f.attr in ("asarray", "array")
+                and self._traced_depth and self._trace_locals is not None
+                and node.args):
+            captured = [r for r in _attr_chain_root(node.args[0])
+                        if r not in self._trace_locals
+                        and r not in ("jnp", "np", "jax")]
+            if captured:
+                self._flag(node, "recompile-const-upload",
+                           f"jnp.{f.attr}({', '.join(sorted(set(captured)))}"
+                           ") captures a host constant inside the traced "
+                           "region; hoist the device array out of the "
+                           "jitted function")
+
+    def _call_on_name(self, node: ast.Call, f: ast.Name) -> None:
+        if f.id == "device_get":
+            self._flag(node, "sync-device-get",
+                       "device_get() is a blocking transfer")
+        elif f.id == "jit" and self._loop_depth:
+            self._flag(node, "recompile-jit-in-loop",
+                       "jit(...) inside a loop creates a fresh "
+                       "compilation cache entry per iteration")
+        elif f.id in ("float", "int", "bool") and len(node.args) == 1:
+            arg = node.args[0]
+            is_dispatch_call = (
+                isinstance(arg, ast.Call)
+                and ((isinstance(arg.func, ast.Attribute)
+                      and arg.func.attr in cfg.JITTED_DISPATCH_NAMES)
+                     or (isinstance(arg.func, ast.Name)
+                         and arg.func.id in cfg.JITTED_DISPATCH_NAMES)))
+            is_device_name = (
+                isinstance(arg, ast.Name) and self._device_names
+                and arg.id in self._device_names[-1])
+            if is_dispatch_call or is_device_name:
+                self._flag(node, "sync-scalar-cast",
+                           f"{f.id}() over a jitted-dispatch result "
+                           "blocks per call; batch the sync instead")
+
+
+def lint_source(source: str, path: str) -> tuple[list[Finding],
+                                                 list[str]]:
+    """Lint one file's source -> (unsuppressed findings, warnings).
+
+    `path` is the repo-relative label used for registry lookups and
+    reporting; it need not exist on disk (tests lint mutated copies)."""
+    suppressions = _parse_suppressions(source)
+    findings: list[Finding] = []
+    for sup in suppressions:
+        unknown = [r for r in sup.rules if r not in RULES]
+        if unknown:
+            findings.append(Finding(
+                path, sup.line, "suppression-missing-reason",
+                f"allow() names unknown rule(s): {', '.join(unknown)}"))
+        if not sup.has_reason:
+            findings.append(Finding(
+                path, sup.line, "suppression-missing-reason",
+                "suppression must carry a reason: "
+                "# pingoo: allow(rule): why this is safe"))
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return [Finding(path, exc.lineno or 0, "sync-item",
+                        f"file does not parse: {exc.msg}")], []
+    linter = _FileLinter(path)
+    linter.visit(tree)
+
+    kept: list[Finding] = []
+    for finding in findings + linter.findings:
+        suppressed = False
+        if finding.rule != "suppression-missing-reason":
+            for sup in suppressions:
+                if (sup.has_reason and finding.rule in sup.rules
+                        and sup.covers(finding.line)):
+                    sup.used = True
+                    suppressed = True
+                    break
+        if not suppressed:
+            kept.append(finding)
+    warnings = [
+        f"{path}:{sup.line}: unused suppression allow"
+        f"({', '.join(sup.rules)})"
+        for sup in suppressions if sup.has_reason and not sup.used]
+    return kept, warnings
+
+
+def iter_lint_files(repo_root: str = REPO_ROOT):
+    for rel_dir in cfg.LINT_DIRS:
+        base = os.path.join(repo_root, rel_dir)
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in cfg.EXCLUDE_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths=None, repo_root: str = REPO_ROOT):
+    """Lint files (default: the configured dirs) ->
+    (findings, warnings)."""
+    findings: list[Finding] = []
+    warnings: list[str] = []
+    for full in (paths if paths is not None
+                 else iter_lint_files(repo_root)):
+        rel = os.path.relpath(full, repo_root)
+        try:
+            with open(full, encoding="utf-8") as fh:
+                source = fh.read()
+        except (UnicodeDecodeError, OSError):
+            continue  # binary/cache noise is not source
+        got, warn = lint_source(source, rel)
+        findings += got
+        warnings += warn
+    return findings, warnings
+
+
+def run(paths=None) -> int:
+    findings, warnings = lint_paths(paths)
+    for w in warnings:
+        print(f"analyze-lint: warning: {w}", file=sys.stderr)
+    if findings:
+        print(f"analyze-lint: FAIL — {len(findings)} finding(s):",
+              file=sys.stderr)
+        for f in findings:
+            print(f"  {f}", file=sys.stderr)
+        print("  (false positive? suppress inline with "
+              "`# pingoo: allow(<rule>): <reason>` — the reason is "
+              "mandatory; see docs/STATIC_ANALYSIS.md)", file=sys.stderr)
+        return 1
+    n = sum(1 for _ in iter_lint_files()) if paths is None else len(paths)
+    print(f"analyze-lint: OK ({n} files, {len(RULES)} rules, "
+          f"0 unsuppressed findings)")
+    return 0

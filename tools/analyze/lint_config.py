@@ -1,0 +1,71 @@
+"""Configuration for the JAX hot-path linter (tools/analyze/lint.py).
+
+Registries are repo-relative `path::qualname` strings; a method's
+qualname is `Class.method`, nested functions join with dots
+(`outer.inner`). docs/STATIC_ANALYSIS.md documents how to extend them.
+"""
+
+# Directories the linter walks (repo-relative). These hold the code
+# that runs per batch on the serving path; host/ and the offline
+# tooling are deliberately out of scope.
+LINT_DIRS = (
+    "pingoo_tpu/engine",
+    "pingoo_tpu/ops",
+    "pingoo_tpu/compiler",
+)
+
+# Never descend into these directory names, and never read non-.py
+# files: caches and build outputs are not source (ISSUE 3 satellite —
+# grep-based tools must not trip over __pycache__/ or binaries).
+EXCLUDE_DIRS = frozenset({
+    "__pycache__", ".git", ".pytest_cache", "build", "dist",
+    ".mypy_cache", ".ruff_cache", "node_modules",
+})
+
+# Functions REGISTERED AS HOT: they run per batch with the request
+# latency budget on the line, so host-device syncs (sync-asarray-hot)
+# and fresh numpy allocations (hot-alloc) inside them must be either
+# eliminated or individually justified with an inline suppression.
+HOT_FUNCTIONS = frozenset({
+    "pingoo_tpu/engine/service.py::VerdictService._evaluate_sync",
+    "pingoo_tpu/engine/service.py::VerdictService._evaluate_with_scores",
+    "pingoo_tpu/engine/service.py::VerdictService._run_batch",
+    "pingoo_tpu/engine/verdict.py::finish_batch",
+    "pingoo_tpu/engine/verdict.py::merge_lanes",
+})
+
+# Functions traced by jax.jit that the AST cannot see are jitted (they
+# are CALLED from a jit-decorated function rather than decorated
+# themselves). Their bodies execute at trace time: jnp.asarray of a
+# captured host constant there is re-staged on every retrace
+# (recompile-const-upload). Nested defs inherit traced-ness.
+TRACED_FUNCTIONS = frozenset({
+    "pingoo_tpu/engine/verdict.py::_matched_cols",
+    "pingoo_tpu/engine/verdict.py::_eval_leaves",
+    "pingoo_tpu/engine/verdict.py::_eval_bool",
+    "pingoo_tpu/engine/verdict.py::_eval_num",
+})
+
+# The explicit blessing list for block_until_ready: the ONE deliberate
+# device sync point per plane. Everything else must go through these.
+BLOCK_UNTIL_READY_ALLOW = frozenset({
+    "pingoo_tpu/engine/verdict.py::finish_batch",
+})
+
+# Attribute/function names that hold jitted dispatch callables: casting
+# their result to a Python scalar (float()/int()/bool()) forces a
+# blocking device round-trip per call (sync-scalar-cast).
+JITTED_DISPATCH_NAMES = frozenset({
+    "_verdict_fn", "_score_fn", "_lane_fn", "verdict_fn", "lane_fn",
+})
+
+# numpy allocators flagged inside hot functions (hot-alloc).
+NP_ALLOCATORS = frozenset({
+    "zeros", "ones", "empty", "full", "zeros_like", "ones_like",
+    "empty_like", "full_like", "concatenate", "stack", "vstack",
+    "hstack", "tile", "repeat",
+})
+
+# numpy materializers that force a device->host copy when handed a jax
+# array (sync-asarray-hot, flagged inside hot functions).
+NP_MATERIALIZERS = frozenset({"asarray", "array", "ascontiguousarray"})

@@ -1,0 +1,165 @@
+"""Native-plane analysis passes: TSAN concurrency gate + clang-tidy.
+
+Both passes degrade to skip-with-warning (exit 0) when the toolchain is
+missing so `make check` stays green in minimal containers — but they
+HARD-FAIL when the toolchain exists and finds something.
+
+tsan   builds pingoo_tpu/native/ring_stress.cc with -fsanitize=thread
+       (`make -C pingoo_tpu/native tsan`) and runs it with halt-on-
+       error. The stress hammers the Vyukov rings AND the v4 telemetry
+       atomics (depth HWM CAS-max, wrap-around, full-ring stalls,
+       concurrent snapshot scrapes) and self-checks counter identities.
+
+tidy   runs clang-tidy (bugprone-*, concurrency-*, .clang-tidy at the
+       repo root) over native/*.cc and diffs normalized findings
+       against the tracked suppression file
+       tools/analyze/tidy_baseline.txt: new findings fail; entries in
+       the baseline are accepted tech-debt with a recorded reason.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+
+from . import REPO_ROOT
+
+NATIVE_DIR = os.path.join(REPO_ROOT, "pingoo_tpu", "native")
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "tidy_baseline.txt")
+TIDY_SOURCES = ("pingoo_ring.cc", "ring_stress.cc", "drain.cc",
+                "loadgen.cc", "loadgen_http.cc", "pong.cc")
+
+TSAN_EXIT_CODE = 66  # distinct from the stress's own abort()s
+
+
+def _toolchain_supports_tsan() -> str | None:
+    """Compiler name if it can link -fsanitize=thread, else None."""
+    cxx = os.environ.get("CXX") or "g++"
+    if not shutil.which(cxx):
+        return None
+    with tempfile.TemporaryDirectory(prefix="pingoo-tsan-") as tmp:
+        src = os.path.join(tmp, "probe.cc")
+        with open(src, "w") as f:
+            f.write("int main() { return 0; }\n")
+        probe = subprocess.run(
+            [cxx, "-fsanitize=thread", "-pthread", "-o",
+             os.path.join(tmp, "probe"), src],
+            capture_output=True)
+    return cxx if probe.returncode == 0 else None
+
+
+def run_tsan() -> int:
+    if _toolchain_supports_tsan() is None:
+        print("analyze-tsan: SKIP — toolchain cannot build "
+              "-fsanitize=thread binaries (tier-1 stays green; run in "
+              "the dev container for the full gate)", file=sys.stderr)
+        return 0
+    build = subprocess.run(["make", "-C", NATIVE_DIR, "tsan"],
+                           capture_output=True)
+    if build.returncode != 0:
+        print("analyze-tsan: FAIL — tsan build broke:\n"
+              f"{build.stderr.decode(errors='replace')[-2000:]}",
+              file=sys.stderr)
+        return 1
+    env = dict(os.environ)
+    env["TSAN_OPTIONS"] = (env.get("TSAN_OPTIONS", "") +
+                           f" halt_on_error=1 exitcode={TSAN_EXIT_CODE}"
+                           ).strip()
+    proc = subprocess.run([os.path.join(NATIVE_DIR, "ring_stress_tsan")],
+                          capture_output=True, env=env, timeout=600)
+    sys.stdout.write(proc.stdout.decode(errors="replace"))
+    if proc.returncode != 0:
+        kind = ("TSAN report" if proc.returncode == TSAN_EXIT_CODE
+                else f"self-check failure (exit {proc.returncode})")
+        print(f"analyze-tsan: FAIL — {kind}:\n"
+              f"{proc.stderr.decode(errors='replace')[-4000:]}",
+              file=sys.stderr)
+        return 1
+    print("analyze-tsan: OK (ring_stress_tsan clean: MPMC rings, "
+          "telemetry atomics, wrap-around, full-ring stalls)")
+    return 0
+
+
+# -- clang-tidy ----------------------------------------------------------
+
+_TIDY_LINE = re.compile(
+    r"^(?P<path>[^:\s][^:]*):(?P<line>\d+):\d+:\s+"
+    r"(?:warning|error):\s+(?P<msg>.*?)\s+\[(?P<check>[^\]]+)\]\s*$")
+
+
+def normalize_tidy_output(text: str, repo_root: str = REPO_ROOT
+                          ) -> list[str]:
+    """clang-tidy stdout -> sorted unique `file:check: message` keys.
+    Line numbers are dropped so unrelated edits don't churn the
+    baseline; system-header noise has no repo-relative path and is
+    dropped too."""
+    keys = set()
+    for raw in text.splitlines():
+        m = _TIDY_LINE.match(raw.strip())
+        if not m:
+            continue
+        path = m.group("path")
+        if os.path.isabs(path):
+            try:
+                rel = os.path.relpath(path, repo_root)
+            except ValueError:
+                continue
+            if rel.startswith(".."):
+                continue  # outside the repo: system headers
+            path = rel
+        keys.add(f"{path}:{m.group('check')}: {m.group('msg')}")
+    return sorted(keys)
+
+
+def load_baseline(path: str = BASELINE_PATH) -> list[str]:
+    if not os.path.exists(path):
+        return []
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.append(line)
+    return out
+
+
+def diff_against_baseline(findings: list[str], baseline: list[str]
+                          ) -> tuple[list[str], list[str]]:
+    """-> (new findings not in the baseline, stale baseline entries)."""
+    fset, bset = set(findings), set(baseline)
+    return sorted(fset - bset), sorted(bset - fset)
+
+
+def run_tidy() -> int:
+    tidy = shutil.which("clang-tidy")
+    if tidy is None:
+        print("analyze-tidy: SKIP — clang-tidy not installed (tier-1 "
+              "stays green; run in a container with clang-tools for "
+              "the full gate)", file=sys.stderr)
+        return 0
+    sources = [os.path.join(NATIVE_DIR, s) for s in TIDY_SOURCES
+               if os.path.exists(os.path.join(NATIVE_DIR, s))]
+    proc = subprocess.run(
+        [tidy, "--quiet", *sources, "--", "-std=c++17", "-I", NATIVE_DIR],
+        capture_output=True, cwd=REPO_ROOT, timeout=900)
+    findings = normalize_tidy_output(proc.stdout.decode(errors="replace"))
+    fresh, stale = diff_against_baseline(findings, load_baseline())
+    for s in stale:
+        print(f"analyze-tidy: warning: stale baseline entry (fixed? "
+              f"remove it): {s}", file=sys.stderr)
+    if fresh:
+        print(f"analyze-tidy: FAIL — {len(fresh)} new finding(s) not in "
+              f"tools/analyze/tidy_baseline.txt:", file=sys.stderr)
+        for f in fresh:
+            print(f"  {f}", file=sys.stderr)
+        print("  (real but accepted? add the line to the baseline WITH "
+              "a trailing `# reason` comment above it)", file=sys.stderr)
+        return 1
+    print(f"analyze-tidy: OK ({len(sources)} sources, "
+          f"{len(findings)} finding(s), all baselined)")
+    return 0
