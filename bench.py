@@ -198,6 +198,118 @@ def autotune_scan_strategies(plan, tables, arrays, iters: int = 30) -> dict:
     return {k: v / base for k, v in raw.items()}
 
 
+def bench_prefilter_modes(plan, tables, arrays, verdict_body,
+                          iters: int = 30) -> dict:
+    """ISSUE 4: per-mode verdict throughput for the literal-prefilter
+    cascade (PINGOO_PREFILTER=off|banks|compact) with the same
+    chained-salted-loop method as the headline bench, plus the Stage-A
+    candidate statistics (rate, banks skipped) on the bench traffic.
+    Selects the fastest mode into plan.prefilter.default_mode (persisted
+    by the caller via the artifact cache) and writes the
+    BENCH_prefilter.json trajectory artifact."""
+    import jax
+    import jax.numpy as jnp
+
+    out: dict = {"modes": {}}
+    batch = int(arrays["asn"].shape[0])
+    prev = os.environ.get("PINGOO_PREFILTER")
+    try:
+        for mode in ("off", "banks", "compact"):
+            os.environ["PINGOO_PREFILTER"] = mode
+
+            # Fresh jit per mode: the mode is read at trace time.
+            @jax.jit
+            def run_n(tables, arrays, n):
+                def body(i, acc):
+                    m = verdict_body(tables, arrays, (acc + i) % 2)
+                    return acc + m.sum().astype(jnp.int64)
+                return jax.lax.fori_loop(0, n, body, jnp.int64(0))
+
+            @jax.jit
+            def floor_loop(arrays, n):
+                def body(i, acc):
+                    return acc + arrays["asn"].sum() + i
+                return jax.lax.fori_loop(0, n, body, jnp.int64(0))
+
+            try:
+                t0 = time.time()
+                checksum = int(run_n(tables, arrays, 2))
+                int(floor_loop(arrays, 2))
+                compile_s = time.time() - t0
+                t0 = time.time()
+                int(floor_loop(arrays, iters))
+                floor = time.time() - t0
+                t0 = time.time()
+                checksum = int(run_n(tables, arrays, iters))
+                full = time.time() - t0
+            except Exception as exc:
+                out["modes"][mode] = {"error": repr(exc)[:200]}
+                continue
+            per_batch_s = max((full - floor) / iters, 1e-9)
+            out["modes"][mode] = {
+                "req_per_s": round(batch / per_batch_s, 1),
+                "p_batch_ms": round(per_batch_s * 1000, 3),
+                "compile_s": round(compile_s, 1),
+                "checksum": checksum,
+            }
+    finally:
+        if prev is None:
+            os.environ.pop("PINGOO_PREFILTER", None)
+        else:
+            os.environ["PINGOO_PREFILTER"] = prev
+
+    # Stage-A candidate statistics on the (unsalted) bench traffic.
+    try:
+        from pingoo_tpu.engine.verdict import make_prefilter_fn
+
+        os.environ["PINGOO_PREFILTER"] = "banks"
+        try:
+            pf = make_prefilter_fn(plan)
+        finally:
+            if prev is None:
+                os.environ.pop("PINGOO_PREFILTER", None)
+            else:
+                os.environ["PINGOO_PREFILTER"] = prev
+        if pf is not None:
+            pf_fn, n_gated = pf
+            _, aux = pf_fn(tables, arrays)
+            aux = np.asarray(aux)
+            out["banks_gated"] = n_gated
+            out["banks_skipped_per_batch"] = int(aux[1])
+            out["candidate_rate"] = (
+                round(int(aux[0]) / (batch * n_gated), 4) if n_gated
+                else 0.0)
+        pfp = getattr(plan, "prefilter", None)
+        if pfp is not None:
+            out["factors"] = {f: ff.num_factors
+                              for f, ff in pfp.fields.items()}
+    except Exception as exc:
+        out["stats_error"] = repr(exc)[:200]
+
+    base = out["modes"].get("off", {}).get("req_per_s")
+    best_mode, best_rps = "off", base or 0
+    for mode, row in out["modes"].items():
+        rps = row.get("req_per_s")
+        if base:
+            row["speedup_vs_off"] = round(rps / base, 3) if rps else None
+        if rps and rps > best_rps:
+            best_mode, best_rps = mode, rps
+    out["selected"] = best_mode
+    if getattr(plan, "prefilter", None) is not None:
+        plan.prefilter.default_mode = best_mode
+
+    try:
+        with open("BENCH_prefilter.json", "w") as f:
+            json.dump({
+                "metric": "prefilter_cascade_modes",
+                "batch_size": batch,
+                **out,
+            }, f, indent=2)
+    except OSError:
+        pass
+    return out
+
+
 def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
     """Committed end-to-end drive: loadgen_http -> httpd -> ring ->
     sidecar (device lane verdict) -> 403 / proxy -> pong."""
@@ -751,6 +863,23 @@ def _main_impl(result: dict, done=None) -> None:
         "build_s": round(build_s, 1),
         "compile_s": round(compile_s, 1),
     })
+    # Literal-prefilter cascade (ISSUE 4): per-mode throughput + Stage-A
+    # candidate stats; the fastest mode becomes the plan's default and
+    # rides the artifact cache like the scan-strategy autotune below.
+    if os.environ.get("BENCH_SKIP_PREFILTER") != "1":
+        try:
+            pf_res = bench_prefilter_modes(
+                plan, tables, arrays, verdict_body,
+                iters=min(iters, int(os.environ.get(
+                    "BENCH_PREFILTER_ITERS", "30"))))
+            result["prefilter"] = pf_res
+            cache_dir = os.environ.get("PINGOO_CACHE_DIR")
+            if cache_dir and pf_res.get("selected"):
+                from pingoo_tpu.compiler.cache import update_cached_plan
+
+                update_cached_plan(rules, lists, plan, cache_dir)
+        except Exception as exc:
+            result["prefilter_error"] = repr(exc)[:200]
     # Micro-autotune: replace the plan's default cost-model strategy
     # selection with MEASURED per-iteration costs, and persist the tuned
     # plan into the artifact cache when one is configured — runs on a

@@ -225,6 +225,20 @@ class NumCmp:
 LeafPred = object  # union
 
 
+def nfa_leaf_patterns(leaf: "NfaPred") -> list["repat.LinearPattern"]:
+    """The linear-pattern alternatives one NFA leaf scans (match = any).
+
+    Single source of truth for the plan's bank assembly AND the
+    prefilter factor pass (compiler/plan.py): both must see the exact
+    same alternatives or the candidate sets could drift from the scanned
+    patterns. Raises repat.Unsupported only for regex leaves that never
+    passed lowering (callers hold already-lowered leaves)."""
+    if leaf.kind == "contains":
+        return [repat.literal_pattern(leaf.pattern.encode("latin-1"),
+                                      case_insensitive=leaf.ci)]
+    return repat.compile_regex(leaf.pattern)
+
+
 class LeafRegistry:
     """Deduplicating allocator of leaf predicate ids."""
 

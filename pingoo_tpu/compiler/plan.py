@@ -42,6 +42,7 @@ from .lowering import (
     NumCmp,
     StrListPred,
     StrPred,
+    nfa_leaf_patterns,
 )
 from .nfa import build_bank
 from ..ops.cidr import build_cidr_table, build_int_set, build_v4_buckets, ip_to_words
@@ -219,6 +220,122 @@ def _halo_partition(patterns, field_len: int):
     return short_idx, rest_idx, short_pats, rest_pats
 
 
+# -- literal-prefilter cascade (Stage A metadata) -----------------------------
+#
+# ISSUE 4: each contains/regex pattern gets a *necessary literal factor*
+# at compile time (compiler/repat.necessary_factor) — a byte-class run
+# that must appear in the field for the pattern to match. Factors are
+# deduplicated per field and packed into one shift-AND bank
+# (ops/prefilter.py) scanned ONCE per batch; engine/verdict.py consults
+# the per-bank candidate masks to skip or compact the exact NFA scans.
+# The prefilter may only PRUNE, never decide: final verdicts are
+# bit-identical across PINGOO_PREFILTER=off|banks|compact
+# (tests/test_prefilter.py asserts this structurally).
+
+PF_ALWAYS = -1  # slot has no extractable factor: its bank always scans
+PF_NEVER = -2  # slot never matches: contributes nothing to candidates
+
+PREFILTER_MODES = ("off", "banks", "compact")
+
+
+@dataclass
+class FieldFactors:
+    """One byte field's deduplicated factor inventory."""
+
+    field: str
+    table_key: str  # np_tables key of the PrefilterTables ("pf_<field>")
+    num_factors: int
+    # The factor byte-class tuples themselves (small; kept for the
+    # differential property tests and plan introspection).
+    factors: tuple[tuple[frozenset, ...], ...]
+
+
+@dataclass
+class PrefilterPlan:
+    """Static Stage-A metadata riding the RulesetPlan into the artifact
+    cache (FORMAT_VERSION bump in compiler/cache.py)."""
+
+    fields: dict[str, FieldFactors] = dc_field(default_factory=dict)
+    bank_field: dict[str, str] = dc_field(default_factory=dict)
+    # np_tables bank key -> bool [F] mask over its field's factors.
+    bank_masks: dict[str, Any] = dc_field(default_factory=dict)
+    # bank key -> True when EVERY slot is factor-gated (or never-match):
+    # only then may the whole bank be skipped/compacted.
+    bank_gated: dict[str, bool] = dc_field(default_factory=dict)
+    # bank key -> per-slot factor index (PF_ALWAYS / PF_NEVER sentinels).
+    slot_codes: dict[str, tuple] = dc_field(default_factory=dict)
+    # Strategy used when the PINGOO_PREFILTER env override is unset;
+    # bench.py's autotune records the measured best mode here and
+    # persists it through compiler.cache.update_cached_plan.
+    default_mode: str = "banks"
+
+
+def _plan_field_prefilter(plan: "RulesetPlan", field: str,
+                          bank_slots: dict[str, list],
+                          nfa_key: Optional[str] = None,
+                          split_idx=None) -> None:
+    """Extract + pack one field's factors; register per-bank masks.
+
+    `bank_slots` maps each of the field's scan banks (the NFA bank AND
+    the MXU window bank — both are gated by the cascade) to its per-slot
+    source LinearPatterns. The factor table is shared per FIELD (one
+    Stage-A scan feeds every bank); `split_idx` additionally registers
+    the NFA halo-partition @short/@rest sub-bank subsets. Fields with no
+    extractable factor get no table."""
+    from ..ops.prefilter import (build_prefilter_bank,
+                                 bank_to_prefilter_tables)
+
+    pf = plan.prefilter
+    if pf is None or not bank_slots:
+        return
+    factors: list = []
+    index: dict = {}
+
+    def code_of(lp) -> int:
+        if lp.never_match:
+            return PF_NEVER
+        fac = repat.necessary_factor(lp)
+        if fac is None:
+            return PF_ALWAYS
+        idx = index.get(fac)
+        if idx is None:
+            idx = len(factors)
+            index[fac] = idx
+            factors.append(fac)
+        return idx
+
+    bank_codes = {bkey: [code_of(lp) for lp in pats]
+                  for bkey, pats in bank_slots.items()}
+    if not factors:
+        return
+    bank = build_prefilter_bank(factors)
+    table_key = f"pf_{field}"
+    plan.np_tables[table_key] = bank_to_prefilter_tables(bank)
+    pf.fields[field] = FieldFactors(
+        field=field, table_key=table_key, num_factors=len(factors),
+        factors=tuple(factors))
+
+    def register(bank_key: str, codes) -> None:
+        codes = tuple(codes)
+        mask = np.zeros(len(factors), dtype=bool)
+        for c in codes:
+            if c >= 0:
+                mask[c] = True
+        pf.bank_field[bank_key] = field
+        pf.bank_masks[bank_key] = mask
+        pf.bank_gated[bank_key] = all(c != PF_ALWAYS for c in codes)
+        pf.slot_codes[bank_key] = codes
+
+    for bkey, codes in bank_codes.items():
+        register(bkey, codes)
+    if nfa_key is not None and split_idx is not None:
+        nfa_codes = bank_codes[nfa_key]
+        register(f"{nfa_key}@short",
+                 [nfa_codes[i] for i in split_idx[0]])
+        register(f"{nfa_key}@rest",
+                 [nfa_codes[i] for i in split_idx[1]])
+
+
 def reselect_scan_strategies(plan: "RulesetPlan",
                              costs: dict | None = None,
                              source: str = "measured") -> None:
@@ -274,6 +391,8 @@ class RulesetPlan:
     route_index: dict[str, int] = dc_field(default_factory=dict)
     # per-NFA-bank scan strategy decisions (static; cached with the plan)
     scan_plans: dict[str, NfaScanPlan] = dc_field(default_factory=dict)
+    # Stage-A literal-prefilter metadata (None for factor-less rulesets)
+    prefilter: Optional[PrefilterPlan] = None
 
     def device_tables(self) -> dict[str, Any]:
         """Materialize all tables as device arrays (a pytree)."""
@@ -348,12 +467,16 @@ def compile_ruleset(
         leaves=registry.leaves,
         bindings={},
         route_index=route_index,
+        prefilter=PrefilterPlan(),
     )
     _assemble_tables(plan)
+    if plan.prefilter is not None and not plan.prefilter.fields:
+        plan.prefilter = None  # nothing extractable: Stage A is a no-op
     # Stats count REAL rules only — route pseudo-columns get their own
     # counters so bench/metrics numbers don't inflate with services.
     real = planned[: len(rules)]
     pseudo = planned[len(rules):]
+    pf = plan.prefilter
     plan.stats = {
         "rules": len(real),
         "device_rules": sum(1 for r in real if not r.host),
@@ -361,6 +484,10 @@ def compile_ruleset(
         "routes": len(pseudo),
         "host_routes": sum(1 for r in pseudo if r.host),
         "leaves": len(registry.leaves),
+        "prefilter_factors": (sum(f.num_factors for f in pf.fields.values())
+                              if pf else 0),
+        "prefilter_gated_banks": (sum(1 for g in pf.bank_gated.values() if g)
+                                  if pf else 0),
     }
     return plan
 
@@ -422,21 +549,19 @@ def _assemble_tables(plan: RulesetPlan) -> None:
     for field, entries in nfa_groups.items():
         patterns = []
         win_patterns: list = []
+        win_srcs: list = []  # window slots' source LinearPatterns
         for leaf_id, leaf in entries:
-            if leaf.kind == "contains":
-                alts = [repat.literal_pattern(
-                    leaf.pattern.encode("latin-1"), case_insensitive=leaf.ci)]
-            else:
-                alts = repat.compile_regex(leaf.pattern)
+            alts = nfa_leaf_patterns(leaf)
             # Fixed-shape literal-ish leaves skip the serial NFA scan
             # entirely: every alternative must lower to a window pattern
             # (ops/window_match.py — one MXU conv pair per field instead
             # of one VPU step per byte).
-            wins = [repat.to_window(lp) for lp in alts
-                    if not lp.never_match]
+            live = [lp for lp in alts if not lp.never_match]
+            wins = [repat.to_window(lp) for lp in live]
             if wins and all(w is not None for w in wins):
                 start = len(win_patterns)
                 win_patterns.extend(wins)
+                win_srcs.extend(live)
                 plan.bindings[leaf_id] = LeafBinding(
                     kind="window", field=field,
                     span=(start, len(win_patterns)),
@@ -447,10 +572,25 @@ def _assemble_tables(plan: RulesetPlan) -> None:
             plan.bindings[leaf_id] = LeafBinding(
                 kind="nfa", field=field, span=(start, len(patterns)),
                 table_key=f"nfa_{field}")
+        split_idx = None
         if patterns:
-            _plan_nfa_bank(plan, field, patterns)
+            split_idx = _plan_nfa_bank(plan, field, patterns)
         if win_patterns:
             plan.np_tables[f"win_{field}"] = build_window_table(win_patterns)
+        # Stage-A factor pass covers BOTH of the field's scan banks (the
+        # serial NFA bank and the MXU window bank) from one shared
+        # factor table; factors come from the ORIGINAL patterns (any
+        # footprint-extended rewrites are match-equivalent over the
+        # field cap, so necessity transfers unchanged).
+        bank_slots: dict[str, list] = {}
+        if patterns:
+            bank_slots[f"nfa_{field}"] = patterns
+        if win_patterns:
+            bank_slots[f"win_{field}"] = win_srcs
+        _plan_field_prefilter(
+            plan, field, bank_slots,
+            nfa_key=f"nfa_{field}" if patterns else None,
+            split_idx=split_idx)
 
     if ip_preds:
         nets = np.zeros((len(ip_preds), 4), dtype=np.uint32)
@@ -467,8 +607,10 @@ def _assemble_tables(plan: RulesetPlan) -> None:
 
 
 def _plan_nfa_bank(plan: RulesetPlan, field: str,
-                   patterns: list) -> None:
-    """Build one field's NFA tables + scan plan.
+                   patterns: list):
+    """Build one field's NFA tables + scan plan; returns the halo
+    partition's (short_idx, rest_idx) slot subsets (None when the bank
+    is not partitioned) for the prefilter sub-bank registration.
 
     Footprint-extension / halo pipeline (docs/ROOFLINE.md lever 1):
 
@@ -519,10 +661,12 @@ def _plan_nfa_bank(plan: RulesetPlan, field: str,
     split = None
     short_strategy = rest_strategy = None
     slot_perm = None
+    split_idx = None
     if _split_enabled() and not tables.halo_ok:
         parts = _halo_partition(patterns, field_len)
         if parts is not None:
             short_idx, rest_idx, short_pats, rest_pats = parts
+            split_idx = (short_idx, rest_idx)
             short_tables = bank_to_tables(build_bank(short_pats))
             rest_tables = bank_to_tables(build_bank(rest_pats))
             plan.np_tables[f"{key}@short"] = short_tables
@@ -544,3 +688,4 @@ def _plan_nfa_bank(plan: RulesetPlan, field: str,
         slot_perm=slot_perm,
         extended=extended,
     )
+    return split_idx

@@ -879,6 +879,113 @@ def _merge_single_char_alts(alts: list[list[_Item]]) -> Pos | None:
     return Pos(bytes=frozenset(members))
 
 
+# -- necessary literal-factor extraction (prefilter cascade) ------------------
+#
+# The verdict cascade (docs/PREFILTER.md, ISSUE 4) gates the serial NFA
+# scan banks behind a cheap packed shift-AND pass over *necessary
+# factors*: for each pattern, a sequence of byte classes that must
+# appear CONSECUTIVELY in any input the pattern matches. If the factor
+# is absent from a request's field bytes, the pattern cannot match —
+# the prefilter may therefore PRUNE (skip/compact the exact scan) but
+# never decide, which is the whole soundness argument. Patterns with no
+# sufficiently selective factor are reported None and the caller marks
+# them always-scan (their bank keeps running unconditionally).
+#
+# Which windows of a linear pattern are necessary consecutive runs?
+# Position p consumes k_p bytes of class C_p with k_p == 1 for ONE,
+# k_p >= 1 for PLUS, k_p >= 0 for OPT/STAR. A window [i..j] therefore
+# yields a guaranteed consecutive occurrence of C_i..C_j exactly when
+# every INTERIOR position is ONE (one byte each) and the EDGES are ONE
+# or PLUS (take the last byte of the left PLUS run / the first byte of
+# the right PLUS run). OPT/STAR anywhere in the window breaks the
+# guarantee (the position may be absent). Anchors and \b constraints
+# only restrict matches further, so they never invalidate a factor.
+
+FACTOR_MAX_LEN = 12  # positions per factor (packed into uint32 lanes)
+FACTOR_MAX_CLASS = 16  # byte-class size cap per factor position
+# Selectivity floor: product of 256/|class| over the window must reach
+# the equivalent of two exact bytes, or the factor would fire on nearly
+# every request (a 1-byte factor like "/" gates nothing and still costs
+# table bits).
+FACTOR_MIN_SCORE = 256.0 ** 2
+
+
+def _factor_windows(positions: list[Pos]) -> list[list[Pos]]:
+    """Maximal candidate windows: runs of ONE/PLUS positions, cut so
+    PLUS appears only at window edges (see the rule above)."""
+    segs: list[list[Pos]] = []
+    cur: list[Pos] = []
+    for p in positions:
+        if p.quant in (Quant.ONE, Quant.PLUS):
+            cur.append(p)
+        elif cur:
+            segs.append(cur)
+            cur = []
+    if cur:
+        segs.append(cur)
+    windows: list[list[Pos]] = []
+    for seg in segs:
+        start = 0
+        for i, p in enumerate(seg):
+            if p.quant == Quant.PLUS and i > start:
+                windows.append(seg[start:i + 1])  # PLUS as right edge
+                start = i
+        windows.append(seg[start:])
+    return windows
+
+
+def _best_subwindow(win: list[Pos]):
+    """Most selective contiguous subwindow of length <= FACTOR_MAX_LEN:
+    (score, length, classes) or None when no position qualifies."""
+    best = None
+    n = len(win)
+    for i in range(n):
+        score = 1.0
+        for j in range(i, min(i + FACTOR_MAX_LEN, n)):
+            cls = win[j].bytes
+            if len(cls) > FACTOR_MAX_CLASS:
+                break
+            score *= 256.0 / len(cls)
+            cand = (score, j - i + 1,
+                    tuple(p.bytes for p in win[i:j + 1]))
+            if best is None or (cand[0], cand[1]) > (best[0], best[1]):
+                best = cand
+    return best
+
+
+def necessary_factor(
+        lp: LinearPattern) -> tuple[frozenset[int], ...] | None:
+    """The pattern's best necessary factor: a tuple of byte classes that
+    appears consecutively in EVERY input the pattern matches, chosen to
+    maximize selectivity (product of 256/|class|). Returns None when the
+    pattern may match without any such run — never_match (no matches to
+    gate), min_len == 0 (may match empty input), or no window clearing
+    the FACTOR_MIN_SCORE selectivity floor."""
+    if lp.never_match or lp.min_len == 0:
+        return None
+    best = None
+    for win in _factor_windows(lp.positions):
+        cand = _best_subwindow(win)
+        if cand is not None and (
+                best is None or (cand[0], cand[1]) > (best[0], best[1])):
+            best = cand
+    if best is None or best[0] < FACTOR_MIN_SCORE:
+        return None
+    return best[2]
+
+
+def factor_present(factor: tuple[frozenset[int], ...], data: bytes) -> bool:
+    """Naive host-side factor containment (the prefilter oracle used by
+    differential tests; the device kernel is ops/prefilter.py)."""
+    m = len(factor)
+    if m == 0:
+        return True
+    for i in range(len(data) - m + 1):
+        if all(data[i + j] in factor[j] for j in range(m)):
+            return True
+    return False
+
+
 # -- footprint extension (halo enablement) ------------------------------------
 #
 # The halo-parallel scans (ops/nfa_scan.halo_split_scan within a device,

@@ -37,7 +37,8 @@ from .batch import (
     pad_batch,
     tuple_to_context,
 )
-from .verdict import action_lanes, finish_batch, make_verdict_fn
+from .verdict import (action_lanes, finish_batch, make_prefilter_fn,
+                      make_verdict_fn)
 
 
 def force_cpu_backend() -> None:
@@ -158,11 +159,18 @@ class ServiceStats:
     score_errors: int = 0
     host_fallback_batches: int = 0
     batch_occupancy_sum: int = 0
+    # Batch dedup (ISSUE 4 satellite): identical RequestTuples inside
+    # one collector batch are encoded/evaluated once, the verdict fanned
+    # out to every duplicate's future.
+    dedup_hits: int = 0
+    # Literal-prefilter cascade counters (docs/PREFILTER.md).
+    prefilter_candidate_rate: float = 0.0
+    scan_banks_skipped: int = 0
 
     def __post_init__(self):
         from ..obs import REGISTRY
         from ..obs.registry import LATENCY_BUCKETS_MS, WAIT_BUCKETS_MS
-        from ..obs.schema import VERDICT_STAGES
+        from ..obs.schema import PREFILTER_METRICS, VERDICT_STAGES
 
         self.wait_hist = REGISTRY.histogram(
             "pingoo_verdict_wait_ms",
@@ -175,6 +183,14 @@ class ServiceStats:
                 buckets=LATENCY_BUCKETS_MS,
                 labels={"plane": "python", "stage": stage})
             for stage in VERDICT_STAGES}
+        self.pf_rate_gauge = REGISTRY.gauge(
+            "pingoo_prefilter_candidate_rate",
+            PREFILTER_METRICS["pingoo_prefilter_candidate_rate"],
+            labels={"plane": "python"})
+        self.pf_skip_counter = REGISTRY.counter(
+            "pingoo_scan_banks_skipped_total",
+            PREFILTER_METRICS["pingoo_scan_banks_skipped_total"],
+            labels={"plane": "python"})
 
     def observe_stage(self, stage: str, ms: float, n: int = 1) -> None:
         h = self.stage_hist[stage]
@@ -192,6 +208,10 @@ class ServiceStats:
             "host_fallback_batches": self.host_fallback_batches,
             "mean_occupancy": (self.batch_occupancy_sum / self.batches
                                if self.batches else 0.0),
+            "dedup_hits": self.dedup_hits,
+            "prefilter_candidate_rate": round(
+                self.prefilter_candidate_rate, 4),
+            "scan_banks_skipped": self.scan_banks_skipped,
             "verdict_p50_ms": self.wait_hist.percentile(0.50),
             "verdict_p99_ms": self.wait_hist.percentile(0.99),
             "stages": {
@@ -229,6 +249,8 @@ class VerdictService:
         self._task: Optional[asyncio.Task] = None
         self._verdict_fn = None
         self._tables = None
+        self._pf_fn = None
+        self._pf_gated_banks = 0
         if use_device and ensure_jax_backend():
             # Fail-open boot (SURVEY.md §5 failure detection): a broken
             # accelerator backend degrades to the XLA CPU engine, and a
@@ -238,6 +260,12 @@ class VerdictService:
                 import jax
 
                 self._verdict_fn = make_verdict_fn(plan)
+                # Stage-A prefilter as its own dispatch so the pipeline
+                # stage is separately timeable (None when the plan has
+                # no factors or PINGOO_PREFILTER=off).
+                pf = make_prefilter_fn(plan)
+                if pf is not None:
+                    self._pf_fn, self._pf_gated_banks = pf
                 tables = plan.device_tables()
                 if device is not None:
                     tables = jax.device_put(tables, device)
@@ -381,11 +409,38 @@ class VerdictService:
                             action=0, matched=np.zeros(R, dtype=bool),
                             degraded=True))
 
+    @staticmethod
+    def _dedup_key(req: RequestTuple) -> tuple:
+        # Everything a verdict can depend on; trace_id deliberately
+        # excluded (it never reaches the device arrays).
+        return (req.method, req.path, req.url, req.host, req.user_agent,
+                req.ip, req.remote_port, req.asn, req.country)
+
     async def _run_batch(self, pending: list) -> None:
         reqs = [r for r, _, _ in pending]
+        # Batch dedup: replayed/bursty traffic repeats identical tuples
+        # (same method/path/headers/ip); encode + evaluate each distinct
+        # tuple once and fan the verdict out to every duplicate.
+        seen: dict[tuple, int] = {}
+        uniq_rows: list[int] = []
+        row_of: list[int] = []
+        for i, req in enumerate(reqs):
+            key = self._dedup_key(req)
+            j = seen.get(key)
+            if j is None:
+                j = len(uniq_rows)
+                seen[key] = j
+                uniq_rows.append(i)
+            row_of.append(j)
+        dups = len(reqs) - len(uniq_rows)
+        eval_reqs = [reqs[i] for i in uniq_rows] if dups else reqs
         loop = asyncio.get_running_loop()
         matched, scores = await loop.run_in_executor(
-            None, self._evaluate_with_scores, reqs)
+            None, self._evaluate_with_scores, eval_reqs)
+        if dups:
+            self.stats.dedup_hits += dups
+            matched = matched[row_of]  # fan out to duplicate rows
+            scores = scores[row_of]
         t_resolve = time.monotonic()
         actions, verified_block = action_lanes(self.plan, matched)
         self.stats.batches += 1
@@ -468,8 +523,18 @@ class VerdictService:
                 fast = pad_batch(
                     RequestBatch(size=batch.size, arrays=arrays),
                     self._pow2_size(n))
+                pf_hits = pf_aux = None
+                if self._pf_fn is not None:
+                    # Stage A (always-on, whole batch): factor hits feed
+                    # the verdict program's bank gating; the aux lanes
+                    # feed the candidate-rate/skip metrics after the
+                    # batch's sync point.
+                    t0 = time.monotonic()
+                    pf_hits, pf_aux = self._pf_fn(self._tables, fast.arrays)
+                    self.stats.observe_stage(
+                        "prefilter", (time.monotonic() - t0) * 1e3)
                 t0 = time.monotonic()
-                dev = self._verdict_fn(self._tables, fast.arrays)
+                dev = self._verdict_fn(self._tables, fast.arrays, pf_hits)
                 # jax dispatch is async: this stage is issue + host->
                 # device transfer; the on-device execution residual is
                 # timed inside finish_batch via block_until_ready,
@@ -480,12 +545,32 @@ class VerdictService:
                     self.plan, dev, fast, self.lists,
                     on_device_wait=lambda ms: self.stats.observe_stage(
                         "device_compute", ms))[:n]
+                if pf_aux is not None:
+                    self._observe_prefilter(pf_aux, fast.size)
             except Exception:
                 self.stats.device_errors += 1
         if matched is None:
             self.stats.host_fallback_batches += 1
             matched = self._evaluate_host(batch)
         return self._rewrite_overflow_rows(reqs, batch, matched)
+
+    def _observe_prefilter(self, pf_aux, batch_rows: int) -> None:
+        """Fold the Stage-A aux lanes into the metrics surface
+        (obs/schema.py PREFILTER_METRICS). Called AFTER finish_batch's
+        sync point — the aux vector was computed before the verdict even
+        dispatched, so this materialization never waits on the device."""
+        try:
+            # pingoo: allow(sync-asarray-hot): two int32 lanes resolved
+            vals = np.asarray(pf_aux)  # long before the batch's sync
+            cand_rows, skipped = int(vals[0]), int(vals[1])
+        except Exception:
+            return
+        denom = batch_rows * self._pf_gated_banks
+        self.stats.prefilter_candidate_rate = (
+            cand_rows / denom if denom else 0.0)
+        self.stats.scan_banks_skipped += skipped
+        self.stats.pf_rate_gauge.set(self.stats.prefilter_candidate_rate)
+        self.stats.pf_skip_counter.inc(skipped)
 
     def _rewrite_overflow_rows(self, reqs, batch, matched: np.ndarray):
         """Rows whose fields exceeded device capacity are re-evaluated on

@@ -49,6 +49,7 @@ from ..ops.cidr import cidr_contains, int_set_contains, v4_buckets_contains
 from ..ops.match_ops import eq_match, prefix_match, suffix_match
 from ..ops.nfa_scan import (extract_slots, halo_split_k, halo_split_scan,
                             init_scan_state, packed_scan_states, scan_chunk)
+from ..ops.prefilter import prefilter_scan
 from ..ops.window_match import window_hits
 
 I64_MIN = -(2**63)
@@ -102,6 +103,47 @@ def _resolve_strategy(strat: ScanStrategy) -> ScanStrategy:
     kind, pair = _ENV_STRATEGIES[env]
     return ScanStrategy(kind=kind, pair=pair, halo_k=strat.halo_k,
                         source="env")
+
+
+# -- literal-prefilter cascade (Stage B wiring) -------------------------------
+#
+# PINGOO_PREFILTER (read per trace; the plan's autotuned default_mode
+# applies when unset):
+#   off     — Stage A never runs; every bank scans unconditionally (the
+#             pre-cascade behavior, the parity baseline).
+#   banks   — one packed shift-AND pass per field; a gated NFA bank is
+#             SKIPPED (lax.cond, shapes static) when no request in the
+#             batch has a candidate for any of its patterns.
+#   compact — banks, plus: a sparse gated bank gathers its candidate
+#             rows into the smallest power-of-2-ish bucket that holds
+#             them (a static ladder -> lax.switch), scans the compacted
+#             rows, and scatters the hits back.
+# PINGOO_PREFILTER_LEVELS caps the compaction ladder depth (default 4
+# halvings); PINGOO_PREFILTER_KERNEL=pallas routes Stage A through the
+# fused kernel. Soundness is structural: candidates over-approximate
+# matches, so pruning can never change a verdict (tests/test_prefilter).
+
+
+def _resolve_pf_mode(plan: RulesetPlan) -> str:
+    pf = getattr(plan, "prefilter", None)
+    if pf is None or not pf.fields:
+        return "off"
+    mode = _os.environ.get("PINGOO_PREFILTER", "") or pf.default_mode
+    return mode if mode in ("off", "banks", "compact") else "banks"
+
+
+def _pf_backend() -> str | None:
+    return _os.environ.get("PINGOO_PREFILTER_KERNEL") or None
+
+
+def _pf_compact_sizes(B: int) -> list[int]:
+    """Static compaction ladder: [B, B/2, ...] bounded by the level cap
+    and a 32-row floor (below that the scan cost is all fixed)."""
+    levels = int(_os.environ.get("PINGOO_PREFILTER_LEVELS", "4"))
+    sizes = [B]
+    while len(sizes) <= levels and sizes[-1] // 2 >= 32:
+        sizes.append(sizes[-1] // 2)
+    return sizes
 
 
 # -- numeric IR evaluation ---------------------------------------------------
@@ -167,8 +209,13 @@ _CMP = {
 # -- leaf evaluation ---------------------------------------------------------
 
 
-def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
-    """Compute every leaf's ([B] val, [B] err) with shared group ops."""
+def _eval_leaves(plan: RulesetPlan, tables, arrays, B, pf_hits=None):
+    """Compute every leaf's ([B] val, [B] err) with shared group ops.
+
+    `pf_hits` optionally carries precomputed Stage-A prefilter hit maps
+    ({field: [B, F] bool} from make_prefilter_fn — the service path
+    dispatches Stage A as its own program so the stage is timeable);
+    absent, the prefilter is traced inline into the same XLA program."""
     results: dict[int, tuple] = {}
     no_err = jnp.zeros((B,), dtype=bool)
 
@@ -213,6 +260,117 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
                            lookup=lookup, backend=backend)
         return extract_slots(bank, state, lens)
 
+    # -- Stage B: candidate gating over the Stage-A factor hits --------------
+
+    pf = getattr(plan, "prefilter", None)
+    pf_mode = _resolve_pf_mode(plan)
+    pf_field_hits: dict[str, Any] = dict(pf_hits or {})
+
+    def field_pf(field):
+        """This field's [B, F] factor hits (from the caller-provided
+        Stage-A pass, or traced inline exactly once per field)."""
+        if field not in pf_field_hits:
+            ff = pf.fields[field]
+            pf_field_hits[field] = prefilter_scan(
+                tables[ff.table_key], arrays[f"{field}_bytes"],
+                arrays[f"{field}_len"], backend=_pf_backend())
+        return pf_field_hits[field]
+
+    def bank_skip_result(bank, lens):
+        """A skipped bank's exact result: zero scan state still yields
+        the always-match and empty-input lanes; every factor-gated slot
+        is False — sound because skipping only happens when no request
+        holds any of the bank's factors (candidates ⊇ matches)."""
+        Bsz = lens.shape[0]
+        state = jnp.zeros((Bsz, bank.opt.shape[0]), dtype=jnp.uint32)
+        return extract_slots(bank, state, lens)
+
+    def bank_candidates(key, n_rows):
+        """[n_rows] candidate-row vector for bank `key`, or None when
+        the bank is ungated (no prefilter, mode off, or a slot without
+        an extractable factor)."""
+        if pf is None or pf_mode == "off":
+            return None
+        if not pf.bank_gated.get(key) or key not in pf.bank_masks:
+            return None
+        field = pf.bank_field[key]
+        if field not in pf.fields:
+            return None
+        mask = pf.bank_masks[key]
+        if not mask.any():
+            # Only never-match slots: statically no candidates.
+            return jnp.zeros((n_rows,), dtype=bool)
+        return jnp.any(field_pf(field) & jnp.asarray(mask)[None, :],
+                       axis=1)
+
+    def compact_rows(scan_rows, base_fn, data, lens, cand):
+        """Gather candidate rows into the smallest ladder bucket that
+        holds them, scan the compacted rows, scatter hits back over the
+        skipped-bank base. Every branch has static shapes (lax.switch);
+        the last branch is the empty-candidate full skip."""
+        Bsz = data.shape[0]
+        sizes = _pf_compact_sizes(Bsz)
+        count = cand.sum(dtype=jnp.int32)
+        order = jnp.argsort(jnp.where(cand, 0, 1))  # candidates first
+
+        def full():
+            return scan_rows(data, lens)
+
+        def level(sz):
+            def br():
+                idx = order[:sz]
+                h = scan_rows(jnp.take(data, idx, axis=0),
+                              jnp.take(lens, idx))
+                return base_fn().at[idx].set(h)
+            return br
+
+        branches = ([full] + [level(sz) for sz in sizes[1:]] + [base_fn])
+        if len(sizes) > 1:
+            lev = jnp.sum((jnp.asarray(sizes[1:], dtype=jnp.int32)
+                           >= count).astype(jnp.int32))
+        else:
+            lev = jnp.int32(0)
+        lev = jnp.where(count == 0, jnp.int32(len(branches) - 1), lev)
+        return jax.lax.switch(lev, branches)
+
+    def gated_scan(key, data, lens, scan_rows, base_fn):
+        """Run one bank through the cascade: unconditional when the bank
+        is ungated, cond-skipped in banks mode, row-compacted in compact
+        mode."""
+        cand = bank_candidates(key, data.shape[0])
+        if cand is None:
+            return scan_rows(data, lens)
+        if pf_mode == "compact":
+            return compact_rows(scan_rows, base_fn, data, lens, cand)
+        return jax.lax.cond(
+            jnp.any(cand),
+            lambda: scan_rows(data, lens),
+            base_fn)
+
+    def gated_bank_hits(key, bank, strat, data, lens):
+        return gated_scan(
+            key, data, lens,
+            lambda d, l: bank_hits(bank, strat, d, l),
+            lambda: bank_skip_result(bank, lens))
+
+    def gated_window_hits(key, field):
+        """The window bank under the same cascade: a gated win bank's
+        slots are all factor-gated or never-match, so the skip base is
+        simply all-False (window patterns carry no always/empty lanes
+        once gating eligibility excludes min_len == 0 sources)."""
+        data = arrays[f"{field}_bytes"]
+        lens = arrays[f"{field}_len"]
+        if pf is None or key not in pf.slot_codes:
+            return window_hits(tables[key], data, lens)
+        # P from the TABLE, not the plan: the tp mesh path pads the
+        # pattern axis (parallel/mesh.pad_tables_for_tp) and pad rows
+        # never match, so all-False covers them too.
+        P = tables[key].kernel.shape[0]
+        return gated_scan(
+            key, data, lens,
+            lambda d, l: window_hits(tables[key], d, l),
+            lambda: jnp.zeros((data.shape[0], P), dtype=bool))
+
     def run_packed_scans(groups: dict[str, tuple[str, list]]) -> None:
         """Run every NFA bank through its plan-selected strategy
         (compiler/plan.py scan_plans; module-level knobs override).
@@ -228,12 +386,12 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
             if entry.split is not None:
                 skey, rkey = entry.split
                 hits = jnp.concatenate(
-                    [bank_hits(tables[skey],
-                               _resolve_strategy(entry.short_strategy),
-                               data, lens),
-                     bank_hits(tables[rkey],
-                               _resolve_strategy(entry.rest_strategy),
-                               data, lens)], axis=1)
+                    [gated_bank_hits(skey, tables[skey],
+                                     _resolve_strategy(entry.short_strategy),
+                                     data, lens),
+                     gated_bank_hits(rkey, tables[rkey],
+                                     _resolve_strategy(entry.rest_strategy),
+                                     data, lens)], axis=1)
                 perm = jnp.asarray(entry.slot_perm, dtype=jnp.int32)
                 nfa_cache[key] = jnp.take(hits, perm, axis=1)
                 continue
@@ -250,7 +408,8 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
                         continue
                 packed[key] = (tables[key], data, lens)
                 continue
-            nfa_cache[key] = bank_hits(tables[key], strat, data, lens)
+            nfa_cache[key] = gated_bank_hits(key, tables[key], strat,
+                                             data, lens)
         if packed:
             states = packed_scan_states(
                 {k: v[0] for k, v in packed.items()},
@@ -320,9 +479,7 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B):
             field, members = win_groups[key]
             mat = span_leaf_matrix(
                 key,
-                lambda key=key, field=field: window_hits(
-                    tables[key], arrays[f"{field}_bytes"],
-                    arrays[f"{field}_len"]),
+                lambda key=key, field=field: gated_window_hits(key, field),
                 [span for _, span in members])
             results[leaf_id] = (mat[:, col], no_err)
         elif k == "str_list":
@@ -400,7 +557,7 @@ def _eval_bool(ir, leaves, B):
 # -- public API --------------------------------------------------------------
 
 
-def _matched_cols(plan: RulesetPlan, tables, arrays):
+def _matched_cols(plan: RulesetPlan, tables, arrays, pf_hits=None):
     """Traced body shared by the verdict/lane functions:
     (tables, arrays) -> [B, R_dev] bool in device_rule_indices order.
 
@@ -412,7 +569,7 @@ def _matched_cols(plan: RulesetPlan, tables, arrays):
     device_rules = [r for r in plan.rules if not r.host]
     n_leaves = len(plan.leaves)
     B = arrays["asn"].shape[0]
-    leaves = _eval_leaves(plan, tables, arrays, B)
+    leaves = _eval_leaves(plan, tables, arrays, B, pf_hits=pf_hits)
     # Effective per-leaf match columns (+ const true / false).
     eff = [None] * n_leaves
     for leaf_id, (v, e) in leaves.items():
@@ -443,13 +600,60 @@ def _matched_cols(plan: RulesetPlan, tables, arrays):
 
 
 def make_verdict_fn(plan: RulesetPlan):
-    """Jitted device verdict: (tables, arrays) -> [B, R_dev] bool."""
+    """Jitted device verdict: (tables, arrays) -> [B, R_dev] bool.
+
+    `pf_hits` optionally feeds a separately-dispatched Stage-A prefilter
+    pass (make_prefilter_fn); left None, Stage A traces inline under the
+    active PINGOO_PREFILTER mode."""
 
     @jax.jit
-    def verdict(tables, arrays):
-        return _matched_cols(plan, tables, arrays)
+    def verdict(tables, arrays, pf_hits=None):
+        return _matched_cols(plan, tables, arrays, pf_hits=pf_hits)
 
     return verdict
+
+
+def make_prefilter_fn(plan: RulesetPlan):
+    """Jitted Stage-A pass: (tables, arrays) -> (pf_hits, aux), where
+    pf_hits is {field: [B, F] bool} (feed to the verdict/lane fn so the
+    pipeline stage is separately timeable) and aux is an int32 [2]
+    vector [candidate_rows_total, banks_skipped] for the observability
+    surface (obs/schema.py PREFILTER_METRICS). Returns (fn, n_gated)
+    or None when the plan has no prefilter / the mode is off."""
+    pf = getattr(plan, "prefilter", None)
+    if pf is None or not pf.fields or _resolve_pf_mode(plan) == "off":
+        return None
+    # Bank keys the evaluator actually scans: NFA banks follow the scan
+    # plan (split-aware); window banks are all registered win_* keys.
+    scanned: list[str] = []
+    for key, entry in plan.scan_plans.items():
+        scanned.extend(entry.split if entry.split else (key,))
+    scanned.extend(k for k in pf.bank_masks if k.startswith("win_"))
+    gated = [k for k in scanned
+             if pf.bank_gated.get(k) and k in pf.bank_masks
+             and pf.bank_field.get(k) in pf.fields]
+    # Hoisted device constants (analyze-lint recompile-const-upload).
+    masks = {k: jnp.asarray(pf.bank_masks[k]) for k in gated
+             if pf.bank_masks[k].any()}
+    backend = _pf_backend()
+
+    @jax.jit
+    def stage_a(tables, arrays):
+        hits = {}
+        for field, ff in pf.fields.items():
+            hits[field] = prefilter_scan(
+                tables[ff.table_key], arrays[f"{field}_bytes"],
+                arrays[f"{field}_len"], backend=backend)
+        cand_rows = jnp.int32(0)
+        skipped = jnp.int32(len(gated) - len(masks))  # never-only banks
+        for k, mask in masks.items():
+            cand = jnp.any(hits[pf.bank_field[k]] & mask[None, :], axis=1)
+            cand_rows = cand_rows + cand.sum(dtype=jnp.int32)
+            skipped = skipped + jnp.where(jnp.any(cand), 0, 1).astype(
+                jnp.int32)
+        return hits, jnp.stack([cand_rows, skipped])
+
+    return stage_a, len(gated)
 
 
 LANE_NONE = np.int32(2**30)  # "no rule": sorts after every real index
@@ -515,8 +719,8 @@ def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
         for dev_route in group_routes]
 
     @jax.jit
-    def lanes(tables, arrays):
-        matched = _matched_cols(plan, tables, arrays)  # [B, C]
+    def lanes(tables, arrays, pf_hits=None):
+        matched = _matched_cols(plan, tables, arrays, pf_hits)  # [B, C]
         B = arrays["asn"].shape[0]
         none = jnp.full((B,), LANE_NONE, dtype=jnp.int32)
         n_route = max(len(groups), 1)

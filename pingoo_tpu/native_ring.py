@@ -535,8 +535,27 @@ class RingSidecar:
                 "pingoo_verdict_stage_ms",
                 "verdict pipeline stage latency (ms)",
                 labels={"plane": "sidecar", "stage": stage})
-            for stage in ("encode", "device_dispatch", "device_compute",
-                          "resolve")}
+            for stage in ("encode", "prefilter", "device_dispatch",
+                          "device_compute", "resolve")}
+        # Stage-A literal prefilter (docs/PREFILTER.md): the sidecar is
+        # the native plane's verdict engine, so it exports the same
+        # candidate-rate/skip metrics the Python listener plane does.
+        from .engine.verdict import make_prefilter_fn
+        from .obs.schema import PREFILTER_METRICS
+
+        self._pf_fn = None
+        self._pf_gated_banks = 0
+        pf = make_prefilter_fn(plan)
+        if pf is not None:
+            self._pf_fn, self._pf_gated_banks = pf
+        self._pf_rate_gauge = REGISTRY.gauge(
+            "pingoo_prefilter_candidate_rate",
+            PREFILTER_METRICS["pingoo_prefilter_candidate_rate"],
+            labels={"plane": "sidecar"})
+        self._pf_skip_counter = REGISTRY.counter(
+            "pingoo_scan_banks_skipped_total",
+            PREFILTER_METRICS["pingoo_scan_banks_skipped_total"],
+            labels={"plane": "sidecar"})
         self._collector_live = True
         REGISTRY.register_collector(self._export_ring_telemetry)
 
@@ -604,11 +623,18 @@ class RingSidecar:
                     RequestBatch(size=n, arrays=bucket_arrays(raw.arrays)),
                     self.max_batch)
                 t1 = time.monotonic()
-                dev = self._lane_fn(self._tables, batch.arrays)  # async
+                pf_hits = pf_aux = None
+                if self._pf_fn is not None:
+                    pf_hits, pf_aux = self._pf_fn(
+                        self._tables, batch.arrays)  # async
+                tpf = time.monotonic()
+                dev = self._lane_fn(self._tables, batch.arrays,
+                                    pf_hits)  # async
                 t2 = time.monotonic()
                 self._stage["encode"].observe((t1 - t0) * 1e3)
-                self._stage["device_dispatch"].observe((t2 - t1) * 1e3)
-                inflight.append((parts, slots, raw, dev, n))
+                self._stage["prefilter"].observe((tpf - t1) * 1e3)
+                self._stage["device_dispatch"].observe((t2 - tpf) * 1e3)
+                inflight.append((parts, slots, raw, dev, pf_aux, n))
             if inflight and (len(inflight) >= self.pipeline_depth or n == 0):
                 self._complete(*inflight.popleft())
             if n == 0 and not inflight:
@@ -646,7 +672,8 @@ class RingSidecar:
             if len(cc) == 2:
                 slots["country"][i] = cc
 
-    def _complete(self, parts, slots, raw_batch, dev, n: int) -> None:
+    def _complete(self, parts, slots, raw_batch, dev, pf_aux,
+                  n: int) -> None:
         from .engine.verdict import host_rule_lanes, merge_lanes
 
         # Host-interpreted rules run on the UNPADDED batch while the
@@ -657,6 +684,13 @@ class RingSidecar:
         wait_s = time.time() - t0
         self.device_wait_s += wait_s
         self._stage["device_compute"].observe(wait_s * 1e3)
+        if pf_aux is not None:
+            # Resolved long before the lane sync above; two int32 lanes.
+            vals = np.asarray(pf_aux)
+            denom = self.max_batch * self._pf_gated_banks
+            if denom:
+                self._pf_rate_gauge.set(int(vals[0]) / denom)
+            self._pf_skip_counter.inc(int(vals[1]))
         t_resolve = time.monotonic()
         self.batches += 1
         unverified, verified_block = merge_lanes(dev_lanes, host)

@@ -38,10 +38,26 @@ VERDICT_STAGES = (
     "queue_wait",      # evaluate() enqueue -> collector pop
     "batch_assembly",  # collector pop -> batch dispatch (the wait window)
     "encode",          # RequestTuple list -> fixed-shape arrays
+    "prefilter",       # Stage-A factor pass dispatch (async; ISSUE 4)
     "device_dispatch", # jitted call issue (async) incl. host->device
     "device_compute",  # block_until_ready on the device result
     "resolve",         # lanes/actions + future resolution
 )
+
+# Literal-prefilter cascade metrics (docs/PREFILTER.md): exported by
+# every plane that runs the batched verdict engine — the Python
+# listener plane (engine/service.py, plane="python") and the ring
+# sidecar serving the native plane (native_ring.py, plane="sidecar").
+# The "prefilter" entry in VERDICT_STAGES above is the matching
+# prefilter_ms stage histogram.
+PREFILTER_METRICS = {
+    "pingoo_prefilter_candidate_rate":
+        "fraction of request x gated-NFA-bank pairs the literal "
+        "prefilter left as candidates in the last batch",
+    "pingoo_scan_banks_skipped_total":
+        "NFA bank scans skipped because no request in the batch held "
+        "any of the bank's necessary literal factors",
+}
 
 # Ring telemetry block metrics (source: the shm header's atomic
 # telemetry block, pingoo_ring.h PingooRingTelemetry), exported by BOTH
@@ -90,4 +106,5 @@ NATIVE_JSON_KEYS = {
 
 def all_metric_names() -> set[str]:
     return (set(SHARED_METRICS) | set(RING_METRICS) | set(NATIVE_METRICS)
+            | set(PREFILTER_METRICS)
             | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

@@ -10,4 +10,4 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from . import cidr, match_ops, nfa_scan, pallas_scan  # noqa: E402,F401
+from . import cidr, match_ops, nfa_scan, pallas_scan, prefilter  # noqa: E402,F401

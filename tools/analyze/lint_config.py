@@ -30,6 +30,7 @@ HOT_FUNCTIONS = frozenset({
     "pingoo_tpu/engine/service.py::VerdictService._evaluate_sync",
     "pingoo_tpu/engine/service.py::VerdictService._evaluate_with_scores",
     "pingoo_tpu/engine/service.py::VerdictService._run_batch",
+    "pingoo_tpu/engine/service.py::VerdictService._observe_prefilter",
     "pingoo_tpu/engine/verdict.py::finish_batch",
     "pingoo_tpu/engine/verdict.py::merge_lanes",
 })
@@ -44,6 +45,10 @@ TRACED_FUNCTIONS = frozenset({
     "pingoo_tpu/engine/verdict.py::_eval_leaves",
     "pingoo_tpu/engine/verdict.py::_eval_bool",
     "pingoo_tpu/engine/verdict.py::_eval_num",
+    # Stage-A prefilter kernel (ISSUE 4): traced per batch from the
+    # verdict/lane programs and from make_prefilter_fn.
+    "pingoo_tpu/ops/prefilter.py::prefilter_scan",
+    "pingoo_tpu/ops/prefilter.py::_fused_prefilter",
 })
 
 # The explicit blessing list for block_until_ready: the ONE deliberate
@@ -56,7 +61,8 @@ BLOCK_UNTIL_READY_ALLOW = frozenset({
 # their result to a Python scalar (float()/int()/bool()) forces a
 # blocking device round-trip per call (sync-scalar-cast).
 JITTED_DISPATCH_NAMES = frozenset({
-    "_verdict_fn", "_score_fn", "_lane_fn", "verdict_fn", "lane_fn",
+    "_verdict_fn", "_score_fn", "_lane_fn", "_pf_fn", "verdict_fn",
+    "lane_fn",
 })
 
 # numpy allocators flagged inside hot functions (hot-alloc).

@@ -74,6 +74,15 @@ def main() -> int:
             problems.append(
                 f"engine/service.py: stage {stage!r} not instrumented")
 
+    # Prefilter cascade metrics: both engine planes (the Python listener
+    # service and the ring sidecar backing the native plane) must export
+    # the documented names.
+    for name in schema.PREFILTER_METRICS:
+        if name not in service_src:
+            problems.append(f"engine/service.py: missing metric {name}")
+        if name not in sidecar_src:
+            problems.append(f"native_ring.py: missing metric {name}")
+
     docs = _read("docs/OBSERVABILITY.md") if os.path.exists(
         os.path.join(REPO, "docs/OBSERVABILITY.md")) else ""
     if not docs:
@@ -86,7 +95,8 @@ def main() -> int:
     # Synthetic full-inventory registry must pass the exposition lint.
     reg = MetricRegistry()
     for name, help_text in {**schema.SHARED_METRICS,
-                            **schema.RING_METRICS}.items():
+                            **schema.RING_METRICS,
+                            **schema.PREFILTER_METRICS}.items():
         if name.endswith("_total"):
             reg.counter(name, help_text, labels={"plane": "audit"}).inc()
         else:
