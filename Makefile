@@ -2,7 +2,8 @@
 
 PY ?= python
 
-.PHONY: all native test check bench audit asan metrics-smoke clean \
+.PHONY: all native test check bench bench-regress audit asan \
+	metrics-smoke clean \
 	analyze analyze-abi analyze-lint analyze-tidy analyze-tsan
 
 all: native
@@ -44,6 +45,14 @@ analyze-tsan:
 
 bench: native
 	$(PY) bench.py
+
+# Bench trajectory gate (ISSUE 5 satellite): `bench.py --history`
+# appends each run to BENCH_history.jsonl; this compares the latest run
+# against the previous comparable one (same backend) and fails on a
+# >BENCH_REGRESS_THRESHOLD (default 10%) regression of any tracked
+# metric.
+bench-regress:
+	$(PY) tools/bench_regress.py
 
 # Dependency audit — the reference ships .github/workflows/audit.yml
 # (cargo audit + cargo deny); the equivalent here is pip-audit over the

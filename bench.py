@@ -271,7 +271,7 @@ def bench_prefilter_modes(plan, tables, arrays, verdict_body,
             else:
                 os.environ["PINGOO_PREFILTER"] = prev
         if pf is not None:
-            pf_fn, n_gated = pf
+            pf_fn, n_gated = pf.fn, len(pf.gated)
             _, aux = pf_fn(tables, arrays)
             aux = np.asarray(aux)
             out["banks_gated"] = n_gated
@@ -603,6 +603,28 @@ _EMIT_LOCK = threading.Lock()
 _EMITTED = False
 
 
+def _history_enabled() -> bool:
+    return "--history" in sys.argv or os.environ.get("BENCH_HISTORY") == "1"
+
+
+def _history_path() -> str:
+    return os.environ.get("BENCH_HISTORY_FILE", "BENCH_history.jsonl")
+
+
+def _append_history(line: str) -> None:
+    """Bench trajectory (ISSUE 5 satellite): append THE emitted result
+    line (success or error — a failed run is trajectory too) to
+    BENCH_history.jsonl with a wall-clock stamp, so
+    tools/bench_regress.py can diff consecutive runs. Best-effort: a
+    read-only tree must not turn a finished bench into rc=1."""
+    try:
+        entry = {"ts": round(time.time(), 3), **json.loads(line)}
+        with open(_history_path(), "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except Exception:
+        pass
+
+
 def _emit_once(line: str) -> bool:
     global _EMITTED
     with _EMIT_LOCK:
@@ -610,6 +632,8 @@ def _emit_once(line: str) -> bool:
             return False
         _EMITTED = True
         print(line, flush=True)
+        if _history_enabled():
+            _append_history(line)
         return True
 
 

@@ -416,6 +416,28 @@ class RulesetPlan:
     def host_rules(self) -> list[PlannedRule]:
         return [r for r in self.rules if r.host]
 
+    @property
+    def rule_names(self) -> tuple[str, ...]:
+        """Rule names in ORIGINAL index order (route pseudo-rules
+        included) — the label space of the per-rule attribution lanes
+        and the flight recorder (obs/provenance.py, ISSUE 5)."""
+        return tuple(r.name for r in self.rules)
+
+    def provenance_labels(self) -> dict:
+        """Static label inventory the provenance layer exports against:
+        rule names, the device-column -> original-index mapping for the
+        on-device attribution fold, and the cascade-gated bank keys for
+        banks-skipped attribution. Everything here is plan-static, so
+        label cardinality is fixed at compile time."""
+        pf = self.prefilter
+        gated = tuple(k for k, g in pf.bank_gated.items() if g) \
+            if pf is not None else ()
+        return {
+            "rules": self.rule_names,
+            "device_cols": tuple(self.device_rule_indices),
+            "gated_banks": gated,
+        }
+
 
 def compile_ruleset(
     rules: list[RuleConfig],

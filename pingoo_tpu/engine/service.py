@@ -28,6 +28,10 @@ import numpy as np
 from ..compiler.plan import RulesetPlan
 from ..config.schema import Action
 from ..expr import execute_as_bool
+from ..obs.flightrecorder import (FlightRecorder, register_recorder,
+                                  tuple_digest)
+from ..obs.provenance import (ParityAuditor, PrefilterAttribution,
+                              RuleAttribution, provenance_enabled)
 from .batch import (
     RequestBatch,
     RequestTuple,
@@ -251,6 +255,22 @@ class VerdictService:
         self._tables = None
         self._pf_fn = None
         self._pf_gated_banks = 0
+        self._pf_attr = None
+        # Verdict provenance (ISSUE 5): per-rule attribution, the
+        # flight recorder, and the shadow-parity auditor. PINGOO_
+        # PROVENANCE=0 turns the whole layer off; the parity auditor
+        # additionally samples nothing until PINGOO_PARITY_SAMPLE > 0.
+        self._last_batch_stages: dict = {}
+        self.flight_recorder = None
+        self._attribution = None
+        self.parity = None
+        if provenance_enabled():
+            self.flight_recorder = register_recorder(FlightRecorder(
+                "python", rule_names=plan.rule_names))
+            self._attribution = RuleAttribution(plan.rule_names,
+                                                plane="python")
+            self.parity = ParityAuditor(plan, lists, plane="python",
+                                        recorder=self.flight_recorder)
         if use_device and ensure_jax_backend():
             # Fail-open boot (SURVEY.md §5 failure detection): a broken
             # accelerator backend degrades to the XLA CPU engine, and a
@@ -265,7 +285,11 @@ class VerdictService:
                 # no factors or PINGOO_PREFILTER=off).
                 pf = make_prefilter_fn(plan)
                 if pf is not None:
-                    self._pf_fn, self._pf_gated_banks = pf
+                    self._pf_fn = pf.fn
+                    self._pf_gated_banks = len(pf.gated)
+                    if provenance_enabled():
+                        self._pf_attr = PrefilterAttribution(
+                            pf.masked, plane="python")
                 tables = plan.device_tables()
                 if device is not None:
                     tables = jax.device_put(tables, device)
@@ -316,6 +340,10 @@ class VerdictService:
                 pass
             self._profile_task = None
         self.ensure_trace_stopped()
+        if self.parity is not None:
+            self.parity.stop()
+        if self._attribution is not None:
+            self._attribution.close()
 
     def ensure_trace_stopped(self) -> None:
         """Flush any live jax.profiler trace (the boot-time
@@ -458,13 +486,59 @@ class VerdictService:
                             verified_block=bool(verified_block[i])))
         self.stats.observe_stage(
             "resolve", (time.monotonic() - t_resolve) * 1e3)
+        # Provenance AFTER future resolution: attribution fold + flight
+        # records + the parity sampling decision never sit between the
+        # device result and the waiting requests.
+        t_prov = time.monotonic()
+        if self._attribution is not None:
+            self._observe_provenance(reqs, pending, matched, actions,
+                                     t_resolve)
+        self.stats.observe_stage(
+            "provenance", (time.monotonic() - t_prov) * 1e3)
+
+    def _observe_provenance(self, reqs, pending, matched, actions,
+                            t_resolve) -> None:
+        """Per-batch provenance: fold per-rule hit counters, flight-
+        record each request, and hand the batch to the parity sampler.
+        Runs on the collector path per batch — registered hot in the
+        analyze-lint registries, so any device sync creeping in here
+        fails `make analyze` (the matrix is already host-resident)."""
+        self._attribution.fold_batch(matched.sum(axis=0))
+        recorder = self.flight_recorder
+        batch_stages = self._last_batch_stages
+        n = len(reqs)
+        # Matched-rule ids per row from ONE nonzero pass (per-row
+        # nonzero would be n small kernel launches' worth of overhead).
+        rows, cols = np.nonzero(matched)
+        per_row: dict[int, list] = {}
+        # pingoo: allow(sync-tolist): host-resident numpy index vectors
+        for r, c in zip(rows.tolist(), cols.tolist()):
+            per_row.setdefault(r, []).append(c)
+        # Recording more rows than the ring holds is pure wrap-around
+        # churn; keep the LAST capacity rows of the batch.
+        start = max(0, n - recorder.capacity)
+        for i in range(start, n):
+            req = reqs[i]
+            stages = dict(batch_stages)
+            stages["wait_ms"] = round(
+                (t_resolve - pending[i][2]) * 1e3, 3)
+            recorder.record(
+                trace_id=req.trace_id,
+                digest=tuple_digest(req.method, req.host, req.path,
+                                    req.url, req.user_agent, req.ip),
+                stages=stages,
+                matched_rules=per_row.get(i, ()),
+                action=int(actions[i]))
+        if self.parity is not None:
+            self.parity.submit_matrix(reqs, matched)
 
     def _evaluate_with_scores(self, reqs: list[RequestTuple]):
         """-> (matched [B, R], bot scores [B]). Scores ride the same
         encoded batch (BASELINE config 5: the vectorized bot head)."""
         t0 = time.monotonic()
         batch = encode_requests(reqs, self.plan.field_specs)
-        self.stats.observe_stage("encode", (time.monotonic() - t0) * 1e3)
+        self._last_batch_stages = {}  # fresh per batch (collector thread)
+        self._batch_stage("encode", (time.monotonic() - t0) * 1e3)
         n = len(reqs)
         # DISPATCH the scorer before the verdict runs: jax dispatch is
         # async, so the bot head computes while the verdict path does
@@ -508,6 +582,13 @@ class VerdictService:
             target *= 2
         return max(min(max(target, 8), self.max_batch), n)
 
+    def _batch_stage(self, stage: str, ms: float) -> None:
+        """Observe a pipeline stage AND stash it in the per-batch stage
+        dict the flight recorder attaches to every record (single
+        collector thread — no lock needed)."""
+        self.stats.observe_stage(stage, ms)
+        self._last_batch_stages[f"{stage}_ms"] = round(ms, 3)
+
     def _evaluate_sync(self, reqs: list[RequestTuple],
                        batch: Optional[RequestBatch] = None) -> np.ndarray:
         n = len(reqs)
@@ -531,7 +612,7 @@ class VerdictService:
                     # batch's sync point.
                     t0 = time.monotonic()
                     pf_hits, pf_aux = self._pf_fn(self._tables, fast.arrays)
-                    self.stats.observe_stage(
+                    self._batch_stage(
                         "prefilter", (time.monotonic() - t0) * 1e3)
                 t0 = time.monotonic()
                 dev = self._verdict_fn(self._tables, fast.arrays, pf_hits)
@@ -539,11 +620,11 @@ class VerdictService:
                 # device transfer; the on-device execution residual is
                 # timed inside finish_batch via block_until_ready,
                 # AFTER the host-interpreted rules overlapped it.
-                self.stats.observe_stage(
+                self._batch_stage(
                     "device_dispatch", (time.monotonic() - t0) * 1e3)
                 matched = finish_batch(
                     self.plan, dev, fast, self.lists,
-                    on_device_wait=lambda ms: self.stats.observe_stage(
+                    on_device_wait=lambda ms: self._batch_stage(
                         "device_compute", ms))[:n]
                 if pf_aux is not None:
                     self._observe_prefilter(pf_aux, fast.size)
@@ -560,7 +641,7 @@ class VerdictService:
         sync point — the aux vector was computed before the verdict even
         dispatched, so this materialization never waits on the device."""
         try:
-            # pingoo: allow(sync-asarray-hot): two int32 lanes resolved
+            # pingoo: allow(sync-asarray-hot): aux int32 lanes resolved
             vals = np.asarray(pf_aux)  # long before the batch's sync
             cand_rows, skipped = int(vals[0]), int(vals[1])
         except Exception:
@@ -571,6 +652,9 @@ class VerdictService:
         self.stats.scan_banks_skipped += skipped
         self.stats.pf_rate_gauge.set(self.stats.prefilter_candidate_rate)
         self.stats.pf_skip_counter.inc(skipped)
+        if self._pf_attr is not None:
+            # Per-bank candidate-rate/skip attribution (ISSUE 5).
+            self._pf_attr.observe(vals, batch_rows)
 
     def _rewrite_overflow_rows(self, reqs, batch, matched: np.ndarray):
         """Rows whose fields exceeded device capacity are re-evaluated on
@@ -586,6 +670,75 @@ class VerdictService:
             ctx = tuple_to_context(reqs[i], self.lists)
             matched[i, :] = interpret_rules_row(self.plan, ctx)
         return matched
+
+    # -- provenance introspection (the /__pingoo/explain endpoint) -----------
+
+    def _interpret_row(self, req: RequestTuple) -> np.ndarray:
+        from .verdict import interpret_rules_row
+
+        return interpret_rules_row(
+            self.plan, tuple_to_context(req, self.lists))
+
+    async def explain(self, req: RequestTuple) -> dict:
+        """Re-run ONE request end to end (the real batched device path)
+        AND through the host interpreter oracle, returning the per-rule
+        / per-stage provenance picture (the /__pingoo/explain payload,
+        validated against the interpreter's rule trace in tests)."""
+        verdict = await self.evaluate(req)
+        loop = asyncio.get_running_loop()
+        want = await loop.run_in_executor(None, self._interpret_row, req)
+        rules = []
+        mismatched = []
+        for rule in self.plan.rules:
+            dev_hit = bool(verdict.matched[rule.index]) \
+                if not verdict.degraded else None
+            interp_hit = bool(want[rule.index])
+            if dev_hit is not None and dev_hit != interp_hit:
+                mismatched.append(rule.name)
+            rules.append({
+                "name": rule.name,
+                "index": rule.index,
+                "host": rule.host,
+                "always": rule.always,
+                "actions": [a.value for a in rule.actions],
+                "device": dev_hit,
+                "interpreter": interp_hit,
+            })
+        # The flight record for this trace id lands in the provenance
+        # stage, AFTER the future resolves — poll briefly for it.
+        stages = None
+        if self.flight_recorder is not None and req.trace_id:
+            for _ in range(10):
+                entry = next(
+                    (e for e in self.flight_recorder.snapshot()
+                     if e["trace_id"] == req.trace_id), None)
+                if entry is not None:
+                    stages = entry["stages_ms"]
+                    break
+                await asyncio.sleep(0.01)
+        return {
+            "trace_id": req.trace_id,
+            "digest": tuple_digest(req.method, req.host, req.path,
+                                   req.url, req.user_agent, req.ip),
+            "request": {
+                "method": req.method, "host": req.host,
+                "path": req.path, "url": req.url,
+                "user_agent": req.user_agent, "ip": req.ip,
+                "asn": req.asn, "country": req.country,
+            },
+            "action": verdict.action,
+            "verified_block": verdict.verified_block,
+            "bot_score": verdict.bot_score,
+            "degraded": verdict.degraded,
+            "matched_rules": [
+                r.name for r in self.plan.rules
+                if bool(want[r.index] if verdict.degraded
+                        else verdict.matched[r.index])],
+            "rules": rules,
+            "parity": {"consistent": not mismatched,
+                       "mismatched_rules": mismatched},
+            "stages_ms": stages,
+        }
 
     def _evaluate_host(self, batch: RequestBatch) -> np.ndarray:
         """Interpreter path: the CPU engine (also the watchdog fallback)."""

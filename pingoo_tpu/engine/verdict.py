@@ -21,7 +21,7 @@ applies the reference's first-match action semantics.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -613,13 +613,26 @@ def make_verdict_fn(plan: RulesetPlan):
     return verdict
 
 
+class PrefilterProgram(NamedTuple):
+    """make_prefilter_fn's bundle: the jitted Stage-A pass plus the
+    static bank inventories the observability fold needs (gated = every
+    cascade-gated bank; masked = the subset with a non-empty factor
+    mask, in the aux vector's per-bank lane order)."""
+
+    fn: Any
+    gated: tuple[str, ...]
+    masked: tuple[str, ...]
+
+
 def make_prefilter_fn(plan: RulesetPlan):
     """Jitted Stage-A pass: (tables, arrays) -> (pf_hits, aux), where
     pf_hits is {field: [B, F] bool} (feed to the verdict/lane fn so the
-    pipeline stage is separately timeable) and aux is an int32 [2]
-    vector [candidate_rows_total, banks_skipped] for the observability
-    surface (obs/schema.py PREFILTER_METRICS). Returns (fn, n_gated)
-    or None when the plan has no prefilter / the mode is off."""
+    pipeline stage is separately timeable) and aux is an int32 vector
+    [candidate_rows_total, banks_skipped, *per-bank candidate counts,
+    *per-bank skip flags] (per-bank lanes in `masked` order — the
+    banks-skipped ATTRIBUTION surface, obs/provenance.py). Returns a
+    PrefilterProgram or None when the plan has no prefilter / the mode
+    is off."""
     pf = getattr(plan, "prefilter", None)
     if pf is None or not pf.fields or _resolve_pf_mode(plan) == "off":
         return None
@@ -646,21 +659,29 @@ def make_prefilter_fn(plan: RulesetPlan):
                 arrays[f"{field}_len"], backend=backend)
         cand_rows = jnp.int32(0)
         skipped = jnp.int32(len(gated) - len(masks))  # never-only banks
+        bank_cands = []
+        bank_skips = []
         for k, mask in masks.items():
             cand = jnp.any(hits[pf.bank_field[k]] & mask[None, :], axis=1)
-            cand_rows = cand_rows + cand.sum(dtype=jnp.int32)
-            skipped = skipped + jnp.where(jnp.any(cand), 0, 1).astype(
-                jnp.int32)
-        return hits, jnp.stack([cand_rows, skipped])
+            n_cand = cand.sum(dtype=jnp.int32)
+            skip = jnp.where(jnp.any(cand), 0, 1).astype(jnp.int32)
+            cand_rows = cand_rows + n_cand
+            skipped = skipped + skip
+            bank_cands.append(n_cand)
+            bank_skips.append(skip)
+        return hits, jnp.stack([cand_rows, skipped]
+                               + bank_cands + bank_skips)
 
-    return stage_a, len(gated)
+    return PrefilterProgram(fn=stage_a, gated=tuple(gated),
+                            masked=tuple(masks))
 
 
 LANE_NONE = np.int32(2**30)  # "no rule": sorts after every real index
 
 
 def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
-                 service_groups: list[list[str]] | None = None):
+                 service_groups: list[list[str]] | None = None,
+                 with_rule_hits: bool = False):
     """Jitted device ACTION-LANE reduction: (tables, arrays) ->
     [3 + max(G, 1), B] i32 rows (first_act_idx, first_act_kind,
     first_block_idx, route lane(s)), indices in ORIGINAL rule-index
@@ -681,7 +702,15 @@ def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
     config.rs:241-253): one route lane per group, all computed from the
     same [B, C] match matrix in one pass — the sidecar picks each row's
     lane by the ring it came from. Services whose route predicate fell
-    back to host interpretation are merged by the sidecar afterwards."""
+    back to host interpretation are merged by the sidecar afterwards.
+
+    `with_rule_hits` adds the PER-RULE ATTRIBUTION aux lane (ISSUE 5):
+    the [C] int32 per-column hit counts, batch rows folded ON DEVICE
+    with padding rows masked by the traced `n_valid` argument, ride the
+    same dispatch as the lanes — C extra int32s per batch, so
+    provenance costs no extra transfer round trip. The fn then returns
+    (lanes, rule_hits); columns map to original rule indices via
+    plan.device_rule_indices."""
     if service_groups is not None and services is not None:
         raise ValueError("pass services or service_groups, not both")
     groups = (service_groups if service_groups is not None
@@ -719,14 +748,27 @@ def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
         for dev_route in group_routes]
 
     @jax.jit
-    def lanes(tables, arrays, pf_hits=None):
+    def lanes(tables, arrays, pf_hits=None, n_valid=None):
         matched = _matched_cols(plan, tables, arrays, pf_hits)  # [B, C]
         B = arrays["asn"].shape[0]
+
+        def rule_hits():
+            # Attribution fold ON DEVICE: padded batch rows are inert
+            # for the lanes (their verdicts are never read) but always-
+            # match columns would count them, so mask by n_valid.
+            m = matched
+            if n_valid is not None:
+                m = m & (jnp.arange(B) < n_valid)[:, None]
+            return m.sum(axis=0, dtype=jnp.int32)
+
+        def pack(stack):
+            return (stack, rule_hits()) if with_rule_hits else stack
+
         none = jnp.full((B,), LANE_NONE, dtype=jnp.int32)
         n_route = max(len(groups), 1)
         if matched.shape[1] == 0:
-            return jnp.stack([none, jnp.zeros((B,), jnp.int32), none]
-                             + [none] * n_route)
+            return pack(jnp.stack([none, jnp.zeros((B,), jnp.int32), none]
+                                  + [none] * n_route))
         act_idx = jnp.where(matched & has_act_row, idx_row, LANE_NONE)
         first_act_idx = jnp.min(act_idx, axis=1)
         arg = jnp.argmin(act_idx, axis=1)
@@ -746,9 +788,10 @@ def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
                 route_lanes.append(none)
         if not route_lanes:
             route_lanes.append(none)
-        # One stacked [3 + G, B] array = ONE device->host transfer.
-        return jnp.stack([first_act_idx, kind, first_block_idx]
-                         + route_lanes)
+        # One stacked [3 + G, B] array = ONE device->host transfer
+        # (plus the [C] attribution lane when with_rule_hits).
+        return pack(jnp.stack([first_act_idx, kind, first_block_idx]
+                              + route_lanes))
 
     return lanes
 

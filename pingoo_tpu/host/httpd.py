@@ -602,6 +602,12 @@ class HttpListener:
         if req.path == "/__pingoo/profile":
             return await self._profile_response(req)
 
+        if req.path == "/__pingoo/flightrecorder":
+            return self._flightrecorder_response()
+
+        if req.path == "/__pingoo/explain":
+            return await self._explain_response(req, request_ctx)
+
         # Captcha-verified cookie: invalid -> challenge page (:222-236).
         captcha_verified = False
         verified_cookie = cookies.get(CAPTCHA_VERIFIED_COOKIE)
@@ -688,6 +694,55 @@ class HttpListener:
             "req_per_s": round(self.stats.requests / uptime, 2) if uptime else 0,
             "verdict": self.verdict.stats.snapshot(),
         }
+        return Response(200, [("content-type", "application/json")],
+                        json.dumps(payload).encode())
+
+    def _flightrecorder_response(self) -> Response:
+        """Dump every flight recorder registered in this process (the
+        listener plane's, plus the sidecar plane's when co-resident) —
+        the /__pingoo/flightrecorder endpoint (docs/OBSERVABILITY.md)."""
+        from ..obs.flightrecorder import dump_all
+
+        return Response(200, [("content-type", "application/json")],
+                        json.dumps(dump_all()).encode())
+
+    async def _explain_response(self, req: Request,
+                                request_ctx: RequestContext) -> Response:
+        """GET /__pingoo/explain?path=/x[&method=&host=&url=&ua=&ip=
+        &asn=&country=&port=]: re-run one synthetic request through the
+        REAL batched verdict path and the interpreter oracle, returning
+        per-rule / per-stage provenance JSON (VerdictService.explain).
+        Unspecified client fields default to the CALLING request's
+        (ip/asn/country), so `curl .../__pingoo/explain?path=/probe`
+        explains that path for the caller's own network identity."""
+        from urllib.parse import parse_qs, unquote
+
+        query = parse_qs(req.target.partition("?")[2],
+                         keep_blank_values=True)
+
+        def q(name, default=""):
+            vals = query.get(name)
+            return unquote(vals[0]) if vals else default
+
+        path = q("path", "/")
+        try:
+            asn = int(q("asn", str(request_ctx.asn)) or 0)
+            port = int(q("port", str(request_ctx.client_port)) or 0)
+        except ValueError:
+            return Response(400, [("content-type", "application/json")],
+                            b'{"error": "asn/port must be integers"}')
+        tup = RequestTuple(
+            host=q("host", request_ctx.host),
+            url=q("url", path),
+            path=path,
+            method=q("method", "GET") or "GET",
+            user_agent=q("ua", q("user_agent", "pingoo-explain")),
+            ip=q("ip", request_ctx.client_ip),
+            remote_port=port,
+            asn=asn,
+            country=q("country", request_ctx.country),
+            trace_id=new_trace_id())
+        payload = await self.verdict.explain(tup)
         return Response(200, [("content-type", "application/json")],
                         json.dumps(payload).encode())
 

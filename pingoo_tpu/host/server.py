@@ -237,3 +237,9 @@ async def run(config: Config, **kwargs) -> None:
         # lost on exit.
         if server.verdict is not None:
             server.verdict.ensure_trace_stopped()
+        # ... and auto-dump the flight recorders (ISSUE 5): the last N
+        # requests' provenance is exactly what a post-mortem of the
+        # shutdown-adjacent traffic needs, and it lives only in memory.
+        from ..obs.flightrecorder import dump_on_drain
+
+        dump_on_drain("sigterm")

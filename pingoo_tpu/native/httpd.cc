@@ -2053,6 +2053,103 @@ class Server {
            body;
   }
 
+  // -- flight recorder -------------------------------------------------------
+  // Bounded ring of the last kFlightN requests that reached a verdict
+  // decision (ISSUE 5): the ring ticket (this plane's correlation id,
+  // joins sidecar-side records at trace id "t-<ticket>"), the enqueue
+  // -> apply wait, the raw verdict byte, the decided action, and a
+  // sanitized method/path prefix with an FNV-1a digest over the tuple
+  // fields. Served as JSON at /__pingoo/flightrecorder (h1 + h2) and
+  // dumped to stderr when the SIGTERM drain starts — the native-plane
+  // counterpart of pingoo_tpu/obs/flightrecorder.py.
+
+  struct FlightEntry {
+    uint64_t ticket = UINT64_MAX;  // UINT64_MAX = no ring ticket
+    uint64_t wait_ms = 0;          // enqueue -> verdict apply (0 = n/a)
+    uint64_t ts_ms = 0;            // CLOCK_MONOTONIC ms at record time
+    uint32_t digest = 0;           // FNV-1a over method|host|path|ua
+    uint8_t verdict = 0;           // raw verdict byte from the ring
+    uint8_t decided = 0;           // 0 proxy 1 block 2 captcha 3 fail-open
+    char method[8] = {0};
+    char path[48] = {0};           // sanitized prefix, for humans
+  };
+  static constexpr size_t kFlightN = 256;
+  FlightEntry flight_[kFlightN];
+  uint64_t flight_next_ = 0;
+
+  static uint32_t fnv1a(uint32_t h, const std::string& s) {
+    for (unsigned char ch : s) {
+      h ^= ch;
+      h *= 16777619u;
+    }
+    return h;
+  }
+
+  void flight_record(const Parsed& req, uint64_t ticket, uint64_t enq_ms,
+                     uint8_t verdict, uint8_t decided) {
+    FlightEntry& e = flight_[flight_next_++ % kFlightN];
+    uint64_t now = now_ms();
+    e.ticket = ticket;
+    e.wait_ms = enq_ms ? now - enq_ms : 0;
+    e.ts_ms = now;
+    uint32_t h = 2166136261u;
+    h = fnv1a(h, req.method);
+    h = fnv1a(h, req.host);
+    h = fnv1a(h, req.path);
+    h = fnv1a(h, req.user_agent);
+    e.digest = h;
+    std::snprintf(e.method, sizeof(e.method), "%s", req.method.c_str());
+    // The stored path is display-only: JSON-hostile bytes (quotes,
+    // backslash, controls, non-ASCII) become '_' at record time so the
+    // dump below can emit it verbatim.
+    size_t n = 0;
+    for (char ch : req.path) {
+      if (n + 1 >= sizeof(e.path)) break;
+      e.path[n++] =
+          (ch >= 0x20 && ch < 0x7f && ch != '"' && ch != '\\') ? ch : '_';
+    }
+    e.path[n] = 0;
+    e.verdict = verdict;
+    e.decided = decided;
+  }
+
+  std::string flightrecorder_json() {
+    uint64_t total = flight_next_;
+    size_t live = total < kFlightN ? static_cast<size_t>(total) : kFlightN;
+    uint64_t start = total - live;
+    std::string out = "{\"plane\": \"native\", \"capacity\": " +
+                      std::to_string(kFlightN) +
+                      ", \"recorded_total\": " + std::to_string(total) +
+                      ", \"entries\": [";
+    for (size_t i = 0; i < live; ++i) {
+      const FlightEntry& e = flight_[(start + i) % kFlightN];
+      if (i) out += ", ";
+      out += "{\"ticket\": ";
+      out += e.ticket == UINT64_MAX ? std::string("null")
+                                    : std::to_string(e.ticket);
+      char digest_hex[16];
+      std::snprintf(digest_hex, sizeof(digest_hex), "%08x", e.digest);
+      out += ", \"digest\": \"";
+      out += digest_hex;
+      out += "\", \"wait_ms\": " + std::to_string(e.wait_ms) +
+             ", \"ts_ms\": " + std::to_string(e.ts_ms) +
+             ", \"verdict\": " + std::to_string(e.verdict) +
+             ", \"decided\": " + std::to_string(e.decided) +
+             ", \"method\": \"" + e.method + "\", \"path\": \"" + e.path +
+             "\"}";
+    }
+    out += "]}";
+    return out;
+  }
+
+  std::string flightrecorder_response() {
+    std::string body = flightrecorder_json();
+    return "HTTP/1.1 200 OK\r\nserver: pingoo\r\ncontent-type: "
+           "application/json\r\ncontent-length: " +
+           std::to_string(body.size()) + "\r\nconnection: close\r\n\r\n" +
+           body;
+  }
+
   // -- graceful drain --------------------------------------------------------
   // SIGTERM stops accepting and drains in-flight requests with a hard
   // cap (reference drains with a 20 s limit, listeners/mod.rs:28 +
@@ -2858,11 +2955,11 @@ class Server {
         auto sit = c->h2_streams.find(sid);
         if (sit == c->h2_streams.end()) continue;  // stream reset meanwhile
         sit->second.ticket = UINT64_MAX;
-        apply_h2_verdict(c, sid, action);
+        apply_h2_verdict(c, sid, action, ticket);
         h2_flush(c);
       } else {
         c->ticket = UINT64_MAX;
-        apply_verdict(c, action);
+        apply_verdict(c, action, ticket);
       }
     }
   }
@@ -2872,7 +2969,7 @@ class Server {
   // actions for verified clients but still blocks on Block
   // (http_listener.rs:251-264). Applies to the h1 cycle or the h2
   // connection's active stream.
-  void apply_verdict(Conn* c, uint8_t action) {
+  void apply_verdict(Conn* c, uint8_t action, uint64_t ticket = UINT64_MAX) {
     stats_.verdicts++;
     if (c->enq_ms) record_wait(now_ms() - c->enq_ms);
     uint8_t decided;  // 0 proxy, 1 block, 2 captcha
@@ -2881,6 +2978,7 @@ class Server {
     } else {
       decided = action & 3;
     }
+    flight_record(c->req, ticket, c->enq_ms, action, decided);
     if (decided == 1) {
       stats_.blocked++;
       respond_close(c, k403);
@@ -2892,11 +2990,13 @@ class Server {
     }
   }
 
-  void apply_h2_verdict(Conn* c, int32_t sid, uint8_t action) {
+  void apply_h2_verdict(Conn* c, int32_t sid, uint8_t action,
+                        uint64_t ticket = UINT64_MAX) {
     stats_.verdicts++;
     H2Stream& st = c->h2_streams[sid];
     if (st.enq_ms) record_wait(now_ms() - st.enq_ms);
     uint8_t decided = st.verified ? ((action & 4) ? 1 : 0) : (action & 3);
+    flight_record(st.p, ticket, st.enq_ms, action, decided);
     if (decided == 1) {
       stats_.blocked++;
       h2_respond_simple(c, sid, 403, "Forbidden");
@@ -3027,6 +3127,10 @@ class Server {
       respond_close(c, metrics_response(c->req).c_str());
       return;
     }
+    if (c->req.path == "/__pingoo/flightrecorder") {
+      respond_close(c, flightrecorder_response().c_str());
+      return;
+    }
     Policy outcome = run_policy(c);
     switch (outcome) {
       case Policy::kBlock:
@@ -3045,6 +3149,7 @@ class Server {
         return;
       case Policy::kFailOpenProxy:
         stats_.fail_open++;
+        flight_record(c->req, UINT64_MAX, 0, 0, 3);  // 3 = fail-open
         fail_open_proxy(c);
         return;
       case Policy::kAwaitVerdict:
@@ -3273,6 +3378,11 @@ class Server {
         h2_submit(c, sid, 200, {{"content-type", ctype}}, std::move(body));
         continue;
       }
+      if (it->second.p.path == "/__pingoo/flightrecorder") {
+        h2_submit(c, sid, 200, {{"content-type", "application/json"}},
+                  flightrecorder_json());
+        continue;
+      }
       Policy outcome = run_policy(c, sid);
       switch (outcome) {
         case Policy::kBlock:
@@ -3291,6 +3401,7 @@ class Server {
           break;
         case Policy::kFailOpenProxy:
           stats_.fail_open++;
+          flight_record(it->second.p, UINT64_MAX, 0, 0, 3);  // fail-open
           h2_stream_fail_open(c, sid);
           break;
         case Policy::kAwaitVerdict:
@@ -5102,6 +5213,11 @@ int main(int argc, char** argv) {
       lfd = -1;
       std::printf("{\"draining\": true}\n");
       std::fflush(stdout);
+      // SIGTERM drain auto-dump (ISSUE 5): the flight recorder lives
+      // only in memory; stderr keeps the stdout protocol lines
+      // ("draining"/"drained") parseable for the harness scripts.
+      std::fprintf(stderr, "%s\n", server.flightrecorder_json().c_str());
+      std::fflush(stderr);
     }
 
     for (int i = 0; i < n; ++i) {

@@ -492,8 +492,15 @@ class RingSidecar:
                     f"got {len(g)}")
         self._ring_group_of = {id(r): gi for r, gi in
                                zip(self.rings, self._ring_group)}
+        # Verdict provenance (ISSUE 5): the per-rule attribution fold
+        # rides the lane dispatch as an aux output (with_rule_hits) —
+        # the match matrix itself still never leaves the device.
+        from .obs.provenance import provenance_enabled
+
+        self._provenance_on = provenance_enabled()
         self._lane_fn = make_lane_fn(
-            plan, service_groups=self._groups or None)
+            plan, service_groups=self._groups or None,
+            with_rule_hits=self._provenance_on)
         # Services whose route predicate fell back to host interpretation
         # are merged into the device route lane per batch (per group).
         self._host_routes: list[list[tuple[int, object]]] = []
@@ -536,7 +543,7 @@ class RingSidecar:
                 "verdict pipeline stage latency (ms)",
                 labels={"plane": "sidecar", "stage": stage})
             for stage in ("encode", "prefilter", "device_dispatch",
-                          "device_compute", "resolve")}
+                          "device_compute", "resolve", "provenance")}
         # Stage-A literal prefilter (docs/PREFILTER.md): the sidecar is
         # the native plane's verdict engine, so it exports the same
         # candidate-rate/skip metrics the Python listener plane does.
@@ -545,9 +552,16 @@ class RingSidecar:
 
         self._pf_fn = None
         self._pf_gated_banks = 0
+        self._pf_attr = None
         pf = make_prefilter_fn(plan)
         if pf is not None:
-            self._pf_fn, self._pf_gated_banks = pf
+            self._pf_fn = pf.fn
+            self._pf_gated_banks = len(pf.gated)
+            if self._provenance_on:
+                from .obs.provenance import PrefilterAttribution
+
+                self._pf_attr = PrefilterAttribution(
+                    pf.masked, plane="sidecar")
         self._pf_rate_gauge = REGISTRY.gauge(
             "pingoo_prefilter_candidate_rate",
             PREFILTER_METRICS["pingoo_prefilter_candidate_rate"],
@@ -556,6 +570,24 @@ class RingSidecar:
             "pingoo_scan_banks_skipped_total",
             PREFILTER_METRICS["pingoo_scan_banks_skipped_total"],
             labels={"plane": "sidecar"})
+        # Attribution lanes + flight recorder + shadow-parity auditor
+        # for the native plane's verdict engine (this drain loop).
+        self._attribution = None
+        self.flight_recorder = None
+        self.parity = None
+        self._dev_cols = np.asarray(plan.device_rule_indices,
+                                    dtype=np.int64)
+        if self._provenance_on:
+            from .obs.flightrecorder import (FlightRecorder,
+                                             register_recorder)
+            from .obs.provenance import ParityAuditor, RuleAttribution
+
+            self.flight_recorder = register_recorder(FlightRecorder(
+                "sidecar", rule_names=plan.rule_names))
+            self._attribution = RuleAttribution(plan.rule_names,
+                                                plane="sidecar")
+            self.parity = ParityAuditor(plan, lists, plane="sidecar",
+                                        recorder=self.flight_recorder)
         self._collector_live = True
         REGISTRY.register_collector(self._export_ring_telemetry)
 
@@ -628,13 +660,22 @@ class RingSidecar:
                     pf_hits, pf_aux = self._pf_fn(
                         self._tables, batch.arrays)  # async
                 tpf = time.monotonic()
-                dev = self._lane_fn(self._tables, batch.arrays,
-                                    pf_hits)  # async
+                rule_hits = None
+                if self._provenance_on:
+                    # Attribution aux lane rides the SAME dispatch; the
+                    # traced n masks batch-padding rows on device.
+                    dev, rule_hits = self._lane_fn(
+                        self._tables, batch.arrays, pf_hits,
+                        np.int32(n))  # async
+                else:
+                    dev = self._lane_fn(self._tables, batch.arrays,
+                                        pf_hits)  # async
                 t2 = time.monotonic()
                 self._stage["encode"].observe((t1 - t0) * 1e3)
                 self._stage["prefilter"].observe((tpf - t1) * 1e3)
                 self._stage["device_dispatch"].observe((t2 - tpf) * 1e3)
-                inflight.append((parts, slots, raw, dev, pf_aux, n))
+                inflight.append((parts, slots, raw, dev, rule_hits,
+                                 pf_aux, n))
             if inflight and (len(inflight) >= self.pipeline_depth or n == 0):
                 self._complete(*inflight.popleft())
             if n == 0 and not inflight:
@@ -672,7 +713,7 @@ class RingSidecar:
             if len(cc) == 2:
                 slots["country"][i] = cc
 
-    def _complete(self, parts, slots, raw_batch, dev, pf_aux,
+    def _complete(self, parts, slots, raw_batch, dev, rule_hits, pf_aux,
                   n: int) -> None:
         from .engine.verdict import host_rule_lanes, merge_lanes
 
@@ -685,12 +726,14 @@ class RingSidecar:
         self.device_wait_s += wait_s
         self._stage["device_compute"].observe(wait_s * 1e3)
         if pf_aux is not None:
-            # Resolved long before the lane sync above; two int32 lanes.
+            # Resolved long before the lane sync above; aux int32 lanes.
             vals = np.asarray(pf_aux)
             denom = self.max_batch * self._pf_gated_banks
             if denom:
                 self._pf_rate_gauge.set(int(vals[0]) / denom)
             self._pf_skip_counter.inc(int(vals[1]))
+            if self._pf_attr is not None:
+                self._pf_attr.observe(vals, self.max_batch)
         t_resolve = time.monotonic()
         self.batches += 1
         unverified, verified_block = merge_lanes(dev_lanes, host)
@@ -797,7 +840,78 @@ class RingSidecar:
             off += m
         self._stage["resolve"].observe(
             (time.monotonic() - t_resolve) * 1e3)
+        t_prov = time.monotonic()
+        if self._attribution is not None:
+            self._observe_provenance(slots, rule_hits, dev_lanes, host,
+                                     raw_batch, unverified,
+                                     verified_block, wait_s, n)
+        self._stage["provenance"].observe(
+            (time.monotonic() - t_prov) * 1e3)
         self.processed += n
+
+    def _observe_provenance(self, slots, rule_hits, dev_lanes, host,
+                            raw_batch, unverified, verified_block,
+                            device_wait_s, n: int) -> None:
+        """Sidecar-plane provenance (ISSUE 5): fold the on-device
+        attribution aux lane, flight-record the batch, and hand the
+        FINAL served lanes (spill rewrites included) to the parity
+        sampler. Registered hot in the analyze-lint registries — the
+        aux lane resolved with the batch's lane sync, so nothing here
+        may wait on the device. Lane-plane attribution covers the
+        DEVICE-resident rules (the match matrix never leaves the chip);
+        host-fallback rules are attributed on the Python plane, where
+        the full matrix exists."""
+        import zlib as _zlib
+
+        from .engine.verdict import LANE_NONE
+
+        if rule_hits is not None and len(self._dev_cols):
+            self._attribution.fold_batch(rule_hits,
+                                         indices=self._dev_cols)
+        trace_ids = [f"t-{int(t)}" for t in slots["ticket"]]
+        recorder = self.flight_recorder
+        # Merged first-acting rule index per row (device lanes already
+        # host-resident; host lanes are numpy) for the record's
+        # matched-rule attribution — the lanes carry no full bitmap.
+        act_idx = np.minimum(dev_lanes[0], host[0])
+        now_ms = int(self.ring.lib.pingoo_ring_now_ms())
+        enq_ms = slots["enq_ms"]
+        compute_ms = round(device_wait_s * 1e3, 3)
+        start = max(0, n - recorder.capacity)
+        for i in range(start, n):
+            crc = _zlib.crc32(slots["method"][i].tobytes())
+            for f in ("host", "path", "url", "user_agent", "ip"):
+                crc = _zlib.crc32(slots[f][i].tobytes(), crc)
+            first = int(act_idx[i])
+            recorder.record(
+                trace_id=trace_ids[i],
+                digest=f"{crc & 0xFFFFFFFF:08x}",
+                stages={
+                    "enqueue_to_post_ms": max(
+                        0, now_ms - int(enq_ms[i])),
+                    "device_compute_ms": compute_ms,
+                },
+                matched_rules=(first,) if first < LANE_NONE else (),
+                action=int(unverified[i]),
+                ticket=int(slots["ticket"][i]))
+        if self.parity is not None:
+            # Truncated/spilled rows were served from a different string
+            # view than the slot arrays — excluded from the audit.
+            skip = ((slots["flags"] & SLOT_FLAG_TRUNCATED) != 0) \
+                | (slots["spill_idx"] != SPILL_NONE)
+
+            def contexts_builder(raw=raw_batch, lists=self.lists):
+                from .engine.batch import batch_to_contexts
+
+                contexts = batch_to_contexts(raw, lists)
+                paths = [c.variables["http_request"]["path"]
+                         for c in contexts]
+                return contexts, paths
+
+            self.parity.submit_lanes(
+                contexts_builder, unverified[:n].copy(),
+                verified_block[:n].copy(), skip_mask=skip,
+                trace_ids=trace_ids)
 
     def _interpret_overflow_row(self, slot, url: bytes, path: bytes,
                                 services=None) -> tuple[int, bool, int]:
@@ -920,6 +1034,10 @@ class RingSidecar:
         # telemetry snapshot FFI call.
         self._collector_live = False
         self._registry.unregister_collector(self._export_ring_telemetry)
+        if self.parity is not None:
+            self.parity.stop()
+        if self._attribution is not None:
+            self._attribution.close()
         self._stop = True
         t = self._thread
         if t is not None and t.is_alive()                 and t is not _threading.current_thread():

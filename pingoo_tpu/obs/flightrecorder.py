@@ -1,0 +1,223 @@
+"""Cross-plane flight recorder: a bounded in-memory ring of the last N
+requests' provenance (ISSUE 5, docs/OBSERVABILITY.md).
+
+Each record carries the request's trace id, a stable request-tuple
+digest (crc32 over the verdict-relevant fields — cheap enough to
+compute per request on the hot path), the per-stage timing picture from
+enqueue through prefilter/scan to post, the matched-rule ids, and the
+shadow-parity status. The ring is fixed-size (PINGOO_FLIGHT_RECORDER_N,
+default 256) and append-only; wrap-around overwrites the oldest entry,
+so memory is bounded no matter the request rate.
+
+Surfaces:
+  * `GET /__pingoo/flightrecorder` on the Python listener dumps every
+    recorder registered in this process (the listener plane's and, when
+    the ring sidecar is co-resident, the sidecar plane's). The native
+    C++ httpd serves its own recorder at the same path.
+  * SIGTERM drain auto-dumps via `dump_on_drain` (host/server.py) — to
+    PINGOO_FLIGHT_DUMP_DIR as a JSON file when set, and always as one
+    structured log line — so the last seconds before a shutdown are
+    never lost.
+
+Thread-safety: records come from the collector event loop, the sidecar
+drain thread, and parity-audit worker threads; a plain lock guards the
+ring (O(1) hold time per record).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+from typing import Optional
+
+from ..logging_utils import get_logger
+
+DEFAULT_CAPACITY = 256
+
+# Parity status values a record can carry.
+PARITY_UNCHECKED = "unchecked"
+PARITY_OK = "ok"
+PARITY_MISMATCH = "mismatch"
+
+
+def recorder_capacity() -> int:
+    try:
+        n = int(os.environ.get("PINGOO_FLIGHT_RECORDER_N",
+                               str(DEFAULT_CAPACITY)))
+    except ValueError:
+        return DEFAULT_CAPACITY
+    return max(8, min(n, 65536))
+
+
+def tuple_digest(method: str, host: str, path: str, url: str,
+                 user_agent: str, ip: str) -> str:
+    """Stable 8-hex digest of the verdict-relevant request fields.
+    crc32, not a cryptographic hash: this is a correlation key for
+    joining recorder entries against logs/replays, computed once per
+    request on the hot path."""
+    raw = "\x00".join((method, host, path, url, user_agent, ip))
+    return f"{zlib.crc32(raw.encode('latin-1', 'replace')) & 0xFFFFFFFF:08x}"
+
+
+class FlightRecorder:
+    """Bounded ring of per-request provenance records."""
+
+    def __init__(self, plane: str, capacity: Optional[int] = None,
+                 rule_names: Optional[tuple] = None, registry=None):
+        self.plane = plane
+        self.capacity = capacity or recorder_capacity()
+        self.rule_names = tuple(rule_names or ())
+        self._ring: list = [None] * self.capacity
+        self._next = 0  # monotonically increasing record sequence
+        self._lock = threading.Lock()
+        if registry is None:
+            from . import REGISTRY as registry  # noqa: N813
+        from . import schema
+
+        self._records_total = registry.counter(
+            "pingoo_flightrecorder_records_total",
+            schema.PROVENANCE_METRICS[
+                "pingoo_flightrecorder_records_total"],
+            labels={"plane": plane})
+
+    # -- hot path ------------------------------------------------------------
+
+    def record(self, *, trace_id: str, digest: str, stages: dict,
+               matched_rules, action: int,
+               parity: str = PARITY_UNCHECKED,
+               ticket: Optional[int] = None) -> None:
+        """Append one request's provenance. `stages` is shared per batch
+        (the caller builds ONE dict and passes it for every row), so the
+        per-record cost is a tuple + one ring store under the lock."""
+        entry = [trace_id, digest, stages, matched_rules, action, parity,
+                 ticket, time.time(), None]  # [-1]: parity detail
+        with self._lock:
+            self._ring[self._next % self.capacity] = entry
+            self._next += 1
+        self._records_total.inc()
+
+    # -- audit / introspection -----------------------------------------------
+
+    def mark_parity(self, trace_id: str, status: str,
+                    detail: Optional[dict] = None) -> bool:
+        """Attach a parity verdict to the entry with `trace_id` (audit
+        worker path — a linear scan over <= capacity entries)."""
+        with self._lock:
+            for entry in self._ring:
+                if entry is not None and entry[0] == trace_id:
+                    entry[5] = status
+                    if detail is not None:
+                        entry[8] = detail
+                    return True
+        return False
+
+    def __len__(self) -> int:
+        with self._lock:
+            return min(self._next, self.capacity)
+
+    @property
+    def recorded_total(self) -> int:
+        return self._next
+
+    def snapshot(self) -> list[dict]:
+        """Oldest -> newest view of the live ring as JSON-able dicts."""
+        with self._lock:
+            n = min(self._next, self.capacity)
+            start = self._next - n
+            entries = [self._ring[(start + i) % self.capacity]
+                       for i in range(n)]
+        out = []
+        for e in entries:
+            if e is None:
+                continue
+            rules = e[3]
+            rec = {
+                "trace_id": e[0],
+                "digest": e[1],
+                "stages_ms": e[2],
+                "matched_rules": [int(r) for r in rules]
+                if rules is not None else [],
+                "action": int(e[4]),
+                "parity": e[5],
+                "ts": round(e[7], 3),
+            }
+            if self.rule_names and rules is not None:
+                rec["matched_rule_names"] = [
+                    self.rule_names[int(r)] for r in rules
+                    if 0 <= int(r) < len(self.rule_names)]
+            if e[6] is not None:
+                rec["ticket"] = int(e[6])
+            if e[8] is not None:
+                rec["parity_detail"] = e[8]
+            out.append(rec)
+        return out
+
+    def dump(self) -> dict:
+        return {
+            "plane": self.plane,
+            "capacity": self.capacity,
+            "recorded_total": self.recorded_total,
+            "entries": self.snapshot(),
+        }
+
+
+# -- process-global recorder registry ----------------------------------------
+# The Python listener's /__pingoo/flightrecorder endpoint dumps every
+# recorder in the process: the listener plane's own, and the sidecar
+# plane's when a RingSidecar is co-resident (host/native_plane.py runs
+# both in one control-plane process).
+
+_RECORDERS: dict[str, FlightRecorder] = {}
+_REG_LOCK = threading.Lock()
+
+
+def register_recorder(recorder: FlightRecorder) -> FlightRecorder:
+    with _REG_LOCK:
+        _RECORDERS[recorder.plane] = recorder
+    return recorder
+
+
+def unregister_recorder(recorder: FlightRecorder) -> None:
+    with _REG_LOCK:
+        if _RECORDERS.get(recorder.plane) is recorder:
+            del _RECORDERS[recorder.plane]
+
+
+def registered_recorders() -> list[FlightRecorder]:
+    with _REG_LOCK:
+        return list(_RECORDERS.values())
+
+
+def dump_all() -> dict:
+    return {"planes": {r.plane: r.dump() for r in registered_recorders()}}
+
+
+def dump_on_drain(reason: str = "sigterm") -> Optional[str]:
+    """SIGTERM-drain auto-dump: write the full dump to
+    PINGOO_FLIGHT_DUMP_DIR (one timestamped file) when configured, and
+    always emit a structured summary log line. Returns the file path
+    written, or None. Never raises — this runs on the shutdown path."""
+    log = get_logger("pingoo_tpu.flightrecorder")
+    payload = dump_all()
+    counts = {plane: len(d["entries"])
+              for plane, d in payload["planes"].items()}
+    path = None
+    out_dir = os.environ.get("PINGOO_FLIGHT_DUMP_DIR")
+    if out_dir:
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            path = os.path.join(
+                out_dir, f"flightrecorder-{int(time.time())}.json")
+            with open(path, "w") as f:
+                json.dump({"reason": reason, **payload}, f)
+        except OSError:
+            path = None
+    try:
+        log.info("flight recorder drain dump", extra={"fields": {
+            "reason": reason, "entries": counts, "dump_path": path}})
+    except Exception:
+        pass
+    return path

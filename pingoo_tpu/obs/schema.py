@@ -42,6 +42,7 @@ VERDICT_STAGES = (
     "device_dispatch", # jitted call issue (async) incl. host->device
     "device_compute",  # block_until_ready on the device result
     "resolve",         # lanes/actions + future resolution
+    "provenance",      # attribution fold + flight record + parity submit
 )
 
 # Literal-prefilter cascade metrics (docs/PREFILTER.md): exported by
@@ -57,6 +58,43 @@ PREFILTER_METRICS = {
     "pingoo_scan_banks_skipped_total":
         "NFA bank scans skipped because no request in the batch held "
         "any of the bank's necessary literal factors",
+}
+
+# Verdict-provenance metrics (ISSUE 5, docs/OBSERVABILITY.md
+# Provenance/Parity sections): exported by every plane that runs the
+# batched verdict engine (plane="python" listener service,
+# plane="sidecar" ring drainer). Per-rule families carry a `rule` label
+# bounded to the top-K hitters (PINGOO_ATTR_TOP_K) plus one "_overflow"
+# series so a 500-rule plan cannot blow up Prometheus cardinality;
+# per-bank families carry a `bank` label (one per gated scan bank — at
+# most a handful per ruleset by construction).
+PROVENANCE_METRICS = {
+    "pingoo_rule_hits_total":
+        "requests matching each rule (top-K labelled series + the "
+        "\"_overflow\" remainder bucket)",
+    "pingoo_prefilter_bank_candidate_rate":
+        "fraction of the last batch's rows Stage A left as candidates "
+        "for this gated scan bank",
+    "pingoo_scan_bank_skipped_total":
+        "batches in which this gated scan bank was skipped entirely",
+    "pingoo_flightrecorder_records_total":
+        "requests written into the in-memory flight-recorder ring",
+}
+
+# Shadow-parity auditor metrics (ISSUE 5): the always-on sampler that
+# re-evaluates PINGOO_PARITY_SAMPLE of live batches through the host
+# expression interpreter off the hot path and diffs the verdicts.
+PARITY_METRICS = {
+    "pingoo_parity_checked_total":
+        "requests re-evaluated by the shadow-parity auditor",
+    "pingoo_parity_mismatch_total":
+        "audited requests whose device verdict diverged from the host "
+        "interpreter",
+    "pingoo_parity_rule_mismatch_total":
+        "per-rule breakdown of parity divergences (bounded rule label "
+        "+ \"_overflow\")",
+    "pingoo_parity_dropped_total":
+        "sampled batches dropped because the audit queue was full",
 }
 
 # Ring telemetry block metrics (source: the shm header's atomic
@@ -106,5 +144,6 @@ NATIVE_JSON_KEYS = {
 
 def all_metric_names() -> set[str]:
     return (set(SHARED_METRICS) | set(RING_METRICS) | set(NATIVE_METRICS)
-            | set(PREFILTER_METRICS)
+            | set(PREFILTER_METRICS) | set(PROVENANCE_METRICS)
+            | set(PARITY_METRICS)
             | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

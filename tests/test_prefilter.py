@@ -286,9 +286,10 @@ class TestModeParity:
         rules, lists, plan, batch = crs_plan
         tables = plan.device_tables()
         monkeypatch.setenv("PINGOO_PREFILTER", "banks")
-        pf_fn, n_gated = make_prefilter_fn(plan)
+        pf = make_prefilter_fn(plan)
+        n_gated = len(pf.gated)
         assert n_gated >= 1
-        hits, aux = pf_fn(tables, batch.arrays)
+        hits, aux = pf.fn(tables, batch.arrays)
         aux = np.asarray(aux)
         assert 0 <= int(aux[1]) <= n_gated
         fn = make_verdict_fn(plan)

@@ -12,6 +12,10 @@ LINT_DIRS = (
     "pingoo_tpu/engine",
     "pingoo_tpu/ops",
     "pingoo_tpu/compiler",
+    # The provenance layer (ISSUE 5) folds device aux lanes per batch;
+    # its hot functions are registered below so a bare host-device sync
+    # there fails `make analyze`.
+    "pingoo_tpu/obs",
 )
 
 # Never descend into these directory names, and never read non-.py
@@ -33,6 +37,17 @@ HOT_FUNCTIONS = frozenset({
     "pingoo_tpu/engine/service.py::VerdictService._observe_prefilter",
     "pingoo_tpu/engine/verdict.py::finish_batch",
     "pingoo_tpu/engine/verdict.py::merge_lanes",
+    # Verdict provenance (ISSUE 5): the attribution fold runs per batch
+    # on the collector/drain path (the one sanctioned materialization of
+    # the device aux lane is suppressed inline), and the parity
+    # sampler's submit side must stay a pure sampling-decision +
+    # queue-put — the interpreter re-evaluation belongs on the audit
+    # worker thread, never the dispatch hot path.
+    "pingoo_tpu/engine/service.py::VerdictService._observe_provenance",
+    "pingoo_tpu/obs/provenance.py::RuleAttribution.fold_batch",
+    "pingoo_tpu/obs/provenance.py::ParityAuditor.submit_matrix",
+    "pingoo_tpu/obs/provenance.py::ParityAuditor.submit_lanes",
+    "pingoo_tpu/obs/flightrecorder.py::FlightRecorder.record",
 })
 
 # Functions traced by jax.jit that the AST cannot see are jitted (they

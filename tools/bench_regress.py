@@ -1,0 +1,144 @@
+#!/usr/bin/env python
+"""Bench trajectory regression gate (make bench-regress; ISSUE 5
+satellite).
+
+`bench.py --history` appends every emitted result line to
+BENCH_history.jsonl (one JSON object per run, wall-clock stamped).
+This tool compares the LATEST run against the most recent previous run
+with the SAME backend label (a cpu-diagnostic floor is never comparable
+to a device number) under a configurable relative threshold:
+
+    python tools/bench_regress.py [--threshold 0.10] [--file PATH]
+
+Exit codes: 0 = no regression (or nothing comparable yet), 1 = at
+least one tracked metric regressed past the threshold, 2 = usage/IO
+error. Tracked metrics and their directions:
+
+    value                higher is better (headline req/s/chip)
+    p_batch_ms           lower  is better (the <2 ms budget)
+    e2e_req_per_s        higher is better
+    dataplane_req_per_s  higher is better
+    blocklist_lookups_per_s  higher is better
+
+Metrics missing from either run are skipped (partial/error lines are
+trajectory too, but only shared keys gate).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# (key, higher_is_better)
+TRACKED = (
+    ("value", True),
+    ("p_batch_ms", False),
+    ("e2e_req_per_s", True),
+    ("dataplane_req_per_s", True),
+    ("blocklist_lookups_per_s", True),
+)
+
+DEFAULT_THRESHOLD = 0.10
+
+
+def load_history(path: str) -> list[dict]:
+    entries = []
+    with open(path) as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entries.append(json.loads(line))
+            except json.JSONDecodeError:
+                print(f"bench-regress: warning: {path}:{i}: "
+                      f"unparseable line skipped", file=sys.stderr)
+    return entries
+
+
+def pick_baseline(entries: list[dict]) -> tuple[dict, dict | None]:
+    """(latest, baseline) where baseline is the most recent PRIOR entry
+    with the same backend label; None when no comparable prior run."""
+    latest = entries[-1]
+    backend = latest.get("backend")
+    for prev in reversed(entries[:-1]):
+        if prev.get("backend") == backend:
+            return latest, prev
+    return latest, None
+
+
+def compare(latest: dict, baseline: dict,
+            threshold: float) -> tuple[list[str], list[str]]:
+    """-> (regressions, report lines)."""
+    regressions: list[str] = []
+    report: list[str] = []
+    for key, higher_better in TRACKED:
+        a, b = baseline.get(key), latest.get(key)
+        if not isinstance(a, (int, float)) or not isinstance(
+                b, (int, float)) or a <= 0:
+            continue
+        ratio = b / a
+        delta_pct = (ratio - 1.0) * 100.0
+        worse = ratio < (1.0 - threshold) if higher_better \
+            else ratio > (1.0 + threshold)
+        marker = "REGRESSION" if worse else "ok"
+        report.append(
+            f"  {marker:>10}  {key}: {a} -> {b} ({delta_pct:+.1f}%, "
+            f"{'higher' if higher_better else 'lower'} is better)")
+        if worse:
+            regressions.append(key)
+    return regressions, report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--threshold", type=float, default=float(
+        os.environ.get("BENCH_REGRESS_THRESHOLD", DEFAULT_THRESHOLD)),
+        help="relative regression threshold (default 0.10 = 10%%)")
+    ap.add_argument("--file", default=os.environ.get(
+        "BENCH_HISTORY_FILE", "BENCH_history.jsonl"))
+    args = ap.parse_args(argv)
+    if args.threshold <= 0 or args.threshold >= 1:
+        print("bench-regress: threshold must be in (0, 1)",
+              file=sys.stderr)
+        return 2
+    if not os.path.exists(args.file):
+        print(f"bench-regress: no history at {args.file} "
+              f"(run `python bench.py --history` first); nothing to "
+              f"compare")
+        return 0
+    try:
+        entries = load_history(args.file)
+    except OSError as exc:
+        print(f"bench-regress: cannot read {args.file}: {exc}",
+              file=sys.stderr)
+        return 2
+    if len(entries) < 2:
+        print(f"bench-regress: {len(entries)} run(s) in {args.file}; "
+              f"need 2 comparable runs")
+        return 0
+    latest, baseline = pick_baseline(entries)
+    if baseline is None:
+        print(f"bench-regress: no prior run with backend="
+              f"{latest.get('backend')!r}; nothing comparable")
+        return 0
+    regressions, report = compare(latest, baseline, args.threshold)
+    print(f"bench-regress: latest ts={latest.get('ts')} vs baseline "
+          f"ts={baseline.get('ts')} (backend={latest.get('backend')!r}, "
+          f"threshold {args.threshold:.0%})")
+    for line in report:
+        print(line)
+    if not report:
+        print("  (no shared tracked metrics between the two runs)")
+    if regressions:
+        print(f"bench-regress: FAIL — {len(regressions)} metric(s) "
+              f"regressed: {', '.join(regressions)}", file=sys.stderr)
+        return 1
+    print("bench-regress: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

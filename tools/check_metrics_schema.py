@@ -83,6 +83,33 @@ def main() -> int:
         if name not in sidecar_src:
             problems.append(f"native_ring.py: missing metric {name}")
 
+    # Verdict provenance (ISSUE 5): the metric-name literals live in
+    # obs/provenance.py + obs/flightrecorder.py (shared by both engine
+    # planes), so check those sources for the names and both plane
+    # sources for the wiring symbols.
+    prov_src = (_read("pingoo_tpu/obs/provenance.py")
+                + _read("pingoo_tpu/obs/flightrecorder.py"))
+    for name in {**schema.PROVENANCE_METRICS, **schema.PARITY_METRICS}:
+        if name not in prov_src:
+            problems.append(f"obs provenance layer: missing metric {name}")
+    for symbol in ("RuleAttribution", "ParityAuditor", "FlightRecorder"):
+        if symbol not in service_src:
+            problems.append(f"engine/service.py: provenance wiring "
+                            f"missing {symbol}")
+        if symbol not in sidecar_src:
+            problems.append(f"native_ring.py: provenance wiring "
+                            f"missing {symbol}")
+
+    # Flight-recorder + explain endpoints: the Python listener serves
+    # both; the native plane serves its own flightrecorder dump (the
+    # C++ exposition is string literals, so the source is the schema).
+    for endpoint in ("/__pingoo/flightrecorder", "/__pingoo/explain"):
+        if endpoint not in py_listener:
+            problems.append(f"host/httpd.py: missing endpoint {endpoint}")
+    if "/__pingoo/flightrecorder" not in native_src:
+        problems.append(
+            "native/httpd.cc: missing endpoint /__pingoo/flightrecorder")
+
     docs = _read("docs/OBSERVABILITY.md") if os.path.exists(
         os.path.join(REPO, "docs/OBSERVABILITY.md")) else ""
     if not docs:
@@ -96,11 +123,20 @@ def main() -> int:
     reg = MetricRegistry()
     for name, help_text in {**schema.SHARED_METRICS,
                             **schema.RING_METRICS,
-                            **schema.PREFILTER_METRICS}.items():
+                            **schema.PREFILTER_METRICS,
+                            **schema.PROVENANCE_METRICS,
+                            **schema.PARITY_METRICS}.items():
         if name.endswith("_total"):
             reg.counter(name, help_text, labels={"plane": "audit"}).inc()
         else:
             reg.gauge(name, help_text, labels={"plane": "audit"}).set(1)
+    # The rule/bank-labelled provenance families must lint with their
+    # real label shapes too (a rule name can carry exposition-hostile
+    # characters; the formatter escapes them).
+    reg.counter("pingoo_rule_hits_total", "", labels={
+        "plane": "audit", "rule": 'r"quoted\\rule'}).inc()
+    reg.gauge("pingoo_prefilter_bank_candidate_rate", "", labels={
+        "plane": "audit", "bank": "nfa_url@short"}).set(0.5)
     h = reg.histogram(schema.SHARED_WAIT_HISTOGRAM, "wait",
                       buckets=WAIT_BUCKETS_MS, labels={"plane": "audit"})
     for v in (0.5, 3, 70, 2000):

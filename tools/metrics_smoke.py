@@ -12,6 +12,14 @@ endpoints in BOTH formats, and validates:
   * the native JSON carries the shm ring telemetry block;
   * a normal response carries x-pingoo-trace-id.
 
+ISSUE 5 additions (verdict provenance): the shadow-parity auditor runs
+against the live traffic on BOTH engine planes (PINGOO_PARITY_SAMPLE=1
+below), a fault-injected path proves an oracle divergence is observable
+via the mismatch counters AND the flight-recorder dump, the
+/__pingoo/flightrecorder endpoints answer on both the Python listener
+and the native httpd, and /__pingoo/explain returns per-rule provenance
+that agrees with the interpreter.
+
 Runs on the CPU backend (JAX_PLATFORMS=cpu) in ~a minute; exits 0/1.
 """
 
@@ -27,6 +35,12 @@ import time
 import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Provenance live checks: audit every batch, and let the chaos knob
+# inject an ORACLE-side divergence for this one path (the served
+# verdicts stay correct — that is the point of the auditor).
+os.environ.setdefault("PINGOO_PARITY_SAMPLE", "1")
+FAULT_PATH = "/__parity-fault"
+os.environ.setdefault("PINGOO_PARITY_FAULT_INJECT", FAULT_PATH)
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
@@ -167,14 +181,70 @@ def main() -> int:
                 check(False, "python: /.env blocked")
             except urllib.error.HTTPError as e:
                 check(e.code == 403, "python: /.env blocked 403")
+            # Parity fault path: the ORACLE diverges here, the served
+            # verdict stays correct (404: no service routes it).
+            try:
+                _get(port, FAULT_PATH)
+            except urllib.error.HTTPError:
+                pass
+            # Both engine planes' auditors run off the hot path; drain
+            # them so the counters below are deterministic.
+            check(svc.parity is not None and svc.parity.flush(30),
+                  "python: parity auditor drained")
+            check(sidecar.parity is not None
+                  and sidecar.parity.flush(30),
+                  "sidecar: parity auditor drained")
             text, payload = validate_plane(
                 "python", port, shared, lint_prometheus_text)
             for key in schema.PYTHON_JSON_KEYS:
                 check(key in payload, f"python JSON: legacy key {key}")
             check("stages" in payload.get("verdict", {}),
                   "python JSON: per-stage verdict breakdown")
+            check("provenance" in payload["verdict"]["stages"],
+                  "python JSON: provenance stage instrumented")
             check("pingoo_ring_depth" in text,
                   "python scrape carries shm ring telemetry (sidecar)")
+            # ISSUE 5 acceptance: with PINGOO_PARITY_SAMPLE>0 the
+            # auditor ran against the live traffic and the mismatch
+            # counters exist on BOTH planes under identical names.
+            for plane in ("python", "sidecar"):
+                check(f'pingoo_parity_checked_total{{plane="{plane}"}}'
+                      in text, f"{plane}: parity checked counter")
+                check(f'pingoo_parity_mismatch_total{{plane="{plane}"}}'
+                      in text, f"{plane}: parity mismatch counter")
+            check(svc.parity.checked_total.value > 0,
+                  "python: auditor audited live traffic")
+            check(sidecar.parity.checked_total.value > 0,
+                  "sidecar: auditor audited live traffic")
+            check(svc.parity.mismatch_total.value > 0,
+                  "python: injected divergence observable via metrics")
+            check("pingoo_rule_hits_total" in text,
+                  "scrape carries per-rule attribution series")
+            # Flight recorder: the listener dumps every co-resident
+            # plane; the injected divergence must appear in it with
+            # full provenance.
+            status, _hdrs, body = _get(port, "/__pingoo/flightrecorder")
+            check(status == 200, "python: flightrecorder endpoint 200")
+            fr = json.loads(body)
+            check({"python", "sidecar"} <= set(fr.get("planes", {})),
+                  "flightrecorder dump covers python + sidecar planes")
+            mismatches = [
+                e for e in fr["planes"]["python"]["entries"]
+                if e["parity"] == "mismatch"]
+            check(bool(mismatches),
+                  "injected divergence observable in flightrecorder dump")
+            check(mismatches and "parity_detail" in mismatches[0],
+                  "flightrecorder mismatch carries provenance detail")
+            # Explain endpoint: per-rule provenance for one request.
+            status, _hdrs, body = _get(
+                port, "/__pingoo/explain?path=/.env")
+            check(status == 200, "python: explain endpoint 200")
+            ex = json.loads(body)
+            check(ex.get("action") == 1 and "waf" in ex.get(
+                "matched_rules", []),
+                "explain: device verdict + matched rule names")
+            check(ex.get("parity", {}).get("consistent") is True,
+                  "explain: interpreter agrees with device path")
 
         await asyncio.get_running_loop().run_in_executor(None, drive)
         serve.cancel()
@@ -182,8 +252,10 @@ def main() -> int:
         await svc.stop()
 
     try:
-        # Drive the native plane first so counters are non-zero.
-        for path in ("/ok", "/.env", "/ok2"):
+        # Drive the native plane first so counters are non-zero (the
+        # parity fault path rides along: its oracle-side divergence
+        # lands on the SIDECAR plane's auditor).
+        for path in ("/ok", "/.env", "/ok2", FAULT_PATH):
             try:
                 _get(nport, path)
             except urllib.error.HTTPError:
@@ -198,8 +270,20 @@ def main() -> int:
               "native JSON: ring enqueued counter moved")
         check(text.rstrip().endswith(tuple("0123456789")),
               "native prometheus body complete (no truncation)")
+        # Native-plane flight recorder: its own C++ ring at the same
+        # endpoint path both Python planes use.
+        status, _hdrs, body = _get(nport, "/__pingoo/flightrecorder")
+        check(status == 200, "native: flightrecorder endpoint 200")
+        nfr = json.loads(body)
+        check(nfr.get("plane") == "native" and nfr.get("entries"),
+              "native: flightrecorder carries verdict records")
+        check(any(e.get("decided") == 1 for e in nfr.get("entries", [])),
+              "native: flightrecorder recorded the /.env block")
 
         asyncio.run(python_plane())
+        check(sidecar.parity is not None
+              and sidecar.parity.mismatch_total.value > 0,
+              "sidecar: injected divergence observable via metrics")
     finally:
         httpd.terminate()
         sidecar.stop()
