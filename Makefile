@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: all native test check bench bench-regress audit asan \
-	metrics-smoke clean \
+	metrics-smoke mesh-smoke clean \
 	analyze analyze-abi analyze-lint analyze-tidy analyze-tsan
 
 all: native
@@ -18,6 +18,7 @@ check:
 	$(PY) -m compileall -q pingoo_tpu
 	$(PY) -c "import pingoo_tpu.config, pingoo_tpu.compiler, pingoo_tpu.engine"
 	$(MAKE) analyze
+	$(MAKE) mesh-smoke
 
 # Static analysis suite (docs/STATIC_ANALYSIS.md) — offline-safe; each
 # pass skips with a warning when its toolchain is missing, and each is
@@ -71,6 +72,13 @@ audit:
 		ldd pingoo_tpu/native/httpd | grep -E 'ssl|crypto|nghttp2'; fi
 	@echo "-- metrics schema parity --"
 	$(PY) tools/check_metrics_schema.py
+
+# Mesh-serving smoke (ISSUE 6, docs/SCHEDULER.md): serve live requests
+# through PINGOO_MESH=2x2x2 on 8 fake host devices, prove verdict
+# bit-identity vs single-device + scheduler/deadline metrics export.
+# Offline-safe: skips with a warning when jax is unavailable.
+mesh-smoke:
+	$(PY) tools/mesh_smoke.py
 
 # Live observability smoke: boot the native plane + ring sidecar + a
 # Python listener, scrape both /__pingoo/metrics endpoints in both

@@ -310,6 +310,136 @@ def bench_prefilter_modes(plan, tables, arrays, verdict_body,
     return out
 
 
+def _mesh_arg() -> str | None:
+    """`--mesh dpxtpxsp` (or BENCH_MESH) selects the serving-mesh shape
+    the scheduler bench runs under; None disables the bench unless
+    BENCH_SCHED=1 asks for the 1x1x1 scheduler A/B alone."""
+    if "--mesh" in sys.argv:
+        i = sys.argv.index("--mesh")
+        if i + 1 < len(sys.argv):
+            return sys.argv[i + 1]
+    return os.environ.get("BENCH_MESH") or None
+
+
+def bench_sched(mesh_spec: str) -> dict:
+    """ISSUE 6 satellite: measure the admission SCHEDULER modes
+    (fixed-window vs continuous, docs/SCHEDULER.md) and the serving
+    mesh by driving a bursty request stream through a live
+    VerdictService. Runs in a SUBPROCESS so the dp*tp*sp virtual CPU
+    devices can be forced before jax initializes (the same shape
+    `make mesh-smoke` and tests/test_mesh_serving.py use); the parent
+    process keeps its own backend untouched. Returns flattened
+    `sched_*` keys for the result line — tools/bench_regress.py tracks
+    continuous throughput, p99, slack, and the deadline-miss rate."""
+    dims = [int(x) for x in mesh_spec.lower().split("x")]
+    if len(dims) != 3 or any(d < 1 for d in dims):
+        raise ValueError(f"bad --mesh spec {mesh_spec!r}")
+    ndev = dims[0] * dims[1] * dims[2]
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if not f.startswith("--xla_force_host_platform_device_count")]
+    flags.append(f"--xla_force_host_platform_device_count={max(ndev, 2)}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    env["PINGOO_MESH"] = mesh_spec
+    repo = os.path.dirname(os.path.abspath(__file__))
+    out = _run_tracked(
+        [sys.executable, "-c", "import bench; bench._sched_bench_child()"],
+        capture_output=True, text=True, timeout=1200, env=env, cwd=repo)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"sched bench child rc={out.returncode}: "
+            f"{(out.stderr or '')[-300:]}")
+    child = json.loads(out.stdout.strip().splitlines()[-1])
+    res = {"sched_mesh": mesh_spec, "sched_mesh_devices": ndev,
+           "sched_deadline_ms": child.get("deadline_ms"),
+           "sched_batch": child.get("max_batch")}
+    for mode, row in child.get("modes", {}).items():
+        for key, val in row.items():
+            res[f"sched_{mode}_{key}"] = val
+    cont = child.get("modes", {}).get("continuous", {})
+    # The regress-tracked aliases (direction-aware, bench_regress.py).
+    if "req_per_s" in cont:
+        res["sched_continuous_req_per_s"] = cont["req_per_s"]
+        res["sched_continuous_p99_ms"] = cont.get("p99_wait_ms")
+        res["sched_deadline_miss_rate"] = cont.get("deadline_miss_rate")
+        res["sched_p99_slack_ms"] = cont.get("p99_slack_ms")
+    return res
+
+
+def _sched_bench_child() -> None:
+    """Child body of bench_sched (forced-device-count subprocess): boot
+    VerdictService per scheduler mode, serve a bursty replayed-traffic
+    stream, emit one JSON line with per-mode throughput/latency/miss
+    statistics. Per-request latency is measured around evaluate() in
+    the driver (the registry's wait histogram is process-global and
+    would mix the two modes)."""
+    import asyncio
+    import time as _time
+
+    from pingoo_tpu.compiler import compile_ruleset
+    from pingoo_tpu.engine.service import VerdictService
+    from pingoo_tpu.utils.crs import generate_ruleset, generate_traffic
+
+    n_rules = int(os.environ.get("BENCH_SCHED_RULES", "60"))
+    n_reqs = int(os.environ.get("BENCH_SCHED_REQUESTS", "1024"))
+    burst = int(os.environ.get("BENCH_SCHED_BURST", "64"))
+    max_batch = int(os.environ.get("BENCH_SCHED_BATCH", "256"))
+    rules, lists = generate_ruleset(n_rules, with_lists=True,
+                                    list_sizes=(4096, 512))
+    plan = compile_ruleset(rules, lists)
+    reqs = generate_traffic(n_reqs, lists=lists, seed=7)
+    result: dict = {"modes": {}, "max_batch": max_batch,
+                    "rules": n_rules, "requests": n_reqs}
+
+    for mode in ("fixed", "continuous"):
+        os.environ["PINGOO_SCHED_MODE"] = mode
+        svc = VerdictService(plan, lists, use_device=True,
+                             max_batch=max_batch, max_wait_us=300)
+        result["deadline_ms"] = svc.sched.config.deadline_ms
+        waits: list[float] = []
+
+        async def timed(svc=svc, waits=waits, r=None):
+            t0 = _time.monotonic()
+            v = await svc.evaluate(r)
+            waits.append((_time.monotonic() - t0) * 1e3)
+            return v
+
+        async def drive(svc=svc, waits=waits):
+            await svc.start()
+            # Warm the per-bucket XLA programs off the measured run (a
+            # first-burst compile would otherwise own the p99).
+            await asyncio.gather(*[svc.evaluate(r)
+                                   for r in reqs[:burst]])
+            miss0 = svc.sched.deadline_misses
+            launch0 = svc.sched.launches
+            t0 = _time.monotonic()
+            for i in range(0, n_reqs, burst):
+                await asyncio.gather(*[
+                    timed(svc, waits, r) for r in reqs[i:i + burst]])
+            elapsed = _time.monotonic() - t0
+            await svc.stop()
+            return elapsed, miss0, launch0
+
+        elapsed, miss0, launch0 = asyncio.run(drive())
+        waits.sort()
+        p99 = waits[min(len(waits) - 1, int(0.99 * len(waits)))]
+        deadline_ms = svc.sched.config.deadline_ms
+        launches = svc.sched.launches - launch0
+        result["modes"][mode] = {
+            "req_per_s": round(n_reqs / elapsed, 1),
+            "p50_wait_ms": round(waits[len(waits) // 2], 3),
+            "p99_wait_ms": round(p99, 3),
+            "p99_slack_ms": round(deadline_ms - p99, 3),
+            "deadline_miss_rate": round(
+                (svc.sched.deadline_misses - miss0) / n_reqs, 4),
+            "launches": launches,
+            "mean_launch_occupancy": round(
+                n_reqs / launches, 1) if launches else 0.0,
+        }
+    print(json.dumps(result), flush=True)
+
+
 def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
     """Committed end-to-end drive: loadgen_http -> httpd -> ring ->
     sidecar (device lane verdict) -> 403 / proxy -> pong."""
@@ -930,6 +1060,18 @@ def _main_impl(result: dict, done=None) -> None:
                     update_cached_plan(rules, lists, plan, cache_dir)
         except Exception as exc:
             result["autotune_error"] = repr(exc)[:200]
+    # Scheduler-mode + serving-mesh A/B (ISSUE 6): runs when --mesh
+    # dpxtpxsp (or BENCH_MESH) is given, or under BENCH_SCHED=1 for the
+    # single-device scheduler comparison alone. Subprocess-isolated so
+    # the forced virtual-device count never touches this process.
+    mesh_spec = _mesh_arg()
+    if mesh_spec is None and os.environ.get("BENCH_SCHED") == "1":
+        mesh_spec = "1x1x1"
+    if mesh_spec is not None and os.environ.get("BENCH_SKIP_SCHED") != "1":
+        try:
+            result.update(bench_sched(mesh_spec))
+        except Exception as exc:
+            result["sched_error"] = repr(exc)[:200]
     if os.environ.get("BENCH_SKIP_BLOCKLIST") != "1":
         try:
             result.update(bench_blocklist_1m())

@@ -137,6 +137,24 @@ def bucket_arrays(arrays: dict, min_len: int = 16) -> dict:
     return out
 
 
+def pow2_batch_size(n: int, max_batch: int, multiple: int = 1) -> int:
+    """The engine's padded launch size for an n-row batch: the next
+    power of two (floor 8, so tiny batches share one compiled shape),
+    capped at `max_batch` but never below n, then rounded up to
+    `multiple` — the mesh executor passes its dp extent so the batch
+    axis shards evenly (sched/mesh_exec.py; 1 = single device, where
+    this reproduces the historical pow2 ladder exactly)."""
+    target = 1
+    while target < n:
+        target *= 2
+    size = max(min(max(target, 8), max_batch), n)
+    if multiple > 1:
+        rem = size % multiple
+        if rem:
+            size += multiple - rem
+    return size
+
+
 def pad_batch(batch: RequestBatch, to_size: int) -> RequestBatch:
     """Pad a batch to a fixed size (jit shape stability); padded rows are
     inert (zero-length fields, ip 0, no overflow)."""

@@ -32,6 +32,7 @@ from ..obs.flightrecorder import (FlightRecorder, register_recorder,
                                   tuple_digest)
 from ..obs.provenance import (ParityAuditor, PrefilterAttribution,
                               RuleAttribution, provenance_enabled)
+from ..sched import MeshExecutor, MeshUnavailable, Scheduler, SchedulerConfig
 from .batch import (
     RequestBatch,
     RequestTuple,
@@ -39,6 +40,7 @@ from .batch import (
     bucket_arrays,
     encode_requests,
     pad_batch,
+    pow2_batch_size,
     tuple_to_context,
 )
 from .verdict import (action_lanes, finish_batch, make_prefilter_fn,
@@ -256,6 +258,21 @@ class VerdictService:
         self._pf_fn = None
         self._pf_gated_banks = 0
         self._pf_attr = None
+        # Continuous-batching admission scheduler + serving mesh
+        # (ISSUE 6, docs/SCHEDULER.md): the scheduler replaces the
+        # fixed max_wait_us assembly window with a deadline-slack
+        # launch policy (PINGOO_SCHED_MODE=fixed keeps the old
+        # behavior); the mesh executor shards tables/batches when
+        # PINGOO_MESH asks for more than one device.
+        self.sched = Scheduler(SchedulerConfig.from_env(max_batch),
+                               plane="python")
+        self.mesh: Optional[MeshExecutor] = None
+        # Double-buffered dispatch: up to this many batches in flight,
+        # so batch N+1 assembles/encodes while batch N computes (the
+        # first slice of the ROADMAP's pipelined-executor item).
+        self._pipeline_depth = max(1, int(
+            os.environ.get("PINGOO_SCHED_PIPELINE", "2")))
+        self._inflight: set = set()
         # Verdict provenance (ISSUE 5): per-rule attribution, the
         # flight recorder, and the shadow-parity auditor. PINGOO_
         # PROVENANCE=0 turns the whole layer off; the parity auditor
@@ -290,14 +307,37 @@ class VerdictService:
                     if provenance_enabled():
                         self._pf_attr = PrefilterAttribution(
                             pf.masked, plane="python")
+                # Mesh BEFORE table materialization: tp padding must
+                # land in plan.np_tables before device_tables() runs.
+                self.mesh = self._build_mesh(plan)
                 tables = plan.device_tables()
-                if device is not None:
+                if self.mesh.active:
+                    tables = self.mesh.place_tables(tables)
+                elif device is not None:
                     tables = jax.device_put(tables, device)
                 self._tables = tables
             except Exception:
                 self.use_device = False
         else:
             self.use_device = False
+
+    def _build_mesh(self, plan) -> MeshExecutor:
+        """The serving mesh for this plane (PINGOO_MESH). Degrades to
+        the inactive single-device executor — never crashes the data
+        plane — when the spec is malformed or needs more devices than
+        the backend has; the failure is logged and visible as
+        pingoo_mesh_devices == 1."""
+        try:
+            return MeshExecutor(plan, plane="python",
+                                metrics=self.sched.metrics)
+        except (MeshUnavailable, ValueError) as exc:
+            from ..logging_utils import get_logger
+
+            get_logger("pingoo_tpu.sched").warning(
+                "serving mesh unavailable; single-device path",
+                extra={"fields": {"error": str(exc)}})
+            return MeshExecutor(plan, spec=(1, 1, 1), plane="python",
+                                metrics=self.sched.metrics)
 
     async def start(self) -> None:
         if self._task is None:
@@ -331,6 +371,11 @@ class VerdictService:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        # Drain the double-buffered in-flight batches: their futures
+        # must resolve (fail-open at worst) before callers tear down.
+        if self._inflight:
+            await asyncio.gather(*list(self._inflight),
+                                 return_exceptions=True)
         task = getattr(self, "_profile_task", None)
         if task is not None and not task.done():
             task.cancel()
@@ -402,40 +447,93 @@ class VerdictService:
     # -- batching loop -------------------------------------------------------
 
     async def _collector(self) -> None:
+        """Admission loop (ISSUE 6): pop -> assemble under the
+        scheduler's launch policy -> hand the batch to a double-
+        buffered runner task, so batch N+1 assembles and encodes while
+        batch N computes. In `continuous` mode the assembly window is
+        the oldest request's remaining deadline slack minus the EWMA
+        dispatch estimate — not a fixed timer; `fixed` keeps the
+        legacy max_wait_us window (the bench A/B arm)."""
+        sched = self.sched
+        continuous = sched.config.mode == "continuous"
+        sem = asyncio.Semaphore(self._pipeline_depth)
         while True:
             item = await self._queue.get()
             t_first = time.monotonic()
             self.stats.observe_stage(
                 "queue_wait", (t_first - item[2]) * 1e3)
-            pending = [item]
-            deadline = t_first + self.max_wait_s
+            # Pending entries are (req, fut, t_enq, t_admit): t_enq
+            # anchors the request's deadline (evaluate() entry — the
+            # <2 ms budget is end to end), t_admit its collector pop.
+            pending = [(item[0], item[1], item[2], t_first)]
+            oldest_enq = item[2]
+            fixed_deadline = t_first + self.max_wait_s
             while len(pending) < self.max_batch:
-                timeout = deadline - time.monotonic()
+                now = time.monotonic()
+                if continuous:
+                    timeout = sched.wait_budget_s(
+                        len(pending), oldest_enq, now)
+                else:
+                    timeout = fixed_deadline - now
                 if timeout <= 0:
                     break
                 try:
                     item = await asyncio.wait_for(self._queue.get(), timeout)
                 except asyncio.TimeoutError:
                     break
+                t_adm = time.monotonic()
                 self.stats.observe_stage(
-                    "queue_wait", (time.monotonic() - item[2]) * 1e3)
-                pending.append(item)
-            self.stats.observe_stage(
-                "batch_assembly", (time.monotonic() - t_first) * 1e3)
-            try:
-                await self._run_batch(pending)
-            except asyncio.CancelledError:
-                raise
-            except Exception:
-                # The collector must never die: resolve this batch
-                # fail-open (no-match) and keep serving.
-                self.stats.device_errors += 1
-                R = len(self.plan.rules)
-                for _, fut, _t in pending:
-                    if not fut.done():
-                        fut.set_result(Verdict(
-                            action=0, matched=np.zeros(R, dtype=bool),
-                            degraded=True))
+                    "queue_wait", (t_adm - item[2]) * 1e3)
+                pending.append((item[0], item[1], item[2], t_adm))
+            # Greedy tail drain: whatever is ALREADY queued rides this
+            # launch for free (burst traffic batches even when the
+            # oldest request's slack is exhausted — launching
+            # singletons under overload would only make every
+            # follower later).
+            while len(pending) < self.max_batch:
+                try:
+                    item = self._queue.get_nowait()
+                except asyncio.QueueEmpty:
+                    break
+                t_adm = time.monotonic()
+                self.stats.observe_stage(
+                    "queue_wait", (t_adm - item[2]) * 1e3)
+                pending.append((item[0], item[1], item[2], t_adm))
+            t_launch = time.monotonic()
+            # Scheduler hold time: first admit -> launch decision.
+            self.stats.observe_stage("sched", (t_launch - t_first) * 1e3)
+            # ISSUE 6 satellite (fairness fix): batch_assembly is
+            # stamped PER REQUEST from its own admit timestamp — the
+            # old single (t_launch - t_first) observation under-
+            # reported queue wait for requests admitted late into a
+            # large batch.
+            for _, _, _, t_adm in pending:
+                self.stats.observe_stage(
+                    "batch_assembly", (t_launch - t_adm) * 1e3)
+            sched.note_launch(len(pending), self._queue.qsize())
+            await sem.acquire()
+            task = asyncio.create_task(
+                self._run_batch_guarded(pending, t_launch, sem))
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+
+    async def _run_batch_guarded(self, pending, t_launch, sem) -> None:
+        try:
+            await self._run_batch(pending, t_launch)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # The runner must never strand futures: resolve this batch
+            # fail-open (no-match) and keep serving.
+            self.stats.device_errors += 1
+            R = len(self.plan.rules)
+            for _, fut, _t, _a in pending:
+                if not fut.done():
+                    fut.set_result(Verdict(
+                        action=0, matched=np.zeros(R, dtype=bool),
+                        degraded=True))
+        finally:
+            sem.release()
 
     @staticmethod
     def _dedup_key(req: RequestTuple) -> tuple:
@@ -444,8 +542,14 @@ class VerdictService:
         return (req.method, req.path, req.url, req.host, req.user_agent,
                 req.ip, req.remote_port, req.asn, req.country)
 
-    async def _run_batch(self, pending: list) -> None:
-        reqs = [r for r, _, _ in pending]
+    async def _run_batch(self, pending: list, t_launch: float) -> None:
+        # Unmeetable deadlines fail open FIRST (per PINGOO_SCHED_
+        # FAILOPEN) so a hopeless request never occupies device budget.
+        if self.sched.config.failopen != "serve":
+            pending = await self._apply_failopen(pending)
+            if not pending:
+                return
+        reqs = [r for r, _, _, _ in pending]
         # Batch dedup: replayed/bursty traffic repeats identical tuples
         # (same method/path/headers/ip); encode + evaluate each distinct
         # tuple once and fan the verdict out to every duplicate.
@@ -463,8 +567,14 @@ class VerdictService:
         dups = len(reqs) - len(uniq_rows)
         eval_reqs = [reqs[i] for i in uniq_rows] if dups else reqs
         loop = asyncio.get_running_loop()
+        stages: dict = {}  # per-batch (double-buffered batches overlap)
+        t_eval = time.monotonic()
         matched, scores = await loop.run_in_executor(
-            None, self._evaluate_with_scores, eval_reqs)
+            None, self._evaluate_with_scores, eval_reqs, stages)
+        # Feed the EWMA cost model the measured encode->result wall for
+        # this padded size — what the launch policy trades slack against.
+        self.sched.observe_cost(self._pow2_size(len(eval_reqs)),
+                                (time.monotonic() - t_eval) * 1e3)
         if dups:
             self.stats.dedup_hits += dups
             matched = matched[row_of]  # fan out to duplicate rows
@@ -474,11 +584,12 @@ class VerdictService:
         self.stats.batches += 1
         self.stats.requests += len(reqs)
         self.stats.batch_occupancy_sum += len(reqs)
-        for i, (_, fut, t_enq) in enumerate(pending):
+        for i, (_, fut, t_enq, _t_adm) in enumerate(pending):
             # The shared verdict-wait histogram measures the full
             # evaluate() -> resolve wall per REQUEST (queue wait
             # included) — the <2ms p99 budget is about this number.
             self.stats.wait_hist.observe((t_resolve - t_enq) * 1e3)
+            self.sched.note_resolved(t_enq, t_resolve)
             if not fut.done():
                 fut.set_result(
                     Verdict(action=int(actions[i]), matched=matched[i],
@@ -492,12 +603,58 @@ class VerdictService:
         t_prov = time.monotonic()
         if self._attribution is not None:
             self._observe_provenance(reqs, pending, matched, actions,
-                                     t_resolve)
+                                     t_resolve, t_launch, stages)
         self.stats.observe_stage(
             "provenance", (time.monotonic() - t_prov) * 1e3)
 
+    async def _apply_failopen(self, pending: list) -> list:
+        """Fail open the requests whose deadline is unmeetable even by
+        an immediate launch (sched.unmeetable): `allow` resolves them
+        with the fail-open verdict at once; `interpret` evaluates them
+        on the host interpreter off the device path. Returns the
+        requests that still ride the device batch."""
+        now = time.monotonic()
+        keep: list = []
+        late: list = []
+        for item in pending:
+            if self.sched.unmeetable(item[2], now, len(pending)):
+                late.append(item)
+            else:
+                keep.append(item)
+        if not late:
+            return pending
+        self.sched.note_failopen(len(late))
+        R = len(self.plan.rules)
+        if self.sched.config.failopen == "allow":
+            t_res = time.monotonic()
+            for _, fut, t_enq, _t_adm in late:
+                self.stats.wait_hist.observe((t_res - t_enq) * 1e3)
+                self.sched.note_resolved(t_enq, t_res)
+                if not fut.done():
+                    fut.set_result(Verdict(
+                        action=0, matched=np.zeros(R, dtype=bool),
+                        degraded=True))
+            return keep
+        # interpret: a real verdict, just off the device path — the
+        # same degradation rung the watchdog fallback uses.
+        loop = asyncio.get_running_loop()
+        late_reqs = [r for r, _, _, _ in late]
+        matched = await loop.run_in_executor(
+            None, lambda: np.stack(
+                [self._interpret_row(r) for r in late_reqs]))
+        acts, vblk = action_lanes(self.plan, matched)
+        t_res = time.monotonic()
+        for i, (_, fut, t_enq, _t_adm) in enumerate(late):
+            self.stats.wait_hist.observe((t_res - t_enq) * 1e3)
+            self.sched.note_resolved(t_enq, t_res)
+            if not fut.done():
+                fut.set_result(Verdict(
+                    action=int(acts[i]), matched=matched[i],
+                    verified_block=bool(vblk[i])))
+        return keep
+
     def _observe_provenance(self, reqs, pending, matched, actions,
-                            t_resolve) -> None:
+                            t_resolve, t_launch, batch_stages) -> None:
         """Per-batch provenance: fold per-rule hit counters, flight-
         record each request, and hand the batch to the parity sampler.
         Runs on the collector path per batch — registered hot in the
@@ -505,7 +662,6 @@ class VerdictService:
         fails `make analyze` (the matrix is already host-resident)."""
         self._attribution.fold_batch(matched.sum(axis=0))
         recorder = self.flight_recorder
-        batch_stages = self._last_batch_stages
         n = len(reqs)
         # Matched-rule ids per row from ONE nonzero pass (per-row
         # nonzero would be n small kernel launches' worth of overhead).
@@ -522,6 +678,10 @@ class VerdictService:
             stages = dict(batch_stages)
             stages["wait_ms"] = round(
                 (t_resolve - pending[i][2]) * 1e3, 3)
+            # ISSUE 6: admit -> launch slack per request (the share of
+            # its wait the SCHEDULER chose, vs. queue/device time).
+            stages["admit_to_launch_ms"] = round(
+                (t_launch - pending[i][3]) * 1e3, 3)
             recorder.record(
                 trace_id=req.trace_id,
                 digest=tuple_digest(req.method, req.host, req.path,
@@ -532,13 +692,19 @@ class VerdictService:
         if self.parity is not None:
             self.parity.submit_matrix(reqs, matched)
 
-    def _evaluate_with_scores(self, reqs: list[RequestTuple]):
+    def _evaluate_with_scores(self, reqs: list[RequestTuple],
+                              stages: Optional[dict] = None):
         """-> (matched [B, R], bot scores [B]). Scores ride the same
-        encoded batch (BASELINE config 5: the vectorized bot head)."""
+        encoded batch (BASELINE config 5: the vectorized bot head).
+        `stages` collects this batch's per-stage timings — a PER-BATCH
+        dict, because double-buffered dispatch (ISSUE 6) overlaps two
+        batches' evaluations."""
         t0 = time.monotonic()
         batch = encode_requests(reqs, self.plan.field_specs)
-        self._last_batch_stages = {}  # fresh per batch (collector thread)
-        self._batch_stage("encode", (time.monotonic() - t0) * 1e3)
+        if stages is None:
+            stages = {}
+        self._last_batch_stages = stages  # latest batch (introspection)
+        self._batch_stage("encode", (time.monotonic() - t0) * 1e3, stages)
         n = len(reqs)
         # DISPATCH the scorer before the verdict runs: jax dispatch is
         # async, so the bot head computes while the verdict path does
@@ -564,7 +730,7 @@ class VerdictService:
                 # Scoring is advisory and never blocks verdicts, but a
                 # broken scorer must show up on the metrics surface.
                 self.stats.score_errors += 1
-        matched = self._evaluate_sync(reqs, batch)
+        matched = self._evaluate_sync(reqs, batch, stages)
         # pingoo: allow(hot-alloc): [B] f32 default score vector
         scores = np.zeros(n, dtype=np.float32)
         if score_dev is not None:
@@ -577,20 +743,23 @@ class VerdictService:
         return matched, scores
 
     def _pow2_size(self, n: int) -> int:
-        target = 1
-        while target < n:
-            target *= 2
-        return max(min(max(target, 8), self.max_batch), n)
+        """Padded launch size: the shared pow2 ladder, dp-aligned when
+        a serving mesh is active (the batch axis must shard evenly)."""
+        multiple = self.mesh.dp if self.mesh is not None else 1
+        return pow2_batch_size(n, self.max_batch, multiple=multiple)
 
-    def _batch_stage(self, stage: str, ms: float) -> None:
-        """Observe a pipeline stage AND stash it in the per-batch stage
-        dict the flight recorder attaches to every record (single
-        collector thread — no lock needed)."""
+    def _batch_stage(self, stage: str, ms: float,
+                     stages: Optional[dict] = None) -> None:
+        """Observe a pipeline stage AND stash it in the batch's stage
+        dict the flight recorder attaches to every record (the dict is
+        per batch: double-buffered batches overlap)."""
         self.stats.observe_stage(stage, ms)
-        self._last_batch_stages[f"{stage}_ms"] = round(ms, 3)
+        if stages is not None:
+            stages[f"{stage}_ms"] = round(ms, 3)
 
     def _evaluate_sync(self, reqs: list[RequestTuple],
-                       batch: Optional[RequestBatch] = None) -> np.ndarray:
+                       batch: Optional[RequestBatch] = None,
+                       stages: Optional[dict] = None) -> np.ndarray:
         n = len(reqs)
         if batch is None:
             batch = encode_requests(reqs, self.plan.field_specs)
@@ -604,6 +773,12 @@ class VerdictService:
                 fast = pad_batch(
                     RequestBatch(size=batch.size, arrays=arrays),
                     self._pow2_size(n))
+                # Mesh placement (ISSUE 6): the device programs read the
+                # dp-sharded view; `fast` itself stays host-resident for
+                # the host-rule overlap + overflow re-interpretation.
+                dev_arrays = fast.arrays
+                if self.mesh is not None and self.mesh.active:
+                    dev_arrays = self.mesh.shard_batch(dev_arrays)
                 pf_hits = pf_aux = None
                 if self._pf_fn is not None:
                     # Stage A (always-on, whole batch): factor hits feed
@@ -611,21 +786,22 @@ class VerdictService:
                     # feed the candidate-rate/skip metrics after the
                     # batch's sync point.
                     t0 = time.monotonic()
-                    pf_hits, pf_aux = self._pf_fn(self._tables, fast.arrays)
+                    pf_hits, pf_aux = self._pf_fn(self._tables, dev_arrays)
                     self._batch_stage(
-                        "prefilter", (time.monotonic() - t0) * 1e3)
+                        "prefilter", (time.monotonic() - t0) * 1e3, stages)
                 t0 = time.monotonic()
-                dev = self._verdict_fn(self._tables, fast.arrays, pf_hits)
+                dev = self._verdict_fn(self._tables, dev_arrays, pf_hits)
                 # jax dispatch is async: this stage is issue + host->
                 # device transfer; the on-device execution residual is
                 # timed inside finish_batch via block_until_ready,
                 # AFTER the host-interpreted rules overlapped it.
                 self._batch_stage(
-                    "device_dispatch", (time.monotonic() - t0) * 1e3)
+                    "device_dispatch", (time.monotonic() - t0) * 1e3,
+                    stages)
                 matched = finish_batch(
                     self.plan, dev, fast, self.lists,
                     on_device_wait=lambda ms: self._batch_stage(
-                        "device_compute", ms))[:n]
+                        "device_compute", ms, stages))[:n]
                 if pf_aux is not None:
                     self._observe_prefilter(pf_aux, fast.size)
             except Exception:

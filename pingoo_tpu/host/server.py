@@ -101,6 +101,24 @@ class Server:
             use_device = False
         from ..compiler.cache import compile_ruleset_cached
 
+        # Serving-mesh + scheduler knobs (ISSUE 6, docs/SCHEDULER.md):
+        # validate PINGOO_MESH here so a malformed spec fails the boot
+        # with its message instead of silently serving unsharded, and
+        # log the admission policy the engine planes will run under.
+        from ..sched import SchedulerConfig, mesh_env_spec
+
+        mesh_spec = mesh_env_spec()  # raises ValueError on a bad spec
+        sched_cfg = SchedulerConfig.from_env(max_batch=1024)
+        from ..logging_utils import get_logger
+
+        get_logger("pingoo_tpu.server").info(
+            "scheduler config", extra={"fields": {
+                "mesh": "x".join(str(d) for d in mesh_spec),
+                "mode": sched_cfg.mode,
+                "deadline_ms": sched_cfg.deadline_ms,
+                "failopen": sched_cfg.failopen,
+            }})
+
         # Service route predicates compile into the same plan as extra
         # verdict columns (rules AND routing decided by one batch).
         routes = [(s.name, s.route) for s in config.services]

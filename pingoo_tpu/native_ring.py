@@ -444,6 +444,16 @@ class RingSidecar:
         # it hides the device round-trip latency (large when the chip is
         # behind a network tunnel) behind the next batch's host work.
         self.pipeline_depth = max(1, pipeline_depth)
+        # Continuous-batching admission scheduler (ISSUE 6, docs/
+        # SCHEDULER.md): replaces the fixed drain window (dispatch
+        # whatever one dequeue pass returned) with the deadline-slack
+        # launch policy shared with the Python plane. Timestamps come
+        # from the ring's enq_ms clock (pingoo_ring_now_ms), converted
+        # to seconds for the scheduler.
+        from .sched import MeshUnavailable, Scheduler, SchedulerConfig
+
+        self.sched = Scheduler(SchedulerConfig.from_env(max_batch),
+                               plane="sidecar")
         # The sidecar uses the transfer-thin lane reduction — the
         # first-match action decision computes ON DEVICE and only four
         # int32 lanes come back, not the [B, R] match matrix (which
@@ -512,7 +522,22 @@ class RingSidecar:
                 if ridx is not None and by_index[ridx].host:
                     hr.append((order, by_index[ridx].program))
             self._host_routes.append(hr)
-        self._tables = plan.device_tables()
+        # Serving mesh (ISSUE 6): tp padding must land in plan.np_tables
+        # before device_tables() materializes; failures degrade to the
+        # single-device path (never crash the drain) and stay visible
+        # via pingoo_mesh_devices == 1.
+        from .sched import MeshExecutor
+
+        try:
+            self.mesh = MeshExecutor(plan, plane="sidecar",
+                                     metrics=self.sched.metrics)
+        except (MeshUnavailable, ValueError):
+            self.mesh = MeshExecutor(plan, spec=(1, 1, 1),
+                                     plane="sidecar",
+                                     metrics=self.sched.metrics)
+        tables = plan.device_tables()
+        self._tables = (self.mesh.place_tables(tables)
+                        if self.mesh.active else tables)
         # The C++ plane has no mmdb decoder: it enqueues slots with
         # asn=0 / country="XX" (its unknown markers). The reference
         # resolves geoip per request in the listener
@@ -542,8 +567,9 @@ class RingSidecar:
                 "pingoo_verdict_stage_ms",
                 "verdict pipeline stage latency (ms)",
                 labels={"plane": "sidecar", "stage": stage})
-            for stage in ("encode", "prefilter", "device_dispatch",
-                          "device_compute", "resolve", "provenance")}
+            for stage in ("sched", "encode", "prefilter",
+                          "device_dispatch", "device_compute", "resolve",
+                          "provenance")}
         # Stage-A literal prefilter (docs/PREFILTER.md): the sidecar is
         # the native plane's verdict engine, so it exports the same
         # candidate-rate/skip metrics the Python listener plane does.
@@ -600,10 +626,15 @@ class RingSidecar:
         work and device occupancy, not their sum plus the transport
         round trip (which matters doubly when the chip sits behind a
         network tunnel).
+
+        Admission (ISSUE 6): dequeued slots ACCUMULATE across drain
+        cycles under the continuous-batching scheduler — a batch
+        launches when it is full, or when the oldest request's
+        remaining deadline slack (enq_ms clock) no longer covers the
+        EWMA dispatch estimate. PINGOO_SCHED_MODE=fixed restores the
+        legacy dispatch-every-pass window.
         """
         from collections import deque
-
-        from .engine.batch import RequestBatch, bucket_arrays, pad_batch
 
         import threading as _threading
 
@@ -612,82 +643,169 @@ class RingSidecar:
         # segfault in the ctypes call.
         self._thread = _threading.current_thread()
         inflight: deque = deque()
+        sched = self.sched
+        continuous = sched.config.mode == "continuous"
+        pend_parts: list[tuple[Ring, np.ndarray]] = []
+        pend_n = 0
+        oldest_enq_ms: Optional[int] = None
         while not self._stop:
-            # One merged batch per cycle across all worker rings. The
+            # One merged dequeue pass across all worker rings. The
             # start index rotates so a saturated ring cannot monopolize
             # the budget and starve its siblings into the data plane's
             # verdict timeout (which fails open).
-            parts: list[tuple[Ring, np.ndarray]] = []
-            budget = self.max_batch
+            budget = self.max_batch - pend_n
             nrings = len(self.rings)
             self._ring_rr = (self._ring_rr + 1) % nrings
+            got = 0
             for i in range(nrings):
                 if budget <= 0:
                     break
                 r = self.rings[(self._ring_rr + i) % nrings]
                 s = r.dequeue_batch(budget)
                 if len(s):
-                    parts.append((r, s))
-                    budget -= len(s)
-            n = sum(len(s) for _, s in parts)
-            if n:
-                if self.geoip is not None:
-                    # Enrich IN the per-ring slot arrays (dequeue_batch
-                    # copies, so this is safe) BEFORE merging: both the
-                    # device batch below and the overflow-spill
-                    # re-interpretation (_interpret_overflow_row reads
-                    # the per-ring part) must see the same geo values —
-                    # enriching only a merged copy would let >2048-byte
-                    # spill rows evaluate geo rules on the XX/0 markers.
-                    for _, s in parts:
+                    if self.geoip is not None:
+                        # Enrich IN the per-ring slot arrays
+                        # (dequeue_batch copies, so this is safe)
+                        # BEFORE merging: both the device batch and the
+                        # overflow-spill re-interpretation
+                        # (_interpret_overflow_row reads the per-ring
+                        # part) must see the same geo values.
                         self._enrich_slots(s)
-                slots = parts[0][1] if len(parts) == 1 else np.concatenate(
-                    [s for _, s in parts])
-                # Pad the batch axis to one fixed shape (a partial batch
-                # would otherwise be a new XLA program — compile stall on
-                # the serving path) and bucket field lengths to powers of
-                # two so the NFA scan walks the batch's longest value,
-                # not the 2048-byte slot capacity (at most log2(cap)
-                # shapes per field).
-                t0 = time.monotonic()
-                raw = RequestBatch(size=n, arrays=slots_to_arrays(slots))
-                batch = pad_batch(
-                    RequestBatch(size=n, arrays=bucket_arrays(raw.arrays)),
-                    self.max_batch)
-                t1 = time.monotonic()
-                pf_hits = pf_aux = None
-                if self._pf_fn is not None:
-                    pf_hits, pf_aux = self._pf_fn(
-                        self._tables, batch.arrays)  # async
-                tpf = time.monotonic()
-                rule_hits = None
-                if self._provenance_on:
-                    # Attribution aux lane rides the SAME dispatch; the
-                    # traced n masks batch-padding rows on device.
-                    dev, rule_hits = self._lane_fn(
-                        self._tables, batch.arrays, pf_hits,
-                        np.int32(n))  # async
+                    pend_parts.append((r, s))
+                    budget -= len(s)
+                    got += len(s)
+                    first = int(s["enq_ms"].min())
+                    if oldest_enq_ms is None or first < oldest_enq_ms:
+                        oldest_enq_ms = first
+            pend_n += got
+            launch = False
+            if pend_n:
+                if not continuous or pend_n >= self.max_batch:
+                    launch = True
                 else:
-                    dev = self._lane_fn(self._tables, batch.arrays,
-                                        pf_hits)  # async
-                t2 = time.monotonic()
-                self._stage["encode"].observe((t1 - t0) * 1e3)
-                self._stage["prefilter"].observe((tpf - t1) * 1e3)
-                self._stage["device_dispatch"].observe((t2 - tpf) * 1e3)
-                inflight.append((parts, slots, raw, dev, rule_hits,
-                                 pf_aux, n))
-            if inflight and (len(inflight) >= self.pipeline_depth or n == 0):
+                    now_ms = int(self.ring.lib.pingoo_ring_now_ms())
+                    launch = sched.should_launch(
+                        pend_n, oldest_enq_ms / 1e3, now_ms / 1e3)
+            if launch:
+                inflight.append(self._dispatch(pend_parts, pend_n,
+                                               oldest_enq_ms))
+                pend_parts, pend_n, oldest_enq_ms = [], 0, None
+            if inflight and (len(inflight) >= self.pipeline_depth
+                             or not launch):
                 self._complete(*inflight.popleft())
-            if n == 0 and not inflight:
-                if max_requests is not None and self.processed >= max_requests:
+            if got == 0 and not launch and not inflight:
+                if not pend_parts and max_requests is not None \
+                        and self.processed >= max_requests:
                     break
                 time.sleep(self.idle_sleep_s)
             if max_requests is not None and self.processed >= max_requests \
-                    and not inflight:
+                    and not inflight and not pend_parts:
                 break
+        # Flush: accumulated-but-unlaunched slots still get verdicts
+        # (the data plane would otherwise eat a fail-open timeout).
+        if pend_parts:
+            inflight.append(self._dispatch(pend_parts, pend_n,
+                                           oldest_enq_ms))
         while inflight:
             self._complete(*inflight.popleft())
         return self.processed
+
+    def _queued_depth(self) -> int:
+        """Requests still waiting across this sidecar's rings (the
+        pingoo_sched_queue_depth gauge; one telemetry snapshot per ring
+        per LAUNCH, not per request)."""
+        total = 0
+        for r in self.rings:
+            try:
+                total += int(r.telemetry()["depth"])
+            except Exception:
+                pass
+        return total
+
+    def _dispatch(self, parts, n: int, oldest_enq_ms: Optional[int]):
+        """Encode + launch one merged batch (jax dispatch is async);
+        returns the in-flight tuple `_complete` consumes."""
+        from .engine.batch import RequestBatch, bucket_arrays, pad_batch
+
+        slots = parts[0][1] if len(parts) == 1 else np.concatenate(
+            [s for _, s in parts])
+        # Pad the batch axis to one fixed shape (a partial batch
+        # would otherwise be a new XLA program — compile stall on
+        # the serving path) and bucket field lengths to powers of
+        # two so the NFA scan walks the batch's longest value,
+        # not the 2048-byte slot capacity (at most log2(cap)
+        # shapes per field).
+        t0 = time.monotonic()
+        raw = RequestBatch(size=n, arrays=slots_to_arrays(slots))
+        batch = pad_batch(
+            RequestBatch(size=n, arrays=bucket_arrays(raw.arrays)),
+            self.max_batch)
+        # Mesh placement (ISSUE 6): the device programs read the
+        # dp-sharded view; `raw` stays host-resident for host-rule
+        # interpretation and spill re-evaluation.
+        arrays = batch.arrays
+        if self.mesh.active:
+            arrays = self.mesh.shard_batch(arrays)
+        t1 = time.monotonic()
+        pf_hits = pf_aux = None
+        if self._pf_fn is not None:
+            pf_hits, pf_aux = self._pf_fn(self._tables, arrays)  # async
+        tpf = time.monotonic()
+        rule_hits = None
+        if self._provenance_on:
+            # Attribution aux lane rides the SAME dispatch; the
+            # traced n masks batch-padding rows on device.
+            dev, rule_hits = self._lane_fn(
+                self._tables, arrays, pf_hits, np.int32(n))  # async
+        else:
+            dev = self._lane_fn(self._tables, arrays, pf_hits)  # async
+        t2 = time.monotonic()
+        self._stage["encode"].observe((t1 - t0) * 1e3)
+        self._stage["prefilter"].observe((tpf - t1) * 1e3)
+        self._stage["device_dispatch"].observe((t2 - tpf) * 1e3)
+        # Scheduler accounting at launch: occupancy + queue depth, the
+        # sidecar's `sched` stage (oldest enqueue -> launch hold on the
+        # ring clock), and the fail-open mask for rows whose deadline
+        # is unmeetable even by this immediate launch.
+        now_ms = int(self.ring.lib.pingoo_ring_now_ms())
+        self.sched.note_launch(n, self._queued_depth())
+        if oldest_enq_ms is not None:
+            self._stage["sched"].observe(
+                max(0.0, float(now_ms - oldest_enq_ms)))
+        skip_masks = None
+        if self.sched.config.failopen == "allow":
+            skip_masks = self._failopen_late_rows(parts, now_ms)
+        return (parts, slots, raw, dev, rule_hits, pf_aux, n, skip_masks,
+                time.monotonic())
+
+    def _failopen_late_rows(self, parts, now_ms: int) -> list:
+        """PINGOO_SCHED_FAILOPEN=allow: rows whose deadline cannot be
+        met even by the launch happening right now get an immediate
+        allow verdict (the reference's fail-open posture — attacks pass
+        rather than stall the data plane); their device verdicts are
+        computed but never posted. Returns one keep-mask per part."""
+        est_ms = self.sched.cost.estimate(self.max_batch)
+        deadline_ms = self.sched.config.deadline_ms
+        masks = []
+        for ring, part in parts:
+            enq = part["enq_ms"].astype(np.int64)
+            late = (now_ms + est_ms) > (enq + deadline_ms)
+            if late.any():
+                tickets = np.ascontiguousarray(part["ticket"][late],
+                                               dtype=np.uint64)
+                acts0 = np.zeros(len(tickets), dtype=np.uint8)
+                done = 0
+                while done < len(tickets):
+                    done += ring.post_verdicts(tickets[done:],
+                                               acts0[done:])
+                    if done < len(tickets):
+                        if self._stop:
+                            break
+                        time.sleep(self.idle_sleep_s)
+                ring.record_waits(part["enq_ms"][late])
+                self.sched.note_failopen(int(late.sum()))
+            masks.append(~late)
+        return masks
 
     def _enrich_slots(self, slots: np.ndarray) -> None:
         """Fill asn/country in place for rows the producer enqueued with
@@ -714,7 +832,7 @@ class RingSidecar:
                 slots["country"][i] = cc
 
     def _complete(self, parts, slots, raw_batch, dev, rule_hits, pf_aux,
-                  n: int) -> None:
+                  n: int, skip_masks=None, t_disp=None) -> None:
         from .engine.verdict import host_rule_lanes, merge_lanes
 
         # Host-interpreted rules run on the UNPADDED batch while the
@@ -725,6 +843,12 @@ class RingSidecar:
         wait_s = time.time() - t0
         self.device_wait_s += wait_s
         self._stage["device_compute"].observe(wait_s * 1e3)
+        if t_disp is not None:
+            # EWMA cost-model feedback: launch -> device result wall
+            # for the padded size — what should_launch trades the
+            # oldest request's slack against.
+            self.sched.observe_cost(self.max_batch,
+                                    (time.monotonic() - t_disp) * 1e3)
         if pf_aux is not None:
             # Resolved long before the lane sync above; aux int32 lanes.
             vals = np.asarray(pf_aux)
@@ -823,21 +947,42 @@ class RingSidecar:
             actions = actions | (np.minimum(route, 31).astype(np.int32) << 3)
         acts = actions[:n].astype(np.uint8)
         off = 0
-        for ring, part in parts:  # scatter back on each worker's ring
+        for pi, (ring, part) in enumerate(parts):  # scatter per ring
             m = len(part)
-            tickets = np.ascontiguousarray(part["ticket"], dtype=np.uint64)
+            # Rows the scheduler already failed open at launch
+            # (skip_masks, PINGOO_SCHED_FAILOPEN=allow) were posted
+            # then; posting again would hand their consumer a second
+            # verdict for the same ticket.
+            if skip_masks is not None and not skip_masks[pi].all():
+                keep = skip_masks[pi]
+                tickets = np.ascontiguousarray(part["ticket"][keep],
+                                               dtype=np.uint64)
+                pacts = np.ascontiguousarray(acts[off:off + m][keep])
+                waits = part["enq_ms"][keep]
+            else:
+                tickets = np.ascontiguousarray(part["ticket"],
+                                               dtype=np.uint64)
+                pacts = acts[off:off + m]
+                waits = part["enq_ms"]
+            k = len(tickets)
             done = 0
-            while done < m:  # one FFI hop per batch, resume on a full ring
-                done += ring.post_verdicts(tickets[done:],
-                                           acts[off + done:off + m])
-                if done < m:
+            while done < k:  # one FFI hop per batch, resume on a full ring
+                done += ring.post_verdicts(tickets[done:], pacts[done:])
+                if done < k:
                     if self._stop:  # a dead consumer must not wedge stop()
                         return
                     time.sleep(self.idle_sleep_s)
             # Telemetry: enqueue -> verdict-post wall time for this
             # ring's rows lands in the shm wait histogram (one FFI hop).
-            ring.record_waits(part["enq_ms"])
+            ring.record_waits(waits)
             off += m
+        # Deadline accounting on the ring clock: rows posted after
+        # their PINGOO_DEADLINE_MS budget count as misses (one
+        # vectorized compare per batch).
+        post_ms = int(self.ring.lib.pingoo_ring_now_ms())
+        self.sched.note_misses(int(
+            ((post_ms - slots["enq_ms"].astype(np.int64))
+             > self.sched.config.deadline_ms).sum()))
         self._stage["resolve"].observe(
             (time.monotonic() - t_resolve) * 1e3)
         t_prov = time.monotonic()
@@ -1019,6 +1164,8 @@ class RingSidecar:
             "spilled_rows": self.spilled_rows,
             "rings": len(self.rings),
             "ring_telemetry": self.ring_telemetry(),
+            "sched": self.sched.snapshot(),
+            "mesh": self.mesh.describe(),
         }
 
     def stop(self, join_timeout_s: float = 10.0) -> None:

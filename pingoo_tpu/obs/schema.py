@@ -36,7 +36,10 @@ SHARED_WAIT_BUCKETS_MS = (1, 2, 5, 10, 50, 100, 1000)
 # histogram.
 VERDICT_STAGES = (
     "queue_wait",      # evaluate() enqueue -> collector pop
-    "batch_assembly",  # collector pop -> batch dispatch (the wait window)
+    "batch_assembly",  # PER-REQUEST admit -> batch launch (ISSUE 6:
+                       # stamped from each request's own admit
+                       # timestamp, not the batch's first pop)
+    "sched",           # scheduler hold: first admit -> launch decision
     "encode",          # RequestTuple list -> fixed-shape arrays
     "prefilter",       # Stage-A factor pass dispatch (async; ISSUE 4)
     "device_dispatch", # jitted call issue (async) incl. host->device
@@ -97,6 +100,28 @@ PARITY_METRICS = {
         "sampled batches dropped because the audit queue was full",
 }
 
+# Continuous-batching scheduler + serving-mesh metrics (ISSUE 6,
+# docs/SCHEDULER.md): exported by every plane that runs the batched
+# verdict engine (plane="python" listener service, plane="sidecar"
+# ring drainer). `pingoo_sched_batch_size` is a histogram over the
+# pow2 launch-size ladder (sched/scheduler.BATCH_SIZE_BUCKETS); the
+# rest are counters/gauges. The matching `sched` entry in
+# VERDICT_STAGES above is the scheduler's hold-time stage histogram.
+SCHED_METRICS = {
+    "pingoo_sched_queue_depth":
+        "requests waiting in the admission queue at the last launch",
+    "pingoo_sched_batch_size":
+        "per-launch batch occupancy (histogram over the pow2 ladder)",
+    "pingoo_sched_deadline_miss_total":
+        "requests resolved after their PINGOO_DEADLINE_MS budget",
+    "pingoo_sched_failopen_total":
+        "requests failed open by the scheduler because their deadline "
+        "was unmeetable (PINGOO_SCHED_FAILOPEN policy)",
+    "pingoo_mesh_devices":
+        "devices in this plane's serving mesh (dp*tp*sp; 1 = "
+        "single-device)",
+}
+
 # Ring telemetry block metrics (source: the shm header's atomic
 # telemetry block, pingoo_ring.h PingooRingTelemetry), exported by BOTH
 # the native httpd (it maps the ring) and the sidecar drainer (so the
@@ -145,5 +170,5 @@ NATIVE_JSON_KEYS = {
 def all_metric_names() -> set[str]:
     return (set(SHARED_METRICS) | set(RING_METRICS) | set(NATIVE_METRICS)
             | set(PREFILTER_METRICS) | set(PROVENANCE_METRICS)
-            | set(PARITY_METRICS)
+            | set(PARITY_METRICS) | set(SCHED_METRICS)
             | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

@@ -2,7 +2,8 @@
 (concurrent halo scan + sequential ring fallback)."""
 
 from .. import ops as _ops  # noqa: F401  (x64 before tracing)
-from .mesh import batch_shardings, make_mesh, pad_tables_for_tp, table_shardings
+from .mesh import (batch_shardings, make_mesh, pad_tables_for_tp,
+                   parse_mesh_spec, table_shardings)
 from .ring import halo_nfa_scan, ring_nfa_scan, shard_batch_for_ring, sp_nfa_scan
 
 __all__ = [
@@ -10,6 +11,7 @@ __all__ = [
     "halo_nfa_scan",
     "make_mesh",
     "pad_tables_for_tp",
+    "parse_mesh_spec",
     "ring_nfa_scan",
     "shard_batch_for_ring",
     "sp_nfa_scan",

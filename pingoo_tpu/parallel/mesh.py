@@ -32,6 +32,28 @@ from ..ops.nfa_scan import NfaTables
 from ..ops.window_match import WindowTable
 
 
+def parse_mesh_spec(spec: str) -> tuple[int, int, int]:
+    """`"dpxtpxsp"` -> (dp, tp, sp), e.g. "2x2x2" -> (2, 2, 2).
+
+    The serving-path mesh knob (PINGOO_MESH, sched/mesh_exec.py) is
+    parsed here next to `make_mesh` so the spec grammar and the mesh
+    axis order live in one place. Raises ValueError with the offending
+    spec on anything malformed — boot fails fast instead of silently
+    serving unsharded."""
+    parts = str(spec).strip().lower().split("x")
+    if len(parts) != 3:
+        raise ValueError(
+            f"bad mesh spec {spec!r}: want dpxtpxsp, e.g. 2x2x2")
+    try:
+        dp, tp, sp = (int(p) for p in parts)
+    except ValueError:
+        raise ValueError(
+            f"bad mesh spec {spec!r}: non-integer axis") from None
+    if dp < 1 or tp < 1 or sp < 1:
+        raise ValueError(f"bad mesh spec {spec!r}: axes must be >= 1")
+    return dp, tp, sp
+
+
 def make_mesh(dp: int = 1, tp: int = 1, sp: int = 1,
               devices: list | None = None) -> Mesh:
     devices = devices if devices is not None else jax.devices()

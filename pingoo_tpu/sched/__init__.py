@@ -1,0 +1,30 @@
+"""Serving-mesh scheduler subsystem (ISSUE 6, docs/SCHEDULER.md).
+
+The layer between the admission queues (the Python listener's asyncio
+queue, the native plane's shm ring) and the compiled verdict programs:
+
+  * `scheduler.Scheduler` — deadline-aware continuous-batching
+    admission (launch when full OR when the oldest request's slack no
+    longer covers the EWMA dispatch estimate), per-request deadline
+    accounting, and the fail-open policy for unmeetable deadlines.
+  * `mesh_exec.MeshExecutor` — live dp×tp×sp mesh execution: shard the
+    rule tables on tp and each request batch on dp at serve time
+    (PINGOO_MESH; 1x1x1 keeps single-device behavior bit-identical).
+"""
+
+from .mesh_exec import MeshExecutor, MeshUnavailable, mesh_env_spec
+from .scheduler import (BATCH_SIZE_BUCKETS, CostModel, SchedMetrics,
+                        Scheduler, SchedulerConfig,
+                        seed_from_bench_history)
+
+__all__ = [
+    "BATCH_SIZE_BUCKETS",
+    "CostModel",
+    "MeshExecutor",
+    "MeshUnavailable",
+    "SchedMetrics",
+    "Scheduler",
+    "SchedulerConfig",
+    "mesh_env_spec",
+    "seed_from_bench_history",
+]

@@ -1,0 +1,139 @@
+"""Live mesh execution for the verdict engine (ISSUE 6 tentpole).
+
+The dp×tp×sp mesh (parallel/mesh.py) existed only as an offline dryrun
+(__graft_entry__.dryrun_multichip — MULTICHIP_r05: 8 devices, parity
+ok); this module promotes it into the SERVING path. At service startup
+both engine planes build a `MeshExecutor` from `PINGOO_MESH=dpxtpxsp`
+(default `1x1x1`, which is a strict no-op — single-device behavior and
+compiled programs are unchanged):
+
+  * the plan's pattern/word axes are padded to tp multiples
+    (parallel/mesh.pad_tables_for_tp; padding rows are inert by
+    construction, so verdicts are bit-identical),
+  * device tables are placed under `table_shardings` (rule/NFA-word
+    axes on tp, incl. the GSPMD halo exchange for multi-word spans
+    straddling a shard cut — compiler/nfa.py pack_span),
+  * each launched batch is placed under `batch_shardings` (request
+    axis on dp) before the jitted prefilter/verdict/lane programs run,
+    so XLA inserts the ICI collectives (scaling-book recipe: pick a
+    mesh, annotate, let the compiler do the rest).
+
+The executor is deliberately dumb about FAILURE: a spec needing more
+devices than the backend has raises `MeshUnavailable` at startup, and
+callers degrade to the single-device path (serve first, scale second —
+the same fail-open posture as the rest of the boot sequence). The
+per-plane `pingoo_mesh_devices` gauge reports what actually serves.
+
+`shard_batch` runs per batch between encode and dispatch — registered
+hot in the analyze-lint registries; it may only issue async
+`jax.device_put` placements, never a host sync.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..parallel.mesh import parse_mesh_spec
+
+
+class MeshUnavailable(RuntimeError):
+    """The configured mesh cannot be built on this backend."""
+
+
+def mesh_env_spec() -> tuple[int, int, int]:
+    """(dp, tp, sp) from PINGOO_MESH (default 1x1x1). Raises ValueError
+    on a malformed spec — callers at boot fail fast with the message
+    rather than silently serving unsharded."""
+    return parse_mesh_spec(os.environ.get("PINGOO_MESH", "1x1x1"))
+
+
+class MeshExecutor:
+    """Owns one plane's mesh + sharding placement for the serving path.
+
+    Inactive (dp*tp*sp == 1) executors are pure pass-throughs: every
+    method returns its input untouched and no jax symbol is imported,
+    so single-device serving pays nothing for the new layer.
+    """
+
+    def __init__(self, plan, spec: Optional[tuple[int, int, int]] = None,
+                 plane: str = "python", metrics=None):
+        if spec is None:
+            spec = mesh_env_spec()
+        self.dp, self.tp, self.sp = spec
+        self.plane = plane
+        self.devices = self.dp * self.tp * self.sp
+        self.mesh = None
+        self._batch_specs: dict = {}  # arrays signature -> shardings
+        if self.devices > 1:
+            import jax
+
+            from ..parallel.mesh import make_mesh, pad_tables_for_tp
+
+            have = len(jax.devices())
+            if have < self.devices:
+                raise MeshUnavailable(
+                    f"PINGOO_MESH={self.dp}x{self.tp}x{self.sp} needs "
+                    f"{self.devices} devices, backend has {have}")
+            if self.tp > 1:
+                # Pad pattern/word axes so rule tables shard evenly;
+                # padded rows are inert (can never match), so the
+                # compiled programs stay bit-identical. The plan keeps
+                # the padded tables: a co-resident plane reusing this
+                # plan builds the same shapes.
+                plan.np_tables = pad_tables_for_tp(plan.np_tables,
+                                                   tp=self.tp)
+            self.mesh = make_mesh(self.dp, self.tp, self.sp)
+        if metrics is not None:
+            metrics.mesh_devices.set(self.devices)
+
+    @property
+    def active(self) -> bool:
+        return self.mesh is not None
+
+    def align_batch(self, padded_size: int) -> int:
+        """Smallest launch size >= `padded_size` that shards evenly on
+        dp (GSPMD wants the batch axis divisible by the dp extent).
+        With the engine's pow2 padding and a pow2 dp this is the
+        identity."""
+        if self.dp <= 1:
+            return padded_size
+        rem = padded_size % self.dp
+        return padded_size if rem == 0 else padded_size + (self.dp - rem)
+
+    def place_tables(self, tables: dict) -> dict:
+        """Device tables -> mesh placement under table_shardings (tp on
+        the rule/word axes, replicate the rest). One-time at startup."""
+        if not self.active:
+            return tables
+        import jax
+
+        from ..parallel.mesh import table_shardings
+
+        specs = table_shardings(self.mesh, tables)
+        return {key: jax.device_put(val, specs[key])
+                for key, val in tables.items()}
+
+    def shard_batch(self, arrays: dict) -> dict:
+        """Batch pytree -> dp placement (request axis sharded). Runs per
+        batch on the hot path: device_put is an async transfer issue,
+        never a sync (lint-registered hot)."""
+        if not self.active:
+            return arrays
+        import jax
+
+        from ..parallel.mesh import batch_shardings
+
+        # Sharding specs depend only on array names/ranks; cache per
+        # signature so steady-state batches skip the spec rebuild.
+        sig = tuple(sorted(arrays))
+        specs = self._batch_specs.get(sig)
+        if specs is None:
+            specs = batch_shardings(self.mesh, arrays)
+            self._batch_specs[sig] = specs
+        return {key: jax.device_put(val, specs[key])
+                for key, val in arrays.items()}
+
+    def describe(self) -> dict:
+        return {"dp": self.dp, "tp": self.tp, "sp": self.sp,
+                "devices": self.devices, "active": self.active}

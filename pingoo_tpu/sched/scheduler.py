@@ -1,0 +1,311 @@
+"""Deadline-aware continuous-batching admission scheduler (ISSUE 6).
+
+The pre-scheduler serving path admitted work through FIXED batch-
+assembly windows: the Python collector waited `max_wait_us` after the
+first request of every batch, and the ring sidecar dispatched whatever
+one dequeue pass returned. Both couple latency to an arbitrary timer
+instead of to the thing the north star actually budgets — each
+request's remaining deadline slack (p99 < 2 ms end to end).
+
+This module is the plane-agnostic admission core both engine planes
+drive (engine/service.py collector, native_ring.RingSidecar drain):
+
+  * every request carries its ADMIT timestamp and a latency budget
+    (`PINGOO_DEADLINE_MS`, default the 2 ms north-star budget);
+  * the scheduler keeps filling the in-flight batch while the OLDEST
+    request's slack still covers the estimated dispatch+compute cost
+    of serving the batch — "launch when full OR slack <= estimate";
+  * the cost estimate is an EWMA per padded-batch-size bucket
+    (`CostModel`), seeded from bench history (`BENCH_history.jsonl`
+    p_batch_ms) so the very first batches after boot already launch
+    against a plausible cost instead of a blind timer;
+  * a request whose deadline is UNMEETABLE (remaining slack below the
+    estimate even if launched immediately) can fail open per
+    `PINGOO_SCHED_FAILOPEN`: `serve` (default — serve late, count the
+    miss), `allow` (resolve immediately with the fail-open verdict),
+    or `interpret` (evaluate on the host interpreter, off the device
+    path);
+  * every launch/resolve feeds the `pingoo_sched_*` metrics
+    (obs/schema.SCHED_METRICS) on the plane's label.
+
+`PINGOO_SCHED_MODE=fixed` keeps the legacy fixed-window assembly (the
+A/B arm `bench.py --mesh` measures against); `continuous` is the
+default. The admission loop and the EWMA update are registered hot in
+the analyze-lint registries (tools/analyze/lint_config.py): nothing
+here may allocate arrays or touch the device — it is pure float math
+on the collector/drain thread between dispatch and resolve.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+# The north-star latency budget (BASELINE.md: p99 added verdict
+# latency < 2 ms) is the default per-request deadline.
+DEFAULT_DEADLINE_MS = 2.0
+
+# Default EWMA smoothing for the per-bucket cost model: heavy enough to
+# converge within tens of batches after boot, light enough that one
+# GC-hiccup outlier cannot triple the estimate.
+DEFAULT_ALPHA = 0.2
+
+# Fallback seed when neither PINGOO_SCHED_SEED_MS nor a bench-history
+# entry is available: the measured full-batch verdict cost on a v5e
+# (bench.py p_batch_ms ~1.4 at B=2048).
+DEFAULT_SEED_MS = 1.5
+
+SCHED_MODES = ("continuous", "fixed")
+FAILOPEN_POLICIES = ("serve", "allow", "interpret")
+
+# pingoo_sched_batch_size histogram bounds: pow2 ladder matching the
+# padded launch sizes the engine actually compiles for.
+BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                      2048, 4096)
+
+
+@dataclass(frozen=True)
+class SchedulerConfig:
+    """Static admission policy for one plane's scheduler."""
+
+    mode: str = "continuous"
+    deadline_ms: float = DEFAULT_DEADLINE_MS
+    failopen: str = "serve"
+    max_batch: int = 1024
+
+    @classmethod
+    def from_env(cls, max_batch: int) -> "SchedulerConfig":
+        mode = os.environ.get("PINGOO_SCHED_MODE", "continuous")
+        if mode not in SCHED_MODES:
+            mode = "continuous"
+        try:
+            deadline_ms = float(
+                os.environ.get("PINGOO_DEADLINE_MS", DEFAULT_DEADLINE_MS))
+        except ValueError:
+            deadline_ms = DEFAULT_DEADLINE_MS
+        failopen = os.environ.get("PINGOO_SCHED_FAILOPEN", "serve")
+        if failopen not in FAILOPEN_POLICIES:
+            failopen = "serve"
+        return cls(mode=mode, deadline_ms=deadline_ms, failopen=failopen,
+                   max_batch=max_batch)
+
+
+def seed_from_bench_history(path: Optional[str] = None) -> Optional[float]:
+    """Newest usable `p_batch_ms` from BENCH_history.jsonl (bench.py
+    --history appends one JSON object per run). Best-effort: a missing
+    or corrupt history just returns None and the static seed applies.
+    Read back to front so the seed tracks the latest measurement."""
+    import json
+
+    path = path or os.environ.get("BENCH_HISTORY_FILE",
+                                  "BENCH_history.jsonl")
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            entry = json.loads(line)
+        except ValueError:
+            continue
+        val = entry.get("p_batch_ms")
+        if isinstance(val, (int, float)) and val > 0:
+            return float(val)
+    return None
+
+
+def _pow2_bucket(n: int, cap: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+class CostModel:
+    """EWMA per-batch-size dispatch-cost estimates (milliseconds).
+
+    Buckets follow the engine's pow2 batch padding — the cost of a
+    batch is a function of its PADDED size, which is what the XLA
+    program actually runs. Unobserved buckets fall back to an affine
+    seed (half fixed dispatch cost, half size-proportional), so the
+    model orders sizes sensibly before the first measurements land.
+
+    `observe` runs per batch on the collector/drain hot path
+    (registered in lint_config.HOT_FUNCTIONS): one dict probe and two
+    float ops, no arrays, no device access.
+    """
+
+    def __init__(self, max_batch: int = 1024,
+                 seed_ms: Optional[float] = None,
+                 alpha: float = DEFAULT_ALPHA):
+        self.max_batch = max(1, int(max_batch))
+        if seed_ms is None:
+            env = os.environ.get("PINGOO_SCHED_SEED_MS")
+            if env:
+                try:
+                    seed_ms = float(env)
+                except ValueError:
+                    seed_ms = None
+            if seed_ms is None:
+                seed_ms = seed_from_bench_history()
+            if seed_ms is None:
+                seed_ms = DEFAULT_SEED_MS
+        self.seed_ms = max(float(seed_ms), 1e-3)
+        self.alpha = float(alpha)
+        self._ewma: dict[int, float] = {}
+
+    def _seed_for(self, bucket: int) -> float:
+        cap = _pow2_bucket(self.max_batch, self.max_batch)
+        return self.seed_ms * (0.5 + 0.5 * bucket / cap)
+
+    def estimate(self, batch_size: int) -> float:
+        """Expected dispatch+compute wall (ms) for a batch whose padded
+        size covers `batch_size` rows."""
+        bucket = _pow2_bucket(max(1, batch_size), self.max_batch)
+        est = self._ewma.get(bucket)
+        if est is None:
+            return self._seed_for(bucket)
+        return est
+
+    def observe(self, batch_size: int, ms: float) -> None:
+        """EWMA update from one served batch's measured cost (hot)."""
+        if ms < 0:
+            return
+        bucket = _pow2_bucket(max(1, batch_size), self.max_batch)
+        prev = self._ewma.get(bucket)
+        if prev is None:
+            self._ewma[bucket] = ms
+        else:
+            self._ewma[bucket] = prev + self.alpha * (ms - prev)
+
+    def snapshot(self) -> dict:
+        return {"seed_ms": round(self.seed_ms, 4),
+                "ewma_ms": {b: round(v, 4)
+                            for b, v in sorted(self._ewma.items())}}
+
+
+class SchedMetrics:
+    """The plane's `pingoo_sched_*` instruments (obs/schema.py
+    SCHED_METRICS). Created eagerly so both planes expose the full
+    inventory from boot (zero-valued until traffic moves them)."""
+
+    def __init__(self, plane: str, registry=None):
+        if registry is None:
+            from ..obs import REGISTRY as registry  # noqa: N813
+        from ..obs import schema
+
+        labels = {"plane": plane}
+        self.queue_depth = registry.gauge(
+            "pingoo_sched_queue_depth",
+            schema.SCHED_METRICS["pingoo_sched_queue_depth"],
+            labels=labels)
+        self.batch_size = registry.histogram(
+            "pingoo_sched_batch_size",
+            schema.SCHED_METRICS["pingoo_sched_batch_size"],
+            buckets=BATCH_SIZE_BUCKETS, labels=labels)
+        self.deadline_miss = registry.counter(
+            "pingoo_sched_deadline_miss_total",
+            schema.SCHED_METRICS["pingoo_sched_deadline_miss_total"],
+            labels=labels)
+        self.failopen = registry.counter(
+            "pingoo_sched_failopen_total",
+            schema.SCHED_METRICS["pingoo_sched_failopen_total"],
+            labels=labels)
+        self.mesh_devices = registry.gauge(
+            "pingoo_mesh_devices",
+            schema.SCHED_METRICS["pingoo_mesh_devices"], labels=labels)
+        self.mesh_devices.set(1)
+
+
+class Scheduler:
+    """One plane's admission scheduler: launch-timing policy + deadline
+    accounting over the shared cost model.
+
+    All timestamps are `time.monotonic()` seconds on the Python plane;
+    the sidecar converts the ring's `enq_ms` clock before calling in.
+    The policy methods are pure float math (hot path — see module
+    docstring); the metrics sinks are O(1) registry instruments.
+    """
+
+    def __init__(self, config: SchedulerConfig, plane: str = "python",
+                 cost_model: Optional[CostModel] = None, registry=None):
+        self.config = config
+        self.plane = plane
+        self.cost = cost_model or CostModel(max_batch=config.max_batch)
+        self.metrics = SchedMetrics(plane, registry=registry)
+        self.launches = 0
+        self.deadline_misses = 0
+        self.failopens = 0
+
+    # -- launch policy (hot) -------------------------------------------------
+
+    def wait_budget_s(self, n_pending: int, oldest_admit_s: float,
+                      now_s: float) -> float:
+        """How much longer the plane may keep assembling this batch
+        (seconds, <= 0 means launch NOW): the oldest request's
+        remaining deadline slack minus the estimated cost of serving
+        the batch at its current size."""
+        if n_pending >= self.config.max_batch:
+            return 0.0
+        deadline_at = oldest_admit_s + self.config.deadline_ms / 1e3
+        est_s = self.cost.estimate(n_pending) / 1e3
+        return (deadline_at - now_s) - est_s
+
+    def should_launch(self, n_pending: int, oldest_admit_s: float,
+                      now_s: float) -> bool:
+        """Launch when full OR when the oldest request's slack no
+        longer covers the dispatch estimate."""
+        return (n_pending >= self.config.max_batch
+                or self.wait_budget_s(n_pending, oldest_admit_s,
+                                      now_s) <= 0.0)
+
+    def unmeetable(self, admit_s: float, now_s: float,
+                   batch_size: int) -> bool:
+        """True when this request's deadline cannot be met even by an
+        immediate launch — the fail-open trigger."""
+        deadline_at = admit_s + self.config.deadline_ms / 1e3
+        return now_s + self.cost.estimate(batch_size) / 1e3 > deadline_at
+
+    # -- accounting sinks ----------------------------------------------------
+
+    def note_launch(self, batch_size: int, queue_depth: int) -> None:
+        """One batch left admission for the device (hot)."""
+        self.launches += 1
+        self.metrics.batch_size.observe(batch_size)
+        self.metrics.queue_depth.set(queue_depth)
+
+    def note_resolved(self, admit_s: float, resolve_s: float) -> bool:
+        """Per-request deadline accounting at resolve time; returns
+        True when the request missed its deadline."""
+        missed = (resolve_s - admit_s) * 1e3 > self.config.deadline_ms
+        if missed:
+            self.deadline_misses += 1
+            self.metrics.deadline_miss.inc()
+        return missed
+
+    def note_misses(self, n: int) -> None:
+        """Batched deadline-miss accounting (the sidecar counts misses
+        with one vectorized compare per batch)."""
+        if n > 0:
+            self.deadline_misses += n
+            self.metrics.deadline_miss.inc(n)
+
+    def note_failopen(self, n: int = 1) -> None:
+        self.failopens += n
+        self.metrics.failopen.inc(n)
+
+    def observe_cost(self, batch_size: int, ms: float) -> None:
+        self.cost.observe(batch_size, ms)
+
+    def snapshot(self) -> dict:
+        return {
+            "mode": self.config.mode,
+            "deadline_ms": self.config.deadline_ms,
+            "failopen_policy": self.config.failopen,
+            "launches": self.launches,
+            "deadline_misses": self.deadline_misses,
+            "failopens": self.failopens,
+            "cost_model": self.cost.snapshot(),
+        }

@@ -16,6 +16,9 @@ LINT_DIRS = (
     # its hot functions are registered below so a bare host-device sync
     # there fails `make analyze`.
     "pingoo_tpu/obs",
+    # The admission scheduler + mesh executor (ISSUE 6) sit between
+    # the queues and the compiled programs on every batch.
+    "pingoo_tpu/sched",
 )
 
 # Never descend into these directory names, and never read non-.py
@@ -48,6 +51,17 @@ HOT_FUNCTIONS = frozenset({
     "pingoo_tpu/obs/provenance.py::ParityAuditor.submit_matrix",
     "pingoo_tpu/obs/provenance.py::ParityAuditor.submit_lanes",
     "pingoo_tpu/obs/flightrecorder.py::FlightRecorder.record",
+    # Continuous-batching scheduler (ISSUE 6): the launch policy and
+    # the EWMA cost update run per batch on the collector/drain
+    # threads between dispatch and resolve — pure float math, no
+    # arrays, and NEVER a host-device sync. The mesh executor's batch
+    # placement runs per batch too: async device_put issues only.
+    "pingoo_tpu/sched/scheduler.py::Scheduler.wait_budget_s",
+    "pingoo_tpu/sched/scheduler.py::Scheduler.should_launch",
+    "pingoo_tpu/sched/scheduler.py::Scheduler.note_launch",
+    "pingoo_tpu/sched/scheduler.py::CostModel.observe",
+    "pingoo_tpu/sched/scheduler.py::CostModel.estimate",
+    "pingoo_tpu/sched/mesh_exec.py::MeshExecutor.shard_batch",
 })
 
 # Functions traced by jax.jit that the AST cannot see are jitted (they

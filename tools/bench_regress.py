@@ -19,6 +19,10 @@ error. Tracked metrics and their directions:
     e2e_req_per_s        higher is better
     dataplane_req_per_s  higher is better
     blocklist_lookups_per_s  higher is better
+    sched_continuous_req_per_s  higher is better (ISSUE 6 serving bench)
+    sched_continuous_p99_ms     lower  is better
+    sched_p99_slack_ms          higher is better (deadline headroom)
+    sched_deadline_miss_rate    lower  is better
 
 Metrics missing from either run are skipped (partial/error lines are
 trajectory too, but only shared keys gate).
@@ -38,6 +42,11 @@ TRACKED = (
     ("e2e_req_per_s", True),
     ("dataplane_req_per_s", True),
     ("blocklist_lookups_per_s", True),
+    # Continuous-batching serving bench (ISSUE 6, bench.py --mesh).
+    ("sched_continuous_req_per_s", True),
+    ("sched_continuous_p99_ms", False),
+    ("sched_p99_slack_ms", True),
+    ("sched_deadline_miss_rate", False),
 )
 
 DEFAULT_THRESHOLD = 0.10

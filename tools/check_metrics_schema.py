@@ -100,6 +100,24 @@ def main() -> int:
             problems.append(f"native_ring.py: provenance wiring "
                             f"missing {symbol}")
 
+    # Continuous-batching scheduler + serving mesh (ISSUE 6): the
+    # metric-name literals live in sched/scheduler.py (shared by both
+    # engine planes; the mesh gauge is set through the same
+    # SchedMetrics bundle), and both planes must wire the Scheduler —
+    # the Python listener service and the ring sidecar each construct
+    # one, which is what makes the pingoo_sched_* series exist under
+    # both plane labels.
+    sched_src = _read("pingoo_tpu/sched/scheduler.py")
+    for name in schema.SCHED_METRICS:
+        if name not in sched_src:
+            problems.append(f"sched/scheduler.py: missing metric {name}")
+    for plane_src, label in ((service_src, "engine/service.py"),
+                             (sidecar_src, "native_ring.py")):
+        for symbol in ("Scheduler", "SchedulerConfig", "MeshExecutor"):
+            if symbol not in plane_src:
+                problems.append(
+                    f"{label}: scheduler wiring missing {symbol}")
+
     # Flight-recorder + explain endpoints: the Python listener serves
     # both; the native plane serves its own flightrecorder dump (the
     # C++ exposition is string literals, so the source is the schema).
@@ -125,8 +143,19 @@ def main() -> int:
                             **schema.RING_METRICS,
                             **schema.PREFILTER_METRICS,
                             **schema.PROVENANCE_METRICS,
-                            **schema.PARITY_METRICS}.items():
-        if name.endswith("_total"):
+                            **schema.PARITY_METRICS,
+                            **schema.SCHED_METRICS}.items():
+        if name == "pingoo_sched_batch_size":
+            # The one histogram in the sched family: lint it with its
+            # real pow2 bucket ladder.
+            from pingoo_tpu.sched import BATCH_SIZE_BUCKETS
+
+            hb = reg.histogram(name, help_text,
+                               buckets=BATCH_SIZE_BUCKETS,
+                               labels={"plane": "audit"})
+            for v in (1, 64, 2048, 100000):
+                hb.observe(v)
+        elif name.endswith("_total"):
             reg.counter(name, help_text, labels={"plane": "audit"}).inc()
         else:
             reg.gauge(name, help_text, labels={"plane": "audit"}).set(1)

@@ -220,6 +220,28 @@ def main() -> int:
                   "python: injected divergence observable via metrics")
             check("pingoo_rule_hits_total" in text,
                   "scrape carries per-rule attribution series")
+            # ISSUE 6: the continuous-batching scheduler + mesh gauge
+            # export on BOTH engine planes under identical names.
+            for plane in ("python", "sidecar"):
+                for name in ("pingoo_sched_queue_depth",
+                             "pingoo_sched_deadline_miss_total",
+                             "pingoo_sched_failopen_total",
+                             "pingoo_mesh_devices"):
+                    check(f'{name}{{plane="{plane}"}}' in text,
+                          f"{plane}: sched metric {name}")
+                check(f'pingoo_sched_batch_size_bucket{{le="1",'
+                      f'plane="{plane}"}}' in text,
+                      f"{plane}: sched batch-size histogram")
+            check(svc.sched.launches > 0,
+                  "python: scheduler drove live launches")
+            check(sidecar.sched.launches > 0,
+                  "sidecar: scheduler drove live launches")
+            check(svc.sched.metrics.mesh_devices.value == 1
+                  and sidecar.sched.metrics.mesh_devices.value == 1,
+                  "mesh gauge reports single-device serving (no "
+                  "PINGOO_MESH)")
+            check("sched" in payload["verdict"]["stages"],
+                  "python JSON: sched stage instrumented")
             # Flight recorder: the listener dumps every co-resident
             # plane; the injected divergence must appear in it with
             # full provenance.
