@@ -310,6 +310,119 @@ def bench_prefilter_modes(plan, tables, arrays, verdict_body,
     return out
 
 
+def bench_dfa_modes(plan, tables, arrays, verdict_body,
+                    iters: int = 30) -> dict:
+    """ISSUE 8: per-mode verdict throughput for the bitsplit-DFA
+    lowering (PINGOO_DFA=off|auto|force) with the same chained-salted-
+    loop method as the headline bench, plus the per-bank lowering
+    summary (state counts, exact vs approximate). Selects the fastest
+    mode into plan.dfa_default_mode (persisted by the caller via the
+    artifact cache) and writes the BENCH_dfa.json trajectory artifact.
+    The off mode is the PR 4 compact-cascade baseline, so
+    speedup_vs_off is the ISSUE 8 acceptance number."""
+    import jax
+    import jax.numpy as jnp
+
+    out: dict = {"modes": {}}
+    batch = int(arrays["asn"].shape[0])
+    prev = os.environ.get("PINGOO_DFA")
+    try:
+        for mode in ("off", "auto", "force"):
+            os.environ["PINGOO_DFA"] = mode
+
+            # Fresh jit per mode: the mode is read at trace time.
+            @jax.jit
+            def run_n(tables, arrays, n):
+                def body(i, acc):
+                    m = verdict_body(tables, arrays, (acc + i) % 2)
+                    return acc + m.sum().astype(jnp.int64)
+                return jax.lax.fori_loop(0, n, body, jnp.int64(0))
+
+            @jax.jit
+            def floor_loop(arrays, n):
+                def body(i, acc):
+                    return acc + arrays["asn"].sum() + i
+                return jax.lax.fori_loop(0, n, body, jnp.int64(0))
+
+            try:
+                t0 = time.time()
+                checksum = int(run_n(tables, arrays, 2))
+                int(floor_loop(arrays, 2))
+                compile_s = time.time() - t0
+                t0 = time.time()
+                int(floor_loop(arrays, iters))
+                floor = time.time() - t0
+                t0 = time.time()
+                checksum = int(run_n(tables, arrays, iters))
+                full = time.time() - t0
+            except Exception as exc:
+                out["modes"][mode] = {"error": repr(exc)[:200]}
+                continue
+            per_batch_s = max((full - floor) / iters, 1e-9)
+            out["modes"][mode] = {
+                "req_per_s": round(batch / per_batch_s, 1),
+                "p_batch_ms": round(per_batch_s * 1000, 3),
+                "compile_s": round(compile_s, 1),
+                "checksum": checksum,
+            }
+    finally:
+        if prev is None:
+            os.environ.pop("PINGOO_DFA", None)
+        else:
+            os.environ["PINGOO_DFA"] = prev
+
+    # Per-bank lowering summary (host-static, from the plan).
+    try:
+        banks = {}
+        for key, e in plan.scan_plans.items():
+            if not e.dfa_key or e.dfa_key not in plan.np_tables:
+                continue
+            dtab = plan.np_tables[e.dfa_key]
+            banks[key] = {
+                "states": int(dtab.num_states),
+                "classes": int(dtab.num_classes),
+                "exact": bool(dtab.exact),
+                "auto": bool(e.dfa_auto),
+            }
+        for key, dkey in getattr(plan, "win_dfa", {}).items():
+            if dkey not in plan.np_tables:
+                continue
+            dtab = plan.np_tables[dkey]
+            banks[key] = {
+                "states": int(dtab.num_states),
+                "classes": int(dtab.num_classes),
+                "exact": bool(dtab.exact),
+                # Window DFAs dispatch on the row-work-bound CPU
+                # backend under auto (engine/verdict._dfa_win_active).
+                "auto": "cpu-only",
+            }
+        out["banks"] = banks
+    except Exception as exc:
+        out["stats_error"] = repr(exc)[:200]
+
+    base = out["modes"].get("off", {}).get("req_per_s")
+    best_mode, best_rps = "off", base or 0
+    for mode, row in out["modes"].items():
+        rps = row.get("req_per_s")
+        if base:
+            row["speedup_vs_off"] = round(rps / base, 3) if rps else None
+        if rps and rps > best_rps:
+            best_mode, best_rps = mode, rps
+    out["selected"] = best_mode
+    plan.dfa_default_mode = best_mode
+
+    try:
+        with open("BENCH_dfa.json", "w") as f:
+            json.dump({
+                "metric": "bitsplit_dfa_modes",
+                "batch_size": batch,
+                **out,
+            }, f, indent=2)
+    except OSError:
+        pass
+    return out
+
+
 def _mesh_arg() -> str | None:
     """`--mesh dpxtpxsp` (or BENCH_MESH) selects the serving-mesh shape
     the scheduler bench runs under; None disables the bench unless
@@ -1034,6 +1147,26 @@ def _main_impl(result: dict, done=None) -> None:
                 update_cached_plan(rules, lists, plan, cache_dir)
         except Exception as exc:
             result["prefilter_error"] = repr(exc)[:200]
+    # Bitsplit-DFA lowering (ISSUE 8): off/auto/force A/B over the PR 4
+    # compact baseline; the fastest mode becomes the plan's default and
+    # rides the artifact cache like the prefilter selection above.
+    if "--dfa" in sys.argv or os.environ.get("BENCH_SKIP_DFA") != "1":
+        try:
+            dfa_res = bench_dfa_modes(
+                plan, tables, arrays, verdict_body,
+                iters=min(iters, int(os.environ.get(
+                    "BENCH_DFA_ITERS", "30"))))
+            result["dfa"] = dfa_res
+            auto_rps = dfa_res["modes"].get("auto", {}).get("req_per_s")
+            if auto_rps:
+                result["dfa_auto_req_per_s"] = auto_rps
+            cache_dir = os.environ.get("PINGOO_CACHE_DIR")
+            if cache_dir and dfa_res.get("selected"):
+                from pingoo_tpu.compiler.cache import update_cached_plan
+
+                update_cached_plan(rules, lists, plan, cache_dir)
+        except Exception as exc:
+            result["dfa_error"] = repr(exc)[:200]
     # Micro-autotune: replace the plan's default cost-model strategy
     # selection with MEASURED per-iteration costs, and persist the tuned
     # plan into the artifact cache when one is configured — runs on a

@@ -581,6 +581,371 @@ def build_bank(patterns: list[LinearPattern]) -> NfaBank:
     return bank
 
 
+# ---------------------------------------------------------------------------
+# Bitsplit-DFA lowering (ISSUE 8): subset-construct a bank's position
+# NFA into byte-indexed transition tables so the scan becomes one
+# [S, C]-row gather per byte instead of the dependent one-hot matmul
+# chain. Optional approximate state merging (quotient by bounded-depth
+# bisimulation signatures) shrinks the NFA *before* determinization;
+# merging only ever ADDS behavior (byte classes, successors, accepts
+# are unioned), so an approximate DFA over-approximates every slot:
+# candidates ⊇ matches, and the engine rechecks candidates against the
+# exact NFA (engine/verdict.py), mirroring prefilter prune-only
+# soundness. docs/DFA.md documents the pipeline.
+# ---------------------------------------------------------------------------
+
+DFA_STATE_BUDGET = 4096  # default PINGOO_DFA_STATES (clamped <= 65536)
+DFA_MERGE_DEPTHS = (8, 4, 2)  # default PINGOO_DFA_MERGE ladder
+
+
+@dataclass
+class DfaBank:
+    """Byte-indexed DFA tables for one field's pattern group.
+
+    Execution (ops/bitsplit_dfa.py):
+
+        H    |= step_accept[state]          # while t < len (sticky fire)
+        state = trans[state, byte_cls[c]]   # while t < len
+        ...
+        H |= end_accept[state_final]        # absolute-end accepts
+        hit[p] = (H[slot p's word] & slot mask) | always | (empty_ok & len==0)
+
+    State 0 is the dedicated start state (it alone carries the t==0
+    anchored injection) and is never a transition target; the empty
+    subset is interned separately as the dead/idle state. Sticky accept
+    accumulators are factored OUT of the subset state (they would
+    otherwise multiply reachable subsets by 2^latched) and fired into
+    the H accumulator via `step_accept` instead — `step_accept[Q]` is
+    the slot mask whose sticky bit the NEXT consumed byte would light
+    from subset Q, which is byte-independent because sticky bits match
+    every byte.
+    """
+
+    trans: np.ndarray        # [S, C] int32 (state, byte class) -> state
+    byte_cls: np.ndarray     # [256] int32 byte -> class id
+    step_accept: np.ndarray  # [S, Wh] uint32 sticky fire, read pre-step
+    end_accept: np.ndarray   # [S, Wh] uint32 read at the final state
+    slot_always: np.ndarray  # [P] bool
+    slot_empty_ok: np.ndarray  # [P] bool
+    num_states: int = 0
+    num_classes: int = 0
+    num_slots: int = 0
+    num_words: int = 0       # Wh = ceil(P / 32) accept words
+    exact: bool = True       # False -> over-approximation (recheck hits)
+    merge_depth: int = 0     # signature depth that produced the tables
+
+
+class _PosNfa:
+    """Flattened position NFA over one bank's expanded alternatives.
+
+    Non-sticky positions only; `succ[q]` / `inj_*` are bitmask ints over
+    positions, `fire*` / `end[q]` are bitmask ints over pattern slots.
+    """
+
+    def __init__(self) -> None:
+        self.bytes: list[frozenset[int]] = []
+        self.rep: list[bool] = []
+        self.succ: list[int] = []   # successors of q (shift + opt closure)
+        self.fire: list[int] = []   # slots whose sticky bit succ(q) feeds
+        self.end: list[int] = []    # slots accepting when q is active at end
+        self.inj_u = 0              # injected every step
+        self.inj_a = 0              # injected at t == 0 only
+        self.fire_u = 0             # sticky slots fed by per-step injection
+        self.fire_a = 0
+
+
+def _add_sub(nfa: _PosNfa, sub: _ScanPattern, slot: int) -> None:
+    n = len(sub.positions)
+    slot_bit = 1 << slot
+    base = len(nfa.bytes)
+
+    def closure(start: int) -> tuple[int, int]:
+        """(position mask, sticky-slot mask) reachable entering `start`:
+        start plus everything past a run of skippable positions; walking
+        past the last position reaches the sticky accumulator."""
+        mask = 0
+        i = start
+        while i < n:
+            mask |= 1 << (base + i)
+            if not _skippable(sub.positions[i]):
+                return mask, 0
+            i += 1
+        return mask, (slot_bit if sub.sticky else 0)
+
+    for i, pos in enumerate(sub.positions):
+        nfa.bytes.append(pos.bytes)
+        nfa.rep.append(_repeatable(pos))
+        smask, sfire = closure(i + 1)
+        if _repeatable(pos):
+            smask |= 1 << (base + i)
+        nfa.succ.append(smask)
+        nfa.fire.append(sfire)
+        nfa.end.append(slot_bit if i in sub.accept else 0)
+    imask, ifire = closure(0)
+    if sub.anchored:
+        nfa.inj_a |= imask
+        nfa.fire_a |= ifire
+    else:
+        nfa.inj_u |= imask
+        nfa.fire_u |= ifire
+
+
+def _bank_position_nfa(
+    patterns: list[LinearPattern],
+) -> tuple[_PosNfa, np.ndarray, np.ndarray]:
+    """Build the global position NFA + slot flag lanes for one bank.
+
+    Slot classification replicates build_bank() bit for bit so DFA slot
+    indices line up with the NfaBank's PatternSlot list.
+    """
+    P = len(patterns)
+    slot_always = np.zeros(P, dtype=bool)
+    slot_empty_ok = np.zeros(P, dtype=bool)
+    nfa = _PosNfa()
+    for p, lp in enumerate(patterns):
+        m = len(lp.positions)
+        ends = lp.anchor_end or lp.anchor_end_abs
+        always = lp.min_len == 0 and not (lp.anchor_start and ends)
+        empty_ok = lp.min_len == 0 and lp.anchor_start and ends
+        if lp.never_match:
+            continue
+        if always or (m == 0 and not (lp.anchor_start and lp.anchor_end)):
+            slot_always[p] = True
+            continue
+        subs = _expand_scan_patterns(lp)
+        need = sum(1 + len(s.positions) + (1 if s.sticky else 0)
+                   for s in subs)
+        slot_empty_ok[p] = empty_ok
+        if not subs or need == 0:
+            continue
+        for sub in subs:
+            _add_sub(nfa, sub, p)
+    return nfa, slot_always, slot_empty_ok
+
+
+def _bits(mask: int):
+    while mask:
+        lsb = mask & -mask
+        yield lsb.bit_length() - 1
+        mask ^= lsb
+
+
+def _merge_positions(nfa: _PosNfa, depth: int) -> tuple[_PosNfa, bool]:
+    """Quotient the position NFA by depth-`depth` signature classes.
+
+    Two positions share a class when their local attributes (self-loop,
+    accept/sticky slot masks, injection membership — NOT the byte set,
+    which is what gets over-approximated) agree and their successor
+    CLASS sets agree through `depth` refinement rounds — a bounded-depth
+    bisimulation. Distinctions propagate backward from accepting
+    positions, so depth k keeps roughly the last k positions before
+    each accept exact and merges (byte-unions) everything upstream: the
+    suffix-window approximation of the approximate-NFA blueprint. The
+    quotient unions every attribute over each class, so it simulates
+    the original: any accepting run maps to an accepting run of the
+    quotient, i.e. the merged automaton over-approximates every slot.
+    Returns (quotient, merged?) where merged is False when the
+    partition is trivial (exact)."""
+    N = len(nfa.bytes)
+    sigs: list = [
+        (nfa.rep[q], nfa.end[q], nfa.fire[q],
+         bool((nfa.inj_u >> q) & 1), bool((nfa.inj_a >> q) & 1))
+        for q in range(N)
+    ]
+    canon: dict = {}
+    ids = [canon.setdefault(s, len(canon)) for s in sigs]
+    for _ in range(depth):
+        canon = {}
+        nxt = [
+            canon.setdefault(
+                (ids[q], frozenset(ids[i] for i in _bits(nfa.succ[q]))),
+                len(canon))
+            for q in range(N)
+        ]
+        if nxt == ids:
+            break
+        ids = nxt
+    K = len(set(ids))
+    if K == N:
+        return nfa, False
+    # Renumber classes densely in first-member order (deterministic).
+    remap: dict[int, int] = {}
+    for q in range(N):
+        remap.setdefault(ids[q], len(remap))
+    ids = [remap[i] for i in ids]
+
+    def map_mask(mask: int) -> int:
+        out = 0
+        for q in _bits(mask):
+            out |= 1 << ids[q]
+        return out
+
+    q_nfa = _PosNfa()
+    q_nfa.bytes = [frozenset() for _ in range(K)]
+    q_nfa.rep = [False] * K
+    q_nfa.succ = [0] * K
+    q_nfa.fire = [0] * K
+    q_nfa.end = [0] * K
+    for q in range(N):
+        k = ids[q]
+        q_nfa.bytes[k] = q_nfa.bytes[k] | nfa.bytes[q]
+        q_nfa.rep[k] = q_nfa.rep[k] or nfa.rep[q]
+        q_nfa.succ[k] |= map_mask(nfa.succ[q])
+        q_nfa.fire[k] |= nfa.fire[q]
+        q_nfa.end[k] |= nfa.end[q]
+    q_nfa.inj_u = map_mask(nfa.inj_u)
+    q_nfa.inj_a = map_mask(nfa.inj_a)
+    q_nfa.fire_u = nfa.fire_u
+    q_nfa.fire_a = nfa.fire_a
+    return q_nfa, True
+
+
+def _slot_words(mask: int, Wh: int) -> list[int]:
+    return [(mask >> (32 * w)) & 0xFFFFFFFF for w in range(Wh)]
+
+
+def _determinize(
+    nfa: _PosNfa, num_slots: int, budget: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray] | None:
+    """Budget-bounded subset construction -> (trans, byte_cls,
+    step_accept, end_accept) or None when the subset count exceeds
+    `budget`."""
+    N = len(nfa.bytes)
+    # Byte -> class compression over position membership columns (the
+    # ops/nfa_scan class_compress idiom, on bitmask ints).
+    col = [0] * 256
+    for q, bs in enumerate(nfa.bytes):
+        bit = 1 << q
+        for b in bs:
+            col[b] |= bit
+    cls_of: dict[int, int] = {}
+    cls_masks: list[int] = []
+    byte_cls = np.zeros(256, dtype=np.int32)
+    for b in range(256):
+        cid = cls_of.get(col[b])
+        if cid is None:
+            cid = len(cls_masks)
+            cls_of[col[b]] = cid
+            cls_masks.append(col[b])
+        byte_cls[b] = cid
+    C = len(cls_masks)
+
+    # masks[0] is the start state (empty subset + anchored injection);
+    # interned subsets start at id 1, so a re-reached empty subset gets
+    # its own dead/idle id and never resurrects the t==0 injection.
+    masks: list[int] = [0]
+    ids: dict[int, int] = {}
+    trans_rows: list[list[int]] = []
+    fires: list[int] = []
+    ends: list[int] = []
+
+    def intern(mask: int) -> int:
+        sid = ids.get(mask)
+        if sid is None:
+            sid = len(masks)
+            ids[mask] = sid
+            masks.append(mask)
+        return sid
+
+    sid = 0
+    while sid < len(masks):
+        if sid == 0:
+            cand = nfa.inj_u | nfa.inj_a
+            fire = nfa.fire_u | nfa.fire_a
+            end = 0
+        else:
+            cand = nfa.inj_u
+            fire = nfa.fire_u
+            end = 0
+            for q in _bits(masks[sid]):
+                cand |= nfa.succ[q]
+                fire |= nfa.fire[q]
+                end |= nfa.end[q]
+        # One AND per class against the per-state candidate mask: the
+        # construction is O(S * (|Q| + C)), not O(S * C * |Q|).
+        row = [intern(cand & cm) for cm in cls_masks]
+        if len(masks) > budget:
+            return None
+        trans_rows.append(row)
+        fires.append(fire)
+        ends.append(end)
+        sid += 1
+
+    S = len(masks)
+    Wh = max(1, -(-num_slots // 32))
+    trans = np.asarray(trans_rows, dtype=np.int32).reshape(S, C)
+    step_accept = np.asarray(
+        [_slot_words(f, Wh) for f in fires], dtype=np.uint32)
+    end_accept = np.asarray(
+        [_slot_words(e, Wh) for e in ends], dtype=np.uint32)
+    return trans, byte_cls, step_accept, end_accept
+
+
+def _dfa_state_budget(state_budget: int | None) -> int:
+    import os
+
+    if state_budget is None:
+        try:
+            state_budget = int(
+                os.environ.get("PINGOO_DFA_STATES", DFA_STATE_BUDGET))
+        except ValueError:
+            state_budget = DFA_STATE_BUDGET
+    # 65536 keeps state ids exact through the Pallas f32 one-hot path.
+    return max(2, min(int(state_budget), 65536))
+
+
+def _dfa_merge_depths(merge_depths) -> tuple[int, ...]:
+    import os
+
+    if merge_depths is None:
+        env = os.environ.get("PINGOO_DFA_MERGE")
+        if env is None:
+            return DFA_MERGE_DEPTHS
+        try:
+            return tuple(int(x) for x in env.split(",") if x.strip())
+        except ValueError:
+            return DFA_MERGE_DEPTHS
+    return tuple(merge_depths)
+
+
+def lower_bank_to_dfa(
+    patterns: list[LinearPattern],
+    state_budget: int | None = None,
+    merge_depths: tuple[int, ...] | None = None,
+) -> DfaBank | None:
+    """Lower one bank's patterns to a bitsplit DFA, or None on blow-up.
+
+    Tries the exact subset construction first; when it exceeds the
+    state budget, retries after approximate merging at each depth in
+    `merge_depths` (finer first — deeper signatures merge less). Every
+    failure falls through; None means the caller keeps the NFA tables.
+    """
+    budget = _dfa_state_budget(state_budget)
+    depths = _dfa_merge_depths(merge_depths)
+    nfa, slot_always, slot_empty_ok = _bank_position_nfa(patterns)
+    if not nfa.bytes:
+        return None  # no device-state patterns: nothing to lower
+    P = len(patterns)
+    attempts: list[tuple[_PosNfa, bool, int]] = [(nfa, True, 0)]
+    for d in depths:
+        merged, did = _merge_positions(nfa, d)
+        if did:
+            attempts.append((merged, False, d))
+    for cand_nfa, exact, depth in attempts:
+        res = _determinize(cand_nfa, P, budget)
+        if res is None:
+            continue
+        trans, byte_cls, step_accept, end_accept = res
+        return DfaBank(
+            trans=trans, byte_cls=byte_cls, step_accept=step_accept,
+            end_accept=end_accept, slot_always=slot_always,
+            slot_empty_ok=slot_empty_ok, num_states=trans.shape[0],
+            num_classes=trans.shape[1], num_slots=P,
+            num_words=step_accept.shape[1], exact=exact,
+            merge_depth=depth)
+    return None
+
+
 def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarray:
     """Reference bitwise scan in numpy (same algebra as the JAX op).
 

@@ -74,7 +74,22 @@ DEFAULT_STEP_COSTS = {
     "pair": 1.3,        # lax.scan, one [C^2, 2W] gather per TWO bytes
     "pallas": 0.25,     # fused kernel, one fused lookup+advance per byte
     "pallas_pair": 0.35,  # fused kernel, two bytes per loop iteration
+    # Bitsplit DFA (ISSUE 8): one [S, C]-row gather per byte, ~4
+    # lane-ops/byte, no dependent matmul and no opt-propagation passes.
+    "dfa": 0.15,
 }
+
+DFA_KIND = "dfa"
+
+
+def _kind_cost(c: dict, kind: str, default: float = 1.0) -> float:
+    """Forward-compatible cost lookup: a measured/partial cost dict (or
+    a cached plan from a build that didn't know `kind` yet) falls back
+    to DEFAULT_STEP_COSTS, then to `default`, instead of KeyError-ing."""
+    v = c.get(kind)
+    if v is None:
+        v = DEFAULT_STEP_COSTS.get(kind, default)
+    return float(v)
 
 
 @dataclass(frozen=True)
@@ -118,6 +133,15 @@ class NfaScanPlan:
     rest_strategy: ScanStrategy | None = None
     slot_perm: tuple[int, ...] | None = None
     extended: bool = False  # footprint-extension rewrote the main bank
+    # Bitsplit-DFA lowering (ISSUE 8): when the bank subset-constructed
+    # within the state budget, `dfa_key` names its DfaTables in
+    # np_tables and `dfa_strategy` carries the modeled cost. `strategy`
+    # stays the best NON-DFA kind (the recheck/fallback path needs it);
+    # `dfa_auto` records whether the cost model prefers the DFA —
+    # PINGOO_DFA=auto honors it, =force overrides it per bank.
+    dfa_key: str | None = None
+    dfa_strategy: ScanStrategy | None = None
+    dfa_auto: bool = False
 
 
 def _pallas_ok() -> bool:
@@ -136,18 +160,27 @@ def select_scan_strategy(tables, costs: dict | None = None,
     cost model; iteration counts scale the pair variants by 1/2, so the
     ranking is independent of the (trace-time) field length. halo_k is
     eligibility metadata: halo re-checks profitability at trace time."""
-    c = dict(DEFAULT_STEP_COSTS)
-    c.update(costs or {})
+    c = dict(costs or {})
     if pallas_ok is None:
         pallas_ok = _pallas_ok()
-    cands = [("scan", False, c["scan"]), ("scan", True, c["pair"] / 2)]
+    cands = [("scan", False, _kind_cost(c, "scan")),
+             ("scan", True, _kind_cost(c, "pair") / 2)]
     if pallas_ok:
-        cands += [("pallas", False, c["pallas"]),
-                  ("pallas", True, c["pallas_pair"] / 2)]
+        cands += [("pallas", False, _kind_cost(c, "pallas")),
+                  ("pallas", True, _kind_cost(c, "pallas_pair") / 2)]
     kind, pair, cost = min(cands, key=lambda x: x[2])
     halo_k = 8 if tables.halo_ok else 1
     return ScanStrategy(kind=kind, pair=pair, halo_k=halo_k,
                         source=source, cost=cost)
+
+
+def select_dfa_strategy(costs: dict | None = None,
+                        source: str = "default") -> ScanStrategy:
+    """Strategy record for a lowered bank's bitsplit-DFA path. Same
+    per-byte normalization as select_scan_strategy's candidates (one
+    loop iteration consumes one byte, no pair variant)."""
+    return ScanStrategy(kind=DFA_KIND, pair=False, halo_k=1, source=source,
+                        cost=_kind_cost(costs or {}, DFA_KIND))
 
 
 def strategy_steps(tables, L: int, strat: ScanStrategy) -> int:
@@ -157,6 +190,10 @@ def strategy_steps(tables, L: int, strat: ScanStrategy) -> int:
     it."""
     from ..ops.nfa_scan import halo_split_k
 
+    if strat.kind == DFA_KIND:
+        # One [S, C]-row gather per byte: no opt-propagation passes, no
+        # pair variant — the dependent chain is exactly L steps.
+        return L
     passes = 1 + tables.extra_passes
     iters = (L + 1) // 2 if strat.pair else L
     if strat.halo_k > 1:
@@ -176,11 +213,22 @@ def _split_enabled() -> bool:
     return os.environ.get("PINGOO_NFA_SPLIT", "0") != "0"
 
 
+def _dfa_lower_enabled() -> bool:
+    """PINGOO_DFA_LOWER=0 is the compile-time kill switch: no DFA tables
+    are built at all (PINGOO_DFA=off merely skips them at trace time)."""
+    return os.environ.get("PINGOO_DFA_LOWER", "1") != "0"
+
+
 def split_config_token() -> str:
     """The plan-shaping env knobs, hashed into the artifact-cache
     fingerprint: plans built under different split settings have
     different np_tables layouts."""
-    return f"nfa_split={int(_split_enabled())}:fp={_halo_fp_budget()}"
+    from .nfa import _dfa_merge_depths, _dfa_state_budget
+
+    dfa = (f"dfa={int(_dfa_lower_enabled())}"
+           f":s={_dfa_state_budget(None)}"
+           f":m={','.join(str(d) for d in _dfa_merge_depths(None))}")
+    return f"nfa_split={int(_split_enabled())}:fp={_halo_fp_budget()}:{dfa}"
 
 
 def _halo_partition(patterns, field_len: int):
@@ -343,13 +391,21 @@ def reselect_scan_strategies(plan: "RulesetPlan",
     from bench.py's autotune hook) and update the plan in place. Callers
     persist via compiler.cache.update_cached_plan."""
     for key, entry in list(plan.scan_plans.items()):
-        kwargs = {"strategy": select_scan_strategy(
-            plan.np_tables[key], costs, source=source)}
+        strategy = select_scan_strategy(
+            plan.np_tables[key], costs, source=source)
+        kwargs = {"strategy": strategy}
         if entry.split:
             kwargs["short_strategy"] = select_scan_strategy(
                 plan.np_tables[entry.split[0]], costs, source=source)
             kwargs["rest_strategy"] = select_scan_strategy(
                 plan.np_tables[entry.split[1]], costs, source=source)
+        if entry.dfa_key is not None:
+            # Re-rank the DFA against the measured non-DFA best; the
+            # cost dict may predate the "dfa" kind (_kind_cost falls
+            # back to the model default instead of KeyError-ing).
+            dfa_strategy = select_dfa_strategy(costs, source=source)
+            kwargs["dfa_strategy"] = dfa_strategy
+            kwargs["dfa_auto"] = dfa_strategy.cost < strategy.cost
         plan.scan_plans[key] = dc_replace(entry, **kwargs)
 
 
@@ -393,6 +449,16 @@ class RulesetPlan:
     scan_plans: dict[str, NfaScanPlan] = dc_field(default_factory=dict)
     # Stage-A literal-prefilter metadata (None for factor-less rulesets)
     prefilter: Optional[PrefilterPlan] = None
+    # Bitsplit-DFA mode when the PINGOO_DFA env override is unset
+    # (off|auto|force); bench.py's --dfa arm records the measured best
+    # and persists it through compiler.cache.update_cached_plan.
+    dfa_default_mode: str = "auto"
+    # Lowered MXU window banks (ISSUE 8): "win_<field>" ->
+    # "dfa_win_<field>" in np_tables. The window conv is serial-free on
+    # the MXU, so the DFA replaces it only where per-row work dominates
+    # (CPU diagnostic backend under auto, any backend under force) —
+    # engine/verdict._dfa_win_active.
+    win_dfa: dict[str, str] = dc_field(default_factory=dict)
 
     def device_tables(self) -> dict[str, Any]:
         """Materialize all tables as device arrays (a pytree)."""
@@ -510,6 +576,14 @@ def compile_ruleset(
                               if pf else 0),
         "prefilter_gated_banks": (sum(1 for g in pf.bank_gated.values() if g)
                                   if pf else 0),
+        "dfa_banks": sum(
+            1 for e in plan.scan_plans.values() if e.dfa_key)
+        + len(plan.win_dfa),
+        "dfa_states_total": sum(
+            plan.np_tables[e.dfa_key].num_states
+            for e in plan.scan_plans.values() if e.dfa_key)
+        + sum(plan.np_tables[k].num_states
+              for k in plan.win_dfa.values()),
     }
     return plan
 
@@ -599,6 +673,22 @@ def _assemble_tables(plan: RulesetPlan) -> None:
             split_idx = _plan_nfa_bank(plan, field, patterns)
         if win_patterns:
             plan.np_tables[f"win_{field}"] = build_window_table(win_patterns)
+            # Bitsplit-DFA lowering of the WINDOW bank (ISSUE 8): the
+            # window slots' source LinearPatterns are fixed-shape
+            # literal-ish, so the subset construction is small (an
+            # Aho-Corasick-style multi-literal DFA) and almost always
+            # exact. The conv table stays — it is the serial-free MXU
+            # path and the recheck/fallback — the DFA replaces it only
+            # where row work dominates (engine/verdict._dfa_win_active).
+            if _dfa_lower_enabled():
+                from .nfa import lower_bank_to_dfa
+                from ..ops.bitsplit_dfa import dfa_to_tables
+
+                win_dfa_bank = lower_bank_to_dfa(win_srcs)
+                if win_dfa_bank is not None:
+                    plan.np_tables[f"dfa_win_{field}"] = \
+                        dfa_to_tables(win_dfa_bank)
+                    plan.win_dfa[f"win_{field}"] = f"dfa_win_{field}"
         # Stage-A factor pass covers BOTH of the field's scan banks (the
         # serial NFA bank and the MXU window bank) from one shared
         # factor table; factors come from the ORIGINAL patterns (any
@@ -680,6 +770,27 @@ def _plan_nfa_bank(plan: RulesetPlan, field: str,
                 extended = True
     plan.np_tables[key] = tables
 
+    # Bitsplit-DFA lowering (ISSUE 8): subset-construct the WHOLE bank
+    # when it fits the state budget (exact first, then the approximate
+    # merge ladder; compiler/nfa.lower_bank_to_dfa). The ORIGINAL
+    # patterns are lowered — a footprint-extension rewrite above is
+    # match-equivalent over the field's device byte cap, so per-slot
+    # semantics line up. The @short/@rest halo partition keeps the NFA
+    # path; the DFA dispatch in engine/verdict.py only takes the
+    # non-split whole-bank branch.
+    dfa_key = None
+    dfa_strategy = None
+    dfa_auto = False
+    if _dfa_lower_enabled():
+        from .nfa import lower_bank_to_dfa
+        from ..ops.bitsplit_dfa import dfa_to_tables
+
+        dfa_bank = lower_bank_to_dfa(patterns)
+        if dfa_bank is not None:
+            dfa_key = f"dfa_{field}"
+            plan.np_tables[dfa_key] = dfa_to_tables(dfa_bank)
+            dfa_strategy = select_dfa_strategy()
+
     split = None
     short_strategy = rest_strategy = None
     slot_perm = None
@@ -701,13 +812,19 @@ def _plan_nfa_bank(plan: RulesetPlan, field: str,
             split = (f"{key}@short", f"{key}@rest")
             short_strategy = select_scan_strategy(short_tables)
             rest_strategy = select_scan_strategy(rest_tables)
+    strategy = select_scan_strategy(tables)
+    if dfa_strategy is not None:
+        dfa_auto = dfa_strategy.cost < strategy.cost
     plan.scan_plans[key] = NfaScanPlan(
         key=key,
-        strategy=select_scan_strategy(tables),
+        strategy=strategy,
         split=split,
         short_strategy=short_strategy,
         rest_strategy=rest_strategy,
         slot_perm=slot_perm,
         extended=extended,
+        dfa_key=dfa_key,
+        dfa_strategy=dfa_strategy,
+        dfa_auto=dfa_auto,
     )
     return split_idx

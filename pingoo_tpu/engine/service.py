@@ -172,11 +172,15 @@ class ServiceStats:
     # Literal-prefilter cascade counters (docs/PREFILTER.md).
     prefilter_candidate_rate: float = 0.0
     scan_banks_skipped: int = 0
+    # Bitsplit-DFA dispatch counters (docs/DFA.md) — host-static per
+    # plan+env, folded once per device batch.
+    dfa_banks: int = 0
+    dfa_rechecks: int = 0
 
     def __post_init__(self):
         from ..obs import REGISTRY
         from ..obs.registry import LATENCY_BUCKETS_MS, WAIT_BUCKETS_MS
-        from ..obs.schema import PREFILTER_METRICS, VERDICT_STAGES
+        from ..obs.schema import DFA_METRICS, PREFILTER_METRICS, VERDICT_STAGES
 
         self.wait_hist = REGISTRY.histogram(
             "pingoo_verdict_wait_ms",
@@ -196,6 +200,16 @@ class ServiceStats:
         self.pf_skip_counter = REGISTRY.counter(
             "pingoo_scan_banks_skipped_total",
             PREFILTER_METRICS["pingoo_scan_banks_skipped_total"],
+            labels={"plane": "python"})
+        self.dfa_banks_counter = {
+            mode: REGISTRY.counter(
+                "pingoo_dfa_banks_total",
+                DFA_METRICS["pingoo_dfa_banks_total"],
+                labels={"plane": "python", "mode": mode})
+            for mode in ("auto", "force")}
+        self.dfa_recheck_counter = REGISTRY.counter(
+            "pingoo_dfa_recheck_total",
+            DFA_METRICS["pingoo_dfa_recheck_total"],
             labels={"plane": "python"})
 
     def observe_stage(self, stage: str, ms: float, n: int = 1) -> None:
@@ -218,6 +232,8 @@ class ServiceStats:
             "prefilter_candidate_rate": round(
                 self.prefilter_candidate_rate, 4),
             "scan_banks_skipped": self.scan_banks_skipped,
+            "dfa_banks": self.dfa_banks,
+            "dfa_rechecks": self.dfa_rechecks,
             "verdict_p50_ms": self.wait_hist.percentile(0.50),
             "verdict_p99_ms": self.wait_hist.percentile(0.99),
             "stages": {
@@ -804,6 +820,7 @@ class VerdictService:
                         "device_compute", ms, stages))[:n]
                 if pf_aux is not None:
                     self._observe_prefilter(pf_aux, fast.size)
+                self._observe_dfa()
             except Exception:
                 self.stats.device_errors += 1
         if matched is None:
@@ -831,6 +848,26 @@ class VerdictService:
         if self._pf_attr is not None:
             # Per-bank candidate-rate/skip attribution (ISSUE 5).
             self._pf_attr.observe(vals, batch_rows)
+
+    def _observe_dfa(self) -> None:
+        """Bitsplit-DFA dispatch accounting (obs/schema.py DFA_METRICS):
+        how many banks this batch ran through a lowered DFA under the
+        resolved PINGOO_DFA mode, and how many of those took the
+        approximate-lowering recheck path. Host-static per plan+env
+        (engine/verdict.dfa_dispatch_counts), so this never waits on the
+        device."""
+        from .verdict import dfa_dispatch_counts
+
+        mode, banks, rechecks = dfa_dispatch_counts(self.plan)
+        if not banks:
+            return
+        self.stats.dfa_banks += banks
+        self.stats.dfa_rechecks += rechecks
+        ctr = self.stats.dfa_banks_counter.get(mode)
+        if ctr is not None:
+            ctr.inc(banks)
+        if rechecks:
+            self.stats.dfa_recheck_counter.inc(rechecks)
 
     def _rewrite_overflow_rows(self, reqs, batch, matched: np.ndarray):
         """Rows whose fields exceeded device capacity are re-evaluated on

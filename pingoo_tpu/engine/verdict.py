@@ -45,6 +45,7 @@ from ..compiler.lowering import (
 from ..compiler.plan import NfaScanPlan, RulesetPlan, ScanStrategy
 from ..config.schema import Action
 from ..expr import execute_as_bool
+from ..ops.bitsplit_dfa import dfa_row_candidates, dfa_scan, dfa_skip_hits
 from ..ops.cidr import cidr_contains, int_set_contains, v4_buckets_contains
 from ..ops.match_ops import eq_match, prefix_match, suffix_match
 from ..ops.nfa_scan import (extract_slots, halo_split_k, halo_split_scan,
@@ -134,6 +135,90 @@ def _resolve_pf_mode(plan: RulesetPlan) -> str:
 
 def _pf_backend() -> str | None:
     return _os.environ.get("PINGOO_PREFILTER_KERNEL") or None
+
+
+# -- bitsplit-DFA lowering dispatch (compiler/nfa.lower_bank_to_dfa) ----------
+#
+# PINGOO_DFA (read per trace; the plan's dfa_default_mode applies when
+# unset):
+#   off   — always run the NFA tables (the parity baseline).
+#   auto  — use the lowered DFA for a bank when the cost model (or the
+#           bench.py micro-autotune) selected it (entry.dfa_auto) and no
+#           PINGOO_SCAN_STRATEGY override pins the NFA backend.
+#   force — use the DFA for every bank that lowered within budget.
+# PINGOO_DFA_KERNEL=pallas routes the byte ladder through the fused
+# kernel (ops/bitsplit_dfa._fused_dfa). An EXACT DFA replaces the NFA
+# scan outright (bit-identical by construction — tests/test_bitsplit_dfa
+# proves parity). An APPROXIMATE DFA (merged states) is gate-only: its
+# hits over-approximate per-slot matches, so candidate rows are
+# rechecked through the exact NFA bank via the compact argsort-gather
+# ladder and pruned rows take the skip base — prefilter prune-only
+# soundness, one level deeper.
+
+
+def _resolve_dfa_mode(plan: RulesetPlan) -> str:
+    mode = _os.environ.get("PINGOO_DFA", "") \
+        or getattr(plan, "dfa_default_mode", "auto")
+    return mode if mode in ("off", "auto", "force") else "auto"
+
+
+def _dfa_backend() -> str | None:
+    return _os.environ.get("PINGOO_DFA_KERNEL") or None
+
+
+def _dfa_bank_active(plan: RulesetPlan, entry, mode: str) -> bool:
+    """Host-static: does this bank run its lowered DFA under `mode`?
+    Split banks keep their per-sub-bank NFA strategies (the partition
+    already beat the whole-bank scan, and slot recombination happens on
+    NFA hits), so lowering only dispatches on non-split entries."""
+    if mode == "off" or entry.split is not None:
+        return False
+    if not entry.dfa_key or entry.dfa_key not in plan.np_tables:
+        return False
+    if mode == "force":
+        return True
+    return bool(entry.dfa_auto) \
+        and not _os.environ.get("PINGOO_SCAN_STRATEGY")
+
+
+def _dfa_win_active(plan: RulesetPlan, key: str, mode: str) -> bool:
+    """Whether window bank `key` dispatches through its lowered DFA.
+
+    The window conv is deliberately serial-free on the MXU (its whole
+    reason to exist — ops/window_match.py), so `auto` only swaps in the
+    DFA gather ladder where per-row work dominates the per-step
+    dependency chain: the CPU diagnostic backend. `force` takes it
+    everywhere (parity/bench A/B)."""
+    dkey = getattr(plan, "win_dfa", {}).get(key)
+    if not dkey or dkey not in plan.np_tables or mode == "off":
+        return False
+    if mode == "force":
+        return True
+    import jax
+
+    return jax.default_backend() == "cpu"
+
+
+def dfa_dispatch_counts(plan: RulesetPlan) -> tuple[str, int, int]:
+    """(resolved mode, banks running their DFA, approx banks taking the
+    exact-NFA recheck path) — host-static per plan+env, counted once per
+    batch by the service metrics (pingoo_dfa_banks_total{mode=} /
+    pingoo_dfa_recheck_total)."""
+    mode = _resolve_dfa_mode(plan)
+    banks = recheck = 0
+    for entry in getattr(plan, "scan_plans", {}).values():
+        if not _dfa_bank_active(plan, entry, mode):
+            continue
+        banks += 1
+        if not plan.np_tables[entry.dfa_key].exact:
+            recheck += 1
+    for key, dkey in getattr(plan, "win_dfa", {}).items():
+        if not _dfa_win_active(plan, key, mode):
+            continue
+        banks += 1
+        if not plan.np_tables[dkey].exact:
+            recheck += 1
+    return mode, banks, recheck
 
 
 def _pf_compact_sizes(B: int) -> list[int]:
@@ -264,6 +349,7 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B, pf_hits=None):
 
     pf = getattr(plan, "prefilter", None)
     pf_mode = _resolve_pf_mode(plan)
+    dfa_mode = _resolve_dfa_mode(plan)
     pf_field_hits: dict[str, Any] = dict(pf_hits or {})
 
     def field_pf(field):
@@ -353,23 +439,70 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B, pf_hits=None):
             lambda d, l: bank_hits(bank, strat, d, l),
             lambda: bank_skip_result(bank, lens))
 
+    def dfa_cascade_hits(key, dtab, data, lens, recheck_rows,
+                         recheck_base):
+        """One lowered bank's [B, P] hits via its bitsplit DFA.
+
+        Exact DFA: a drop-in replacement for the bank's scan that rides
+        the full prefilter cascade unchanged (cond-skip in banks mode,
+        argsort-gather compaction in compact mode; the skip base is the
+        DFA's own zero-input result — start-state accepts cover the
+        always/empty lanes). Approximate DFA: the gather ladder itself
+        rides the cascade (compacted onto Stage-A candidate rows —
+        sparse end-to-end, the skip base makes pruned rows trivially
+        non-candidates), then rows with any non-trivial hit are
+        rechecked through the bank's EXACT scan (NFA tables / window
+        conv) via a second, smaller compact ladder; pruned rows take
+        the exact skip base. Either way the verdict is bit-identical to
+        PINGOO_DFA=off (tests/test_bitsplit_dfa)."""
+        dfa_rows = lambda d, l: dfa_scan(dtab, d, l,
+                                         backend=_dfa_backend())
+        dfa_base = lambda: dfa_skip_hits(dtab, lens)
+        if dtab.exact:
+            return gated_scan(key, data, lens, dfa_rows, dfa_base)
+        hits = gated_scan(key, data, lens, dfa_rows, dfa_base)
+        cand = dfa_row_candidates(dtab, hits, lens)
+        pf_cand = bank_candidates(key, data.shape[0])
+        if pf_cand is not None:
+            cand = cand & pf_cand
+        return compact_rows(recheck_rows, recheck_base, data, lens,
+                            cand)
+
+    def dfa_bank_hits(key, entry, bank, data, lens):
+        strat = _resolve_strategy(entry.strategy)
+        return dfa_cascade_hits(
+            key, tables[entry.dfa_key], data, lens,
+            lambda d, l: bank_hits(bank, strat, d, l),
+            lambda: bank_skip_result(bank, lens))
+
     def gated_window_hits(key, field):
         """The window bank under the same cascade: a gated win bank's
         slots are all factor-gated or never-match, so the skip base is
         simply all-False (window patterns carry no always/empty lanes
-        once gating eligibility excludes min_len == 0 sources)."""
+        once gating eligibility excludes min_len == 0 sources). When
+        the bank's source patterns lowered to a bitsplit DFA and the
+        dispatch mode takes it (_dfa_win_active: force anywhere, auto
+        on the row-work-bound CPU backend), the gather ladder replaces
+        the conv — guarded on slot-count agreement so the tp mesh path
+        (which pads the conv table's pattern axis but not DfaTables)
+        falls back to the conv."""
         data = arrays[f"{field}_bytes"]
         lens = arrays[f"{field}_len"]
-        if pf is None or key not in pf.slot_codes:
-            return window_hits(tables[key], data, lens)
         # P from the TABLE, not the plan: the tp mesh path pads the
         # pattern axis (parallel/mesh.pad_tables_for_tp) and pad rows
         # never match, so all-False covers them too.
         P = tables[key].kernel.shape[0]
-        return gated_scan(
-            key, data, lens,
-            lambda d, l: window_hits(tables[key], d, l),
-            lambda: jnp.zeros((data.shape[0], P), dtype=bool))
+        win_rows = lambda d, l: window_hits(tables[key], d, l)
+        win_base = lambda: jnp.zeros((data.shape[0], P), dtype=bool)
+        dkey = getattr(plan, "win_dfa", {}).get(key)
+        if dkey and dkey in tables \
+                and _dfa_win_active(plan, key, dfa_mode) \
+                and tables[dkey].num_slots == P:
+            return dfa_cascade_hits(key, tables[dkey], data, lens,
+                                    win_rows, win_base)
+        if pf is None or key not in pf.slot_codes:
+            return win_rows(data, lens)
+        return gated_scan(key, data, lens, win_rows, win_base)
 
     def run_packed_scans(groups: dict[str, tuple[str, list]]) -> None:
         """Run every NFA bank through its plan-selected strategy
@@ -394,6 +527,13 @@ def _eval_leaves(plan: RulesetPlan, tables, arrays, B, pf_hits=None):
                                      data, lens)], axis=1)
                 perm = jnp.asarray(entry.slot_perm, dtype=jnp.int32)
                 nfa_cache[key] = jnp.take(hits, perm, axis=1)
+                continue
+            if _dfa_bank_active(plan, entry, dfa_mode) \
+                    and entry.dfa_key in tables \
+                    and tables[entry.dfa_key].num_slots \
+                        == tables[key].accept_member.shape[1]:
+                nfa_cache[key] = dfa_bank_hits(key, entry, tables[key],
+                                               data, lens)
                 continue
             strat = _resolve_strategy(entry.strategy)
             if strat.source != "env" and SCAN_PACK_MODE != "field":

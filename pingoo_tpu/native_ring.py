@@ -596,6 +596,21 @@ class RingSidecar:
             "pingoo_scan_banks_skipped_total",
             PREFILTER_METRICS["pingoo_scan_banks_skipped_total"],
             labels={"plane": "sidecar"})
+        # Bitsplit-DFA dispatch accounting (docs/DFA.md): same series
+        # the Python listener plane exports, host-static per plan+env
+        # (engine/verdict.dfa_dispatch_counts), folded once per batch.
+        from .obs.schema import DFA_METRICS
+
+        self._dfa_banks_counter = {
+            mode: REGISTRY.counter(
+                "pingoo_dfa_banks_total",
+                DFA_METRICS["pingoo_dfa_banks_total"],
+                labels={"plane": "sidecar", "mode": mode})
+            for mode in ("auto", "force")}
+        self._dfa_recheck_counter = REGISTRY.counter(
+            "pingoo_dfa_recheck_total",
+            DFA_METRICS["pingoo_dfa_recheck_total"],
+            labels={"plane": "sidecar"})
         # Attribution lanes + flight recorder + shadow-parity auditor
         # for the native plane's verdict engine (this drain loop).
         self._attribution = None
@@ -858,6 +873,15 @@ class RingSidecar:
             self._pf_skip_counter.inc(int(vals[1]))
             if self._pf_attr is not None:
                 self._pf_attr.observe(vals, self.max_batch)
+        from .engine.verdict import dfa_dispatch_counts
+
+        dfa_mode, dfa_banks, dfa_rechecks = dfa_dispatch_counts(self.plan)
+        if dfa_banks:
+            ctr = self._dfa_banks_counter.get(dfa_mode)
+            if ctr is not None:
+                ctr.inc(dfa_banks)
+            if dfa_rechecks:
+                self._dfa_recheck_counter.inc(dfa_rechecks)
         t_resolve = time.monotonic()
         self.batches += 1
         unverified, verified_block = merge_lanes(dev_lanes, host)

@@ -63,6 +63,23 @@ PREFILTER_METRICS = {
         "any of the bank's necessary literal factors",
 }
 
+# Bitsplit-DFA lowering metrics (ISSUE 8, docs/DFA.md): exported by
+# every plane that runs the batched verdict engine (plane="python"
+# listener service, plane="sidecar" ring drainer). Both are host-static
+# per plan+env — counted once per batch from the plan's scan_plans and
+# the resolved PINGOO_DFA mode (engine/verdict.dfa_dispatch_counts),
+# not from device results. `pingoo_dfa_banks_total` carries a `mode`
+# label (auto | force) naming how the dispatch was selected.
+DFA_METRICS = {
+    "pingoo_dfa_banks_total":
+        "NFA bank evaluations dispatched to a lowered bitsplit DFA "
+        "(mode label: auto = cost-model selected, force = env pinned)",
+    "pingoo_dfa_recheck_total":
+        "DFA bank dispatches that took the approximate-lowering path "
+        "(merged states) and rechecked candidate rows through the "
+        "exact NFA bank",
+}
+
 # Verdict-provenance metrics (ISSUE 5, docs/OBSERVABILITY.md
 # Provenance/Parity sections): exported by every plane that runs the
 # batched verdict engine (plane="python" listener service,
@@ -169,6 +186,7 @@ NATIVE_JSON_KEYS = {
 
 def all_metric_names() -> set[str]:
     return (set(SHARED_METRICS) | set(RING_METRICS) | set(NATIVE_METRICS)
-            | set(PREFILTER_METRICS) | set(PROVENANCE_METRICS)
+            | set(PREFILTER_METRICS) | set(DFA_METRICS)
+            | set(PROVENANCE_METRICS)
             | set(PARITY_METRICS) | set(SCHED_METRICS)
             | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

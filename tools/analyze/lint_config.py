@@ -38,6 +38,9 @@ HOT_FUNCTIONS = frozenset({
     "pingoo_tpu/engine/service.py::VerdictService._evaluate_with_scores",
     "pingoo_tpu/engine/service.py::VerdictService._run_batch",
     "pingoo_tpu/engine/service.py::VerdictService._observe_prefilter",
+    # Bitsplit-DFA dispatch accounting (ISSUE 8): host-static counter
+    # folds per batch — pure int math, no arrays, never a device sync.
+    "pingoo_tpu/engine/service.py::VerdictService._observe_dfa",
     "pingoo_tpu/engine/verdict.py::finish_batch",
     "pingoo_tpu/engine/verdict.py::merge_lanes",
     # Verdict provenance (ISSUE 5): the attribution fold runs per batch
@@ -78,6 +81,10 @@ TRACED_FUNCTIONS = frozenset({
     # verdict/lane programs and from make_prefilter_fn.
     "pingoo_tpu/ops/prefilter.py::prefilter_scan",
     "pingoo_tpu/ops/prefilter.py::_fused_prefilter",
+    # Bitsplit-DFA byte ladder (ISSUE 8): traced from the verdict
+    # program's bank dispatch (engine/verdict run_packed_scans).
+    "pingoo_tpu/ops/bitsplit_dfa.py::dfa_scan",
+    "pingoo_tpu/ops/bitsplit_dfa.py::_fused_dfa",
 })
 
 # The explicit blessing list for block_until_ready: the ONE deliberate

@@ -23,6 +23,7 @@ error. Tracked metrics and their directions:
     sched_continuous_p99_ms     lower  is better
     sched_p99_slack_ms          higher is better (deadline headroom)
     sched_deadline_miss_rate    lower  is better
+    dfa_auto_req_per_s   higher is better (ISSUE 8 bitsplit-DFA arm)
 
 Metrics missing from either run are skipped (partial/error lines are
 trajectory too, but only shared keys gate).
@@ -47,6 +48,8 @@ TRACKED = (
     ("sched_continuous_p99_ms", False),
     ("sched_p99_slack_ms", True),
     ("sched_deadline_miss_rate", False),
+    # Bitsplit-DFA lowering A/B (ISSUE 8, bench.py --dfa).
+    ("dfa_auto_req_per_s", True),
 )
 
 DEFAULT_THRESHOLD = 0.10

@@ -83,6 +83,16 @@ def main() -> int:
         if name not in sidecar_src:
             problems.append(f"native_ring.py: missing metric {name}")
 
+    # Bitsplit-DFA dispatch metrics (ISSUE 8): like the prefilter
+    # family, both engine planes must export the documented names (the
+    # counts themselves are host-static, engine/verdict
+    # dfa_dispatch_counts).
+    for name in schema.DFA_METRICS:
+        if name not in service_src:
+            problems.append(f"engine/service.py: missing metric {name}")
+        if name not in sidecar_src:
+            problems.append(f"native_ring.py: missing metric {name}")
+
     # Verdict provenance (ISSUE 5): the metric-name literals live in
     # obs/provenance.py + obs/flightrecorder.py (shared by both engine
     # planes), so check those sources for the names and both plane
@@ -142,6 +152,7 @@ def main() -> int:
     for name, help_text in {**schema.SHARED_METRICS,
                             **schema.RING_METRICS,
                             **schema.PREFILTER_METRICS,
+                            **schema.DFA_METRICS,
                             **schema.PROVENANCE_METRICS,
                             **schema.PARITY_METRICS,
                             **schema.SCHED_METRICS}.items():
@@ -166,6 +177,8 @@ def main() -> int:
         "plane": "audit", "rule": 'r"quoted\\rule'}).inc()
     reg.gauge("pingoo_prefilter_bank_candidate_rate", "", labels={
         "plane": "audit", "bank": "nfa_url@short"}).set(0.5)
+    reg.counter("pingoo_dfa_banks_total", "", labels={
+        "plane": "audit", "mode": "auto"}).inc()
     h = reg.histogram(schema.SHARED_WAIT_HISTOGRAM, "wait",
                       buckets=WAIT_BUCKETS_MS, labels={"plane": "audit"})
     for v in (0.5, 3, 70, 2000):

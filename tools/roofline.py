@@ -105,9 +105,37 @@ def main():
                     val, L, ScanStrategy(kind="pallas", pair=True)),
                 "halo": strategy_steps(
                     val, L, ScanStrategy(halo_k=8)),
+                # Bitsplit DFA (ISSUE 8): L single-gather dependent
+                # steps, no matmul in the chain (~4 ops/byte).
+                "dfa": strategy_steps(val, L, ScanStrategy(kind="dfa")),
             }
             detail[key]["strategy_steps"] = variants
-            if entry is not None:
+            dfa_active = False
+            if entry is not None and entry.dfa_key in plan.np_tables:
+                dtab = plan.np_tables[entry.dfa_key]
+                mode = getattr(plan, "dfa_default_mode", "auto")
+                dfa_active = entry.split is None and (
+                    mode == "force" or (mode == "auto" and entry.dfa_auto))
+                detail[key]["dfa"] = {
+                    "states": int(dtab.num_states),
+                    "classes": int(dtab.num_classes),
+                    "exact": bool(dtab.exact),
+                    "auto": bool(entry.dfa_auto),
+                    "active": dfa_active,
+                }
+            if dfa_active:
+                # The lowered chain: L dependent [S,C]-row gathers —
+                # the dependent MATMUL chain is gone on this bank (an
+                # approximate lowering rechecks candidate rows through
+                # the exact NFA, off the common path).
+                detail[key]["selected"] = {
+                    "kind": "dfa" + ("" if dtab.exact else "+recheck"),
+                    "source": (entry.dfa_strategy.source
+                               if entry.dfa_strategy else "default"),
+                }
+                detail[key]["selected_steps"] = variants["dfa"]
+                selected_steps += variants["dfa"]
+            elif entry is not None:
                 if entry.split is not None:
                     short_t = plan.np_tables[entry.split[0]]
                     rest_t = plan.np_tables[entry.split[1]]
@@ -142,6 +170,24 @@ def main():
             mxu_macs += BATCH * L * 16 * K
             detail[key] = {"signatures": K, "len": L,
                            "table_KiB": round(tbytes / 1024, 1)}
+            # Window-bank DFA lowering (ISSUE 8): the conv is
+            # serial-free on the MXU, so the gather ladder is only
+            # taken where per-row work dominates — the CPU diagnostic
+            # backend under auto, everywhere under force
+            # (engine/verdict._dfa_win_active). It trades BATCH*L*16*K
+            # MXU MACs for L dependent row-gathers (~4 ops/byte).
+            dkey = getattr(plan, "win_dfa", {}).get(key)
+            if dkey and dkey in plan.np_tables:
+                dtab = plan.np_tables[dkey]
+                mode = getattr(plan, "dfa_default_mode", "auto")
+                detail[key]["dfa"] = {
+                    "states": int(dtab.num_states),
+                    "classes": int(dtab.num_classes),
+                    "exact": bool(dtab.exact),
+                    "auto": "cpu-only",
+                    "active_on_tpu": mode == "force",
+                    "dependent_steps_if_taken": L,
+                }
         elif key.startswith("iplist_"):
             hbm_bytes += tbytes  # 1.4 MiB bucket table streamed per batch
             vpu_ops += BATCH * 64  # bucket probe + compares
