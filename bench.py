@@ -553,6 +553,185 @@ def _sched_bench_child() -> None:
     print(json.dumps(result), flush=True)
 
 
+def bench_pipeline() -> dict:
+    """ISSUE 9 satellite: A/B the zero-copy pipelined executor
+    (PINGOO_PIPELINE=off vs on, docs/EXECUTOR.md) by driving the same
+    seeded traffic stream through a live ring + RingSidecar per mode in
+    a SUBPROCESS (fresh jit caches per run; the parent backend stays
+    untouched). Verdict checksums must be identical across modes — the
+    pipeline is a scheduling change, never a semantic one. Writes
+    BENCH_pipeline.json and returns flattened `pipeline_*` keys for the
+    result line; tools/bench_regress.py tracks on-mode throughput and
+    p99."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = _run_tracked(
+        [sys.executable, "-c", "import bench; bench._pipeline_bench_child()"],
+        capture_output=True, text=True, timeout=1800, env=env, cwd=repo)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"pipeline bench child rc={out.returncode}: "
+            f"{(out.stderr or '')[-300:]}")
+    child = json.loads(out.stdout.strip().splitlines()[-1])
+    if "note" in child:
+        return {"pipeline_note": child["note"]}
+    on = child["modes"].get("on", {})
+    off = child["modes"].get("off", {})
+    child["checksum_match"] = (on.get("checksum") == off.get("checksum")
+                               and on.get("checksum") is not None)
+    if off.get("req_per_s") and on.get("req_per_s"):
+        child["speedup"] = round(on["req_per_s"] / off["req_per_s"], 3)
+    try:
+        with open("BENCH_pipeline.json", "w") as f:
+            json.dump({"metric": "pipelined_executor_modes", **child},
+                      f, indent=2)
+    except OSError:
+        pass
+    res = {"pipeline_checksum_match": child["checksum_match"],
+           "pipeline_speedup": child.get("speedup")}
+    for mode, row in child["modes"].items():
+        for key, val in row.items():
+            if key != "checksum":
+                res[f"pipeline_{mode}_{key}"] = val
+    # The regress-tracked aliases (direction-aware, bench_regress.py).
+    res["pipeline_on_req_per_s"] = on.get("req_per_s")
+    res["pipeline_on_p99_ms"] = on.get("p99_wait_ms")
+    res["pipeline_overlap_ratio"] = on.get("overlap_ratio")
+    return res
+
+
+def _pipeline_bench_child() -> None:
+    """Child body of bench_pipeline: per PINGOO_PIPELINE mode, boot a
+    fresh shm ring + RingSidecar, drive the same seeded traffic with
+    INTERLEAVED verdict polling (both rings are finite — a driver that
+    enqueues the whole stream before polling wedges against the
+    sidecar's full-verdict-ring retry loop), and emit one JSON line
+    with per-mode throughput / p99 / verdict checksum plus the on-mode
+    overlap telemetry."""
+    import socket as _socket
+    import tempfile
+    import time as _time
+    import zlib
+
+    from pingoo_tpu import native_ring
+    from pingoo_tpu.compiler import compile_ruleset
+    from pingoo_tpu.native_ring import Ring, RingSidecar
+    from pingoo_tpu.utils.crs import generate_ruleset, generate_traffic
+
+    if not native_ring.ensure_built():
+        print(json.dumps({"note": "native toolchain unavailable"}),
+              flush=True)
+        return
+    n_rules = int(os.environ.get("BENCH_PIPELINE_RULES", "500"))
+    # 8 full batches at the default B=2048: with only 4 the A/B delta
+    # sits below the GIL/scheduler jitter floor on shared CPU hosts.
+    n_reqs = int(os.environ.get("BENCH_PIPELINE_REQUESTS", "16384"))
+    max_batch = int(os.environ.get("BENCH_PIPELINE_BATCH", "2048"))
+    depth = int(os.environ.get("BENCH_PIPELINE_DEPTH", "3"))
+    rules, lists = generate_ruleset(n_rules, with_lists=True,
+                                    list_sizes=(4096, 512))
+    plan = compile_ruleset(rules, lists)
+
+    def _pack(reqs):
+        packed = []
+        for r in reqs:
+            try:
+                ip = (b"\x00" * 10 + b"\xff\xff"
+                      + _socket.inet_aton(r.ip))  # v6-mapped, ABI order
+            except OSError:
+                ip = b"\x00" * 16
+            packed.append((r.method.encode(), r.host.encode(),
+                           r.path.encode(), r.url.encode(),
+                           r.user_agent.encode(), ip, r.remote_port,
+                           r.asn, r.country.encode()))
+        return packed
+
+    # Warm with the SAME request count as the measured drive: batch
+    # shapes form from whatever backlog the sidecar sees at dequeue
+    # time, so a short warm stream leaves pow2 buckets uncompiled and
+    # a multi-second jit compile lands inside the measured window —
+    # which is an arm-order lottery, not an executor comparison.
+    warm = _pack(generate_traffic(n_reqs, lists=lists, seed=12))
+    traffic = _pack(generate_traffic(n_reqs, lists=lists, seed=11))
+    result: dict = {"modes": {}, "max_batch": max_batch,
+                    "rules": n_rules, "requests": n_reqs, "depth": depth}
+
+    def drive(ring, stream, record=None):
+        """Enqueue `stream` with interleaved polling; -> wall seconds.
+        `record` (ticket -> stream index map + per-request waits)
+        collects checksum/latency inputs for the measured run."""
+        t_enq: dict[int, float] = {}
+        idx_of: dict[int, int] = {}
+        actions: dict[int, int] = {}
+        waits: list[float] = []
+        done = 0
+        i = 0
+        t0 = _time.monotonic()
+        while done < len(stream):
+            if i < len(stream):
+                m, h, p, u, ua, ip, port, asn, cc = stream[i]
+                t = ring.enqueue(method=m, host=h, path=p, url=u,
+                                 user_agent=ua, ip=ip, port=port,
+                                 asn=asn, country=cc)
+                if t is not None:
+                    idx_of[t] = i
+                    t_enq[t] = _time.monotonic()
+                    i += 1
+            v = ring.poll_verdict()
+            while v is not None:
+                ticket, action, _score = v
+                now = _time.monotonic()
+                waits.append((now - t_enq.pop(ticket, now)) * 1e3)
+                actions[idx_of.pop(ticket, -1)] = action
+                done += 1
+                v = ring.poll_verdict()
+        elapsed = _time.monotonic() - t0
+        if record is not None:
+            record["waits"] = waits
+            record["checksum"] = zlib.crc32(
+                bytes(actions[j] for j in sorted(actions)))
+        return elapsed
+
+    for mode in ("off", "on"):
+        os.environ["PINGOO_PIPELINE"] = mode
+        tmp = tempfile.mkdtemp(prefix="pingoo-pipe-bench-")
+        ring = Ring(os.path.join(tmp, "ring"), capacity=4096, create=True)
+        sidecar = RingSidecar(ring, plan, lists, max_batch=max_batch,
+                              pipeline_depth=depth)
+        th = threading.Thread(target=sidecar.run, daemon=True)
+        th.start()
+        drive(ring, warm)  # compile the hot pow2 buckets off the clock
+        # Best-of-2 measured drives: the stream is identical, so the
+        # checksum is too, and the faster wall isolates executor
+        # behavior from scheduler-jitter outliers on shared CPU.
+        rec: dict = {}
+        elapsed = drive(ring, traffic, record=rec)
+        rec2: dict = {}
+        elapsed2 = drive(ring, traffic, record=rec2)
+        if elapsed2 < elapsed:
+            elapsed, rec = elapsed2, rec2
+        snap = sidecar.stats().get("pipeline", {})
+        cost = sidecar.sched.cost.snapshot()
+        sidecar.stop()
+        ring.close()
+        waits = sorted(rec["waits"])
+        row = {
+            "req_per_s": round(n_reqs / elapsed, 1),
+            "p50_wait_ms": round(waits[len(waits) // 2], 3),
+            "p99_wait_ms": round(
+                waits[min(len(waits) - 1, int(0.99 * len(waits)))], 3),
+            "checksum": rec["checksum"],
+            "overlap_ratio": snap.get("overlap_ratio"),
+            "overlap_events": snap.get("overlap_events"),
+            "stage_occupancy": snap.get("stage_occupancy"),
+        }
+        if mode == "on":
+            row["stage_ewma_ms"] = cost.get("stage_ewma_ms")
+        result["modes"][mode] = row
+    print(json.dumps(result), flush=True)
+
+
 def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
     """Committed end-to-end drive: loadgen_http -> httpd -> ring ->
     sidecar (device lane verdict) -> 403 / proxy -> pong."""
@@ -1205,6 +1384,15 @@ def _main_impl(result: dict, done=None) -> None:
             result.update(bench_sched(mesh_spec))
         except Exception as exc:
             result["sched_error"] = repr(exc)[:200]
+    # Zero-copy pipelined executor A/B (ISSUE 9): PINGOO_PIPELINE
+    # off vs on over the same ring-driven traffic, identical-verdict-
+    # checksum enforced. Subprocess-isolated like the sched bench.
+    if ("--pipeline" in sys.argv
+            or os.environ.get("BENCH_SKIP_PIPELINE") != "1"):
+        try:
+            result.update(bench_pipeline())
+        except Exception as exc:
+            result["pipeline_error"] = repr(exc)[:200]
     if os.environ.get("BENCH_SKIP_BLOCKLIST") != "1":
         try:
             result.update(bench_blocklist_1m())

@@ -173,6 +173,200 @@ def pad_batch(batch: RequestBatch, to_size: int) -> RequestBatch:
     return RequestBatch(size=to_size, arrays=arrays, overflow=overflow)
 
 
+# Shm-slot length-field names per string field (native_ring
+# REQUEST_SLOT_DTYPE; `country` is a fixed 2-byte code with no length
+# field). Lives here, not in native_ring.py, so the zero-copy fill is
+# inside the analyze-linted tree (tools/analyze/lint_config.py).
+SLOT_LEN_KEYS = {
+    "method": "method_len",
+    "host": "host_len",
+    "path": "path_len",
+    "url": "url_len",
+    "user_agent": "ua_len",
+}
+
+
+def bucket_len(longest: int, cap: int, min_len: int = 16) -> int:
+    """The pow2 column count `bucket_arrays` would pick for a field
+    whose longest value is `longest` under capacity `cap`."""
+    L = min_len
+    while L < longest:
+        L *= 2
+    return min(L, cap)
+
+
+class StagingEncoder:
+    """Pre-allocated, reused staging buffers for the zero-copy encode
+    path (ISSUE 9, docs/EXECUTOR.md).
+
+    The legacy chain allocates per batch: `encode_requests` builds
+    fresh (B, cap) matrices, `bucket_arrays` copies the pow2 column
+    slice contiguous, and `pad_batch` concatenates zero rows — three
+    full-batch copies before the device sees a byte. This encoder owns
+    (max_batch, cap) matrices per field and fills them IN PLACE,
+    handing out views already bucketed (pow2 columns) and padded (pow2
+    rows), value-identical to the legacy chain (the bit-identity suite
+    in tests/test_pipeline.py is the contract).
+
+    Double-buffered: `nbuf` rotating buffer sets, so batch N+1's host
+    fill cannot overwrite buffers a still-in-flight batch N hands to
+    the device or reads at resolve time. Planes size `nbuf` to their
+    executor depth + 1.
+
+    Two fill paths:
+      * `encode_requests` — RequestTuple list (Python listener plane);
+        same per-request loop as module-level `encode_requests`, minus
+        the allocations.
+      * `encode_slots` — a structured shm-slot array view
+        (native_ring.REQUEST_SLOT_DTYPE rows, sidecar plane): per-field
+        vectorized strided copies straight out of the ring slots, no
+        per-slot Python tuple materialization.
+    """
+
+    def __init__(self, max_batch: int,
+                 field_specs: Optional[Mapping[str, int]] = None,
+                 nbuf: int = 2):
+        specs = dict(field_specs or DEFAULT_FIELD_SPECS)
+        self.max_batch = int(max_batch)
+        self.specs = specs
+        self.nbuf = max(1, int(nbuf))
+        self._cursor = 0
+        self._bufs: list[dict] = []
+        for _ in range(self.nbuf):
+            bufs: dict = {}
+            for field in STRING_FIELDS:
+                cap = specs.get(field, 256)
+                bufs[f"{field}_bytes"] = np.zeros(
+                    (self.max_batch, cap), dtype=np.uint8)
+                bufs[f"{field}_len"] = np.zeros(
+                    self.max_batch, dtype=np.int32)
+            bufs["ip"] = np.zeros((self.max_batch, 4), dtype=np.uint32)
+            bufs["asn"] = np.zeros(self.max_batch, dtype=np.int64)
+            bufs["remote_port"] = np.zeros(self.max_batch, dtype=np.int64)
+            bufs["overflow"] = np.zeros(self.max_batch, dtype=bool)
+            self._bufs.append(bufs)
+
+    def _checkout(self) -> dict:
+        buf = self._bufs[self._cursor]
+        self._cursor = (self._cursor + 1) % self.nbuf
+        return buf
+
+    def encode_requests(
+        self, requests: list[RequestTuple], pad_to: Optional[int] = None,
+    ) -> RequestBatch:
+        """RequestTuples -> bucketed+padded staging views (hot).
+
+        Value-identical to
+        `pad_batch(bucket of encode_requests(requests), pad_to)`; the
+        returned arrays are views into this encoder's rotating buffers
+        and stay valid until the buffer set cycles back (nbuf - 1
+        later checkouts)."""
+        B = len(requests)
+        P = B if pad_to is None else int(pad_to)
+        if not B or P < B or P > self.max_batch:
+            raise ValueError(f"bad staging shape: B={B} pad_to={pad_to} "
+                             f"max_batch={self.max_batch}")
+        buf = self._checkout()
+        arrays: dict = {}
+        overflow = buf["overflow"][:P]
+        overflow[:] = False
+        for field in STRING_FIELDS:
+            cap = self.specs.get(field, 256)
+            raws = []
+            longest = 0
+            for i, req in enumerate(requests):
+                full = _to_bytes(getattr(req, field))
+                if len(full) > cap:
+                    overflow[i] = True
+                raw = full[:cap]
+                raws.append(raw)
+                if len(raw) > longest:
+                    longest = len(raw)
+            L = bucket_len(longest, cap)
+            data = buf[f"{field}_bytes"][:P, :L]
+            lens = buf[f"{field}_len"][:P]
+            data[:] = 0
+            lens[B:] = 0
+            for i, raw in enumerate(raws):
+                data[i, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+                lens[i] = len(raw)
+            arrays[f"{field}_bytes"] = data
+            arrays[f"{field}_len"] = lens
+        ip = buf["ip"][:P]
+        ip[B:] = 0
+        for i, req in enumerate(requests):
+            try:
+                ip[i], _ = ip_to_words(Ip(req.ip))
+            except Exception:
+                ip[i] = 0  # unparseable -> never matches any predicate
+        arrays["ip"] = ip
+        asn = buf["asn"][:P]
+        port = buf["remote_port"][:P]
+        asn[B:] = 0
+        port[B:] = 0
+        for i, req in enumerate(requests):
+            asn[i] = _clamp_i64(req.asn)
+            port[i] = _clamp_i64(req.remote_port)
+        arrays["asn"] = asn
+        arrays["remote_port"] = port
+        return RequestBatch(size=P, arrays=arrays, overflow=overflow)
+
+    def encode_slots(self, slots: np.ndarray,
+                     pad_to: Optional[int] = None) -> RequestBatch:
+        """Shm slot rows -> bucketed+padded staging views (hot).
+
+        `slots` is a structured-array view over n REQUEST_SLOT_DTYPE
+        rows (native_ring.Ring.dequeue_batch_into buffers). Per field:
+        one vectorized strided copy out of the slots, lens cast in the
+        same assignment — value-identical to the legacy
+        slots_to_arrays -> bucket_arrays -> pad_batch chain, with no
+        intermediate matrices and no per-slot tuples."""
+        n = len(slots)
+        P = n if pad_to is None else int(pad_to)
+        if not n or P < n or P > self.max_batch:
+            raise ValueError(f"bad staging shape: n={n} pad_to={pad_to} "
+                             f"max_batch={self.max_batch}")
+        buf = self._checkout()
+        arrays: dict = {}
+        for field, len_key in SLOT_LEN_KEYS.items():
+            cap = self.specs.get(field, 256)
+            lens = buf[f"{field}_len"][:P]
+            lens[:n] = slots[len_key]
+            lens[n:] = 0
+            longest = int(lens[:n].max()) if n else 0
+            L = bucket_len(longest, cap)
+            data = buf[f"{field}_bytes"][:P, :L]
+            data[:n] = slots[field][:, :L]
+            data[n:] = 0
+            arrays[f"{field}_bytes"] = data
+            arrays[f"{field}_len"] = lens
+        # country: fixed 2-byte code, no slot length field (the legacy
+        # path reports len 2 for live rows, 0 for padding).
+        cdata = buf["country_bytes"][:P, :2]
+        cdata[:n] = np.frombuffer(
+            slots["country"].tobytes(), dtype=np.uint8).reshape(-1, 2)
+        cdata[n:] = 0
+        clens = buf["country_len"][:P]
+        clens[:n] = 2
+        clens[n:] = 0
+        arrays["country_bytes"] = cdata
+        arrays["country_len"] = clens
+        ip = buf["ip"][:P]
+        # big-endian slot words -> native u32 in one casting assignment.
+        ip[:n] = slots["ip"].view(">u4")
+        ip[n:] = 0
+        arrays["ip"] = ip
+        asn = buf["asn"][:P]
+        asn[:n] = slots["asn"]
+        asn[n:] = 0
+        arrays["asn"] = asn
+        port = buf["remote_port"][:P]
+        port[:n] = slots["remote_port"]
+        port[n:] = 0
+        arrays["remote_port"] = port
+        return RequestBatch(size=P, arrays=arrays, overflow=None)
+
+
 def batch_to_contexts(
     batch: RequestBatch, lists: Mapping[str, list]
 ) -> list[Context]:

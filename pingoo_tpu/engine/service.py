@@ -30,12 +30,14 @@ from ..config.schema import Action
 from ..expr import execute_as_bool
 from ..obs.flightrecorder import (FlightRecorder, register_recorder,
                                   tuple_digest)
+from ..obs.pipeline import PipelineStats
 from ..obs.provenance import (ParityAuditor, PrefilterAttribution,
                               RuleAttribution, provenance_enabled)
 from ..sched import MeshExecutor, MeshUnavailable, Scheduler, SchedulerConfig
 from .batch import (
     RequestBatch,
     RequestTuple,
+    StagingEncoder,
     batch_to_contexts,
     bucket_arrays,
     encode_requests,
@@ -45,6 +47,30 @@ from .batch import (
 )
 from .verdict import (action_lanes, finish_batch, make_prefilter_fn,
                       make_verdict_fn)
+
+# Per-stage slices of the PINGOO_DEADLINE_MS budget (ISSUE 9,
+# docs/EXECUTOR.md): cumulative launch-relative fractions a batch may
+# have consumed when each HOST stage finishes before the whole batch
+# fails open through the PINGOO_SCHED_FAILOPEN route (an overrunning
+# encode must not stall the collector into the device dispatch; the
+# compute stage's budget is the remainder and is enforced by the
+# scheduler's unmeetable/deadline-miss machinery). Only enforced when
+# the failopen policy is not `serve` — `serve` (the default) keeps
+# verdicts flowing bit-identically and just counts the misses.
+PIPELINE_STAGE_BUDGET = {"encode": 0.45, "dispatch": 0.75}
+
+
+class _StageBudgetExceeded(RuntimeError):
+    """A pipeline stage blew its slice of the deadline budget; the
+    batch reroutes through the fail-open machinery instead of holding
+    its pipeline slot through a doomed device round trip."""
+
+    def __init__(self, stage: str, elapsed_ms: float):
+        super().__init__(
+            f"pipeline stage {stage!r} blew its deadline slice "
+            f"({elapsed_ms:.3f} ms since launch)")
+        self.stage = stage
+        self.elapsed_ms = elapsed_ms
 
 
 def force_cpu_backend() -> None:
@@ -289,6 +315,40 @@ class VerdictService:
         self._pipeline_depth = max(1, int(
             os.environ.get("PINGOO_SCHED_PIPELINE", "2")))
         self._inflight: set = set()
+        # Overlapped zero-copy executor (ISSUE 9, docs/EXECUTOR.md):
+        # PINGOO_PIPELINE=on (the default) encodes into reused staging
+        # buffers and runs the evaluate chain as token-guarded stages
+        # — batch N+1's encode overlaps batch N's device compute, but
+        # two batches never fill staging or issue device work at the
+        # same time. =off keeps the legacy per-batch-allocating chain
+        # (the bench A/B arm and the bit-identity oracle).
+        # PINGOO_PIPELINE_DEPTH overrides the in-flight batch bound.
+        mode = os.environ.get("PINGOO_PIPELINE", "on").strip().lower()
+        self.pipeline_mode = "off" if mode in ("off", "0", "false") else "on"
+        try:
+            self._pipeline_depth = max(1, int(os.environ.get(
+                "PINGOO_PIPELINE_DEPTH", str(self._pipeline_depth))))
+        except ValueError:
+            pass
+        self._pipe = PipelineStats("python", self._pipeline_depth)
+        self._staging: Optional[StagingEncoder] = None
+        if self.pipeline_mode == "on":
+            # nbuf = depth + 1: every in-flight batch holds one buffer
+            # set and the collector encodes the next into another.
+            self._staging = StagingEncoder(max_batch, plan.field_specs,
+                                           nbuf=self._pipeline_depth + 1)
+        import threading as _threading
+
+        # Per-stage in-flight tokens: host stages are serialized ACROSS
+        # batches (the staging encoder's rotating buffers are checked
+        # out non-atomically; two concurrent encodes would also just
+        # fight over the GIL), while a batch holding no token — i.e.
+        # blocked on device compute — lets the next batch's host work
+        # run. That asymmetry IS the overlap.
+        self._stage_tokens = {
+            "encode": _threading.Lock(),
+            "dispatch": _threading.Lock(),
+        }
         # Verdict provenance (ISSUE 5): per-rule attribution, the
         # flight recorder, and the shadow-parity auditor. PINGOO_
         # PROVENANCE=0 turns the whole layer off; the parity auditor
@@ -312,7 +372,13 @@ class VerdictService:
             try:
                 import jax
 
-                self._verdict_fn = make_verdict_fn(plan)
+                # Donated request buffers (ISSUE 9): XLA recycles each
+                # pipelined batch's upload in place — requested only on
+                # real accelerator backends (no-op + warning on cpu).
+                from .verdict import donate_batch_buffers
+
+                self._verdict_fn = make_verdict_fn(
+                    plan, donate=donate_batch_buffers())
                 # Stage-A prefilter as its own dispatch so the pipeline
                 # stage is separately timeable (None when the plan has
                 # no factors or PINGOO_PREFILTER=off).
@@ -584,44 +650,116 @@ class VerdictService:
         eval_reqs = [reqs[i] for i in uniq_rows] if dups else reqs
         loop = asyncio.get_running_loop()
         stages: dict = {}  # per-batch (double-buffered batches overlap)
-        t_eval = time.monotonic()
-        matched, scores = await loop.run_in_executor(
-            None, self._evaluate_with_scores, eval_reqs, stages)
-        # Feed the EWMA cost model the measured encode->result wall for
-        # this padded size — what the launch policy trades slack against.
-        self.sched.observe_cost(self._pow2_size(len(eval_reqs)),
-                                (time.monotonic() - t_eval) * 1e3)
-        if dups:
-            self.stats.dedup_hits += dups
-            matched = matched[row_of]  # fan out to duplicate rows
-            scores = scores[row_of]
-        t_resolve = time.monotonic()
-        actions, verified_block = action_lanes(self.plan, matched)
-        self.stats.batches += 1
-        self.stats.requests += len(reqs)
-        self.stats.batch_occupancy_sum += len(reqs)
-        for i, (_, fut, t_enq, _t_adm) in enumerate(pending):
-            # The shared verdict-wait histogram measures the full
-            # evaluate() -> resolve wall per REQUEST (queue wait
-            # included) — the <2ms p99 budget is about this number.
-            self.stats.wait_hist.observe((t_resolve - t_enq) * 1e3)
-            self.sched.note_resolved(t_enq, t_resolve)
+        # The pipeline slot id rides the batch's stage dict into the
+        # evaluate chain (note_stage pairing) and every flight record
+        # (which batch-in-flight a request's timings belong to).
+        pipe_slot = self._pipe.enter(self.pipeline_mode)
+        stages["pipeline_slot"] = pipe_slot
+        try:
+            t_eval = time.monotonic()
+            try:
+                matched, scores = await loop.run_in_executor(
+                    None, self._evaluate_with_scores, eval_reqs, stages,
+                    t_launch)
+            except _StageBudgetExceeded:
+                # A host stage blew its slice of the deadline budget:
+                # the whole batch reroutes through the PINGOO_SCHED_
+                # FAILOPEN route instead of riding the device.
+                await self._failopen_batch(pending)
+                return
+            # Feed the EWMA cost model the measured encode->result wall
+            # for this padded size — what the launch policy trades slack
+            # against — plus the per-stage decomposition (ISSUE 9) so
+            # wait_budget_s can price encode+dispatch+compute instead of
+            # one opaque wall.
+            psize = self._pow2_size(len(eval_reqs))
+            self.sched.observe_cost(psize,
+                                    (time.monotonic() - t_eval) * 1e3)
+            if "encode_ms" in stages:
+                self.sched.observe_stage_cost(
+                    "encode", psize, stages["encode_ms"])
+            if "device_dispatch_ms" in stages:
+                self.sched.observe_stage_cost(
+                    "dispatch", psize,
+                    stages.get("prefilter_ms", 0.0)
+                    + stages["device_dispatch_ms"])
+            if "compute_wall_ms" in stages:
+                # Dispatch-end -> results-ready: the honest remaining
+                # wall a row's deadline must still cover after launch
+                # (NOT the residual block at sync, which goes to ~0
+                # exactly when the overlap works).
+                self.sched.observe_stage_cost(
+                    "compute", psize, stages["compute_wall_ms"])
+            if dups:
+                self.stats.dedup_hits += dups
+                matched = matched[row_of]  # fan out to duplicate rows
+                scores = scores[row_of]
+            t_resolve = time.monotonic()
+            actions, verified_block = action_lanes(self.plan, matched)
+            self.stats.batches += 1
+            self.stats.requests += len(reqs)
+            self.stats.batch_occupancy_sum += len(reqs)
+            for i, (_, fut, t_enq, _t_adm) in enumerate(pending):
+                # The shared verdict-wait histogram measures the full
+                # evaluate() -> resolve wall per REQUEST (queue wait
+                # included) — the <2ms p99 budget is about this number.
+                self.stats.wait_hist.observe((t_resolve - t_enq) * 1e3)
+                self.sched.note_resolved(t_enq, t_resolve)
+                if not fut.done():
+                    fut.set_result(
+                        Verdict(action=int(actions[i]), matched=matched[i],
+                                bot_score=float(scores[i]),
+                                verified_block=bool(verified_block[i])))
+            t_res_end = time.monotonic()
+            self.stats.observe_stage(
+                "resolve", (t_res_end - t_resolve) * 1e3)
+            self._pipe.note_stage(pipe_slot, "resolve",
+                                  t_resolve, t_res_end)
+            # Provenance AFTER future resolution: attribution fold +
+            # flight records + the parity sampling decision never sit
+            # between the device result and the waiting requests.
+            t_prov = time.monotonic()
+            if self._attribution is not None:
+                self._observe_provenance(reqs, pending, matched, actions,
+                                         t_resolve, t_launch, stages)
+            self.stats.observe_stage(
+                "provenance", (time.monotonic() - t_prov) * 1e3)
+        finally:
+            self._pipe.exit()
+
+    async def _failopen_batch(self, pending: list) -> None:
+        """Resolve a whole batch through the PINGOO_SCHED_FAILOPEN
+        route after a pipeline stage blew its slice of the deadline
+        budget (docs/EXECUTOR.md): `allow` answers every future with
+        the degraded no-match verdict immediately; `interpret` gives a
+        real verdict off the device path. Only reachable when failopen
+        != serve — `serve` never raises _StageBudgetExceeded."""
+        self.sched.note_failopen(len(pending))
+        R = len(self.plan.rules)
+        if self.sched.config.failopen == "interpret":
+            loop = asyncio.get_running_loop()
+            late_reqs = [r for r, _, _, _ in pending]
+            matched = await loop.run_in_executor(
+                None, lambda: np.stack(
+                    [self._interpret_row(r) for r in late_reqs]))
+            acts, vblk = action_lanes(self.plan, matched)
+            t_res = time.monotonic()
+            for i, (_, fut, t_enq, _t_adm) in enumerate(pending):
+                self.stats.wait_hist.observe((t_res - t_enq) * 1e3)
+                self.sched.note_resolved(t_enq, t_res)
+                if not fut.done():
+                    fut.set_result(Verdict(
+                        action=int(acts[i]), matched=matched[i],
+                        verified_block=bool(vblk[i])))
+            return
+        t_res = time.monotonic()
+        for _, fut, t_enq, _t_adm in pending:
+            self.stats.wait_hist.observe((t_res - t_enq) * 1e3)
+            self.sched.note_resolved(t_enq, t_res)
             if not fut.done():
-                fut.set_result(
-                    Verdict(action=int(actions[i]), matched=matched[i],
-                            bot_score=float(scores[i]),
-                            verified_block=bool(verified_block[i])))
-        self.stats.observe_stage(
-            "resolve", (time.monotonic() - t_resolve) * 1e3)
-        # Provenance AFTER future resolution: attribution fold + flight
-        # records + the parity sampling decision never sit between the
-        # device result and the waiting requests.
-        t_prov = time.monotonic()
-        if self._attribution is not None:
-            self._observe_provenance(reqs, pending, matched, actions,
-                                     t_resolve, t_launch, stages)
-        self.stats.observe_stage(
-            "provenance", (time.monotonic() - t_prov) * 1e3)
+                fut.set_result(Verdict(
+                    action=0, matched=np.zeros(R, dtype=bool),
+                    degraded=True))
 
     async def _apply_failopen(self, pending: list) -> list:
         """Fail open the requests whose deadline is unmeetable even by
@@ -709,19 +847,36 @@ class VerdictService:
             self.parity.submit_matrix(reqs, matched)
 
     def _evaluate_with_scores(self, reqs: list[RequestTuple],
-                              stages: Optional[dict] = None):
+                              stages: Optional[dict] = None,
+                              t_launch: Optional[float] = None):
         """-> (matched [B, R], bot scores [B]). Scores ride the same
         encoded batch (BASELINE config 5: the vectorized bot head).
         `stages` collects this batch's per-stage timings — a PER-BATCH
         dict, because double-buffered dispatch (ISSUE 6) overlaps two
-        batches' evaluations."""
-        t0 = time.monotonic()
-        batch = encode_requests(reqs, self.plan.field_specs)
+        batches' evaluations. With PINGOO_PIPELINE=on the encode runs
+        into reused staging buffers under the encode token (ISSUE 9):
+        already bucketed + padded, value-identical to the legacy
+        encode->bucket->pad chain (tests/test_pipeline.py holds the
+        bit-identity line)."""
         if stages is None:
             stages = {}
         self._last_batch_stages = stages  # latest batch (introspection)
-        self._batch_stage("encode", (time.monotonic() - t0) * 1e3, stages)
+        pipe_slot = stages.get("pipeline_slot")
         n = len(reqs)
+        if self._staging is not None:
+            with self._stage_tokens["encode"]:
+                t0 = time.monotonic()
+                batch = self._staging.encode_requests(
+                    reqs, pad_to=self._pow2_size(n))
+                t1 = time.monotonic()
+            if pipe_slot is not None:
+                self._pipe.note_stage(pipe_slot, "encode", t0, t1)
+        else:
+            t0 = time.monotonic()
+            batch = encode_requests(reqs, self.plan.field_specs)
+            t1 = time.monotonic()
+        self._batch_stage("encode", (t1 - t0) * 1e3, stages)
+        self._check_stage_budget("encode", t_launch)
         # DISPATCH the scorer before the verdict runs: jax dispatch is
         # async, so the bot head computes while the verdict path does
         # its host work + device round trip, instead of serializing
@@ -746,7 +901,8 @@ class VerdictService:
                 # Scoring is advisory and never blocks verdicts, but a
                 # broken scorer must show up on the metrics surface.
                 self.stats.score_errors += 1
-        matched = self._evaluate_sync(reqs, batch, stages)
+        matched = self._evaluate_sync(reqs, batch, stages, t_launch,
+                                      staged=self._staging is not None)
         # pingoo: allow(hot-alloc): [B] f32 default score vector
         scores = np.zeros(n, dtype=np.float32)
         if score_dev is not None:
@@ -757,6 +913,14 @@ class VerdictService:
             except Exception:
                 self.stats.score_errors += 1
         return matched, scores
+
+    def pipeline_snapshot(self) -> dict:
+        """Pipelined-executor introspection (ISSUE 9): mode, depth,
+        in-flight count, per-stage occupancy and the overlap ratio —
+        the JSON twin of the pingoo_pipeline_* registry gauges."""
+        snap = self._pipe.snapshot()
+        snap["mode"] = self.pipeline_mode
+        return snap
 
     def _pow2_size(self, n: int) -> int:
         """Padded launch size: the shared pow2 ladder, dp-aligned when
@@ -773,59 +937,122 @@ class VerdictService:
         if stages is not None:
             stages[f"{stage}_ms"] = round(ms, 3)
 
+    def _check_stage_budget(self, stage: str,
+                            t_launch: Optional[float]) -> None:
+        """Per-stage fail-open budget (ISSUE 9, docs/EXECUTOR.md):
+        after each HOST stage, check the launch-relative elapsed time
+        against that stage's cumulative slice of the deadline
+        (PIPELINE_STAGE_BUDGET x PINGOO_DEADLINE_MS) and raise
+        _StageBudgetExceeded to reroute the batch through the fail-open
+        machinery. No-op under the default `serve` policy — serving
+        bit-identical verdicts beats enforcing the budget."""
+        if t_launch is None or self.sched.config.failopen == "serve":
+            return
+        frac = PIPELINE_STAGE_BUDGET.get(stage)
+        if frac is None:
+            return
+        elapsed_ms = (time.monotonic() - t_launch) * 1e3
+        if elapsed_ms > frac * self.sched.config.deadline_ms:
+            raise _StageBudgetExceeded(stage, elapsed_ms)
+
     def _evaluate_sync(self, reqs: list[RequestTuple],
                        batch: Optional[RequestBatch] = None,
-                       stages: Optional[dict] = None) -> np.ndarray:
+                       stages: Optional[dict] = None,
+                       t_launch: Optional[float] = None,
+                       staged: bool = False) -> np.ndarray:
+        from contextlib import nullcontext
+
         n = len(reqs)
         if batch is None:
             batch = encode_requests(reqs, self.plan.field_specs)
+            staged = False
+        pipe_slot = (stages or {}).get("pipeline_slot")
         matched = None
         if self.use_device:
             try:
-                # Stabilize BOTH shape axes: bucket field lengths, and pad
-                # the batch axis to a power of two so arbitrary collector
-                # occupancies don't each compile a fresh XLA program.
-                arrays = bucket_arrays(batch.arrays)
-                fast = pad_batch(
-                    RequestBatch(size=batch.size, arrays=arrays),
-                    self._pow2_size(n))
-                # Mesh placement (ISSUE 6): the device programs read the
-                # dp-sharded view; `fast` itself stays host-resident for
-                # the host-rule overlap + overflow re-interpretation.
-                dev_arrays = fast.arrays
-                if self.mesh is not None and self.mesh.active:
-                    dev_arrays = self.mesh.shard_batch(dev_arrays)
-                pf_hits = pf_aux = None
-                if self._pf_fn is not None:
-                    # Stage A (always-on, whole batch): factor hits feed
-                    # the verdict program's bank gating; the aux lanes
-                    # feed the candidate-rate/skip metrics after the
-                    # batch's sync point.
+                if staged:
+                    # Staging path (ISSUE 9): the encoder already
+                    # bucketed the field axes and padded the batch axis
+                    # — reusing its views IS the zero-copy win.
+                    fast = batch
+                else:
+                    # Stabilize BOTH shape axes: bucket field lengths,
+                    # and pad the batch axis to a power of two so
+                    # arbitrary collector occupancies don't each
+                    # compile a fresh XLA program.
+                    arrays = bucket_arrays(batch.arrays)
+                    fast = pad_batch(
+                        RequestBatch(size=batch.size, arrays=arrays),
+                        self._pow2_size(n))
+                # The dispatch token serializes device issue across
+                # in-flight batches (program order stays deterministic)
+                # while leaving compute token-free: batch N+1 encodes
+                # and dispatches while batch N blocks on its result.
+                tok = (self._stage_tokens["dispatch"]
+                       if self._staging is not None else nullcontext())
+                td0 = time.monotonic()
+                with tok:
+                    # Mesh placement (ISSUE 6): the device programs
+                    # read the dp-sharded view; `fast` itself stays
+                    # host-resident for the host-rule overlap +
+                    # overflow re-interpretation.
+                    dev_arrays = fast.arrays
+                    if self.mesh is not None and self.mesh.active:
+                        dev_arrays = self.mesh.shard_batch(dev_arrays)
+                    pf_hits = pf_aux = None
+                    if self._pf_fn is not None:
+                        # Stage A (always-on, whole batch): factor hits
+                        # feed the verdict program's bank gating; the
+                        # aux lanes feed the candidate-rate/skip
+                        # metrics after the batch's sync point.
+                        t0 = time.monotonic()
+                        pf_hits, pf_aux = self._pf_fn(self._tables,
+                                                      dev_arrays)
+                        self._batch_stage(
+                            "prefilter", (time.monotonic() - t0) * 1e3,
+                            stages)
                     t0 = time.monotonic()
-                    pf_hits, pf_aux = self._pf_fn(self._tables, dev_arrays)
+                    dev = self._verdict_fn(self._tables, dev_arrays,
+                                           pf_hits)
+                    # jax dispatch is async: this stage is issue +
+                    # host->device transfer; the on-device execution
+                    # residual is timed inside finish_batch via
+                    # block_until_ready, AFTER the host-interpreted
+                    # rules overlapped it.
                     self._batch_stage(
-                        "prefilter", (time.monotonic() - t0) * 1e3, stages)
-                t0 = time.monotonic()
-                dev = self._verdict_fn(self._tables, dev_arrays, pf_hits)
-                # jax dispatch is async: this stage is issue + host->
-                # device transfer; the on-device execution residual is
-                # timed inside finish_batch via block_until_ready,
-                # AFTER the host-interpreted rules overlapped it.
-                self._batch_stage(
-                    "device_dispatch", (time.monotonic() - t0) * 1e3,
-                    stages)
+                        "device_dispatch", (time.monotonic() - t0) * 1e3,
+                        stages)
+                td1 = time.monotonic()
+                if pipe_slot is not None:
+                    self._pipe.note_stage(pipe_slot, "dispatch", td0, td1)
+                self._check_stage_budget("dispatch", t_launch)
                 matched = finish_batch(
                     self.plan, dev, fast, self.lists,
                     on_device_wait=lambda ms: self._batch_stage(
                         "device_compute", ms, stages))[:n]
+                tc1 = time.monotonic()
+                # The pipeline's compute window is dispatch-end ->
+                # results-ready (the overlap denominator AND the
+                # per-stage cost fed to the scheduler) — NOT the
+                # residual block at sync, which goes to ~0 exactly
+                # when the overlap works.
+                if pipe_slot is not None:
+                    self._pipe.note_stage(pipe_slot, "compute", td1, tc1)
+                if stages is not None:
+                    stages["compute_wall_ms"] = round(
+                        (tc1 - td1) * 1e3, 3)
                 if pf_aux is not None:
                     self._observe_prefilter(pf_aux, fast.size)
                 self._observe_dfa()
+            except _StageBudgetExceeded:
+                raise
             except Exception:
                 self.stats.device_errors += 1
         if matched is None:
             self.stats.host_fallback_batches += 1
-            matched = self._evaluate_host(batch)
+            # [:n]: the staging batch carries pow2 padding rows the
+            # host interpreter evaluates too — slice them off.
+            matched = self._evaluate_host(batch)[:n]
         return self._rewrite_overflow_rows(reqs, batch, matched)
 
     def _observe_prefilter(self, pf_aux, batch_rows: int) -> None:

@@ -739,18 +739,39 @@ def _matched_cols(plan: RulesetPlan, tables, arrays, pf_hits=None):
     return jnp.take(allmat, jnp.asarray(rule_col, dtype=jnp.int32), axis=1)
 
 
-def make_verdict_fn(plan: RulesetPlan):
+def donate_batch_buffers() -> bool:
+    """Whether the verdict/lane programs should mark their request
+    arrays as donated inputs (ISSUE 9, docs/EXECUTOR.md). Donation
+    lets XLA reuse the per-batch upload buffers in place across the
+    pipelined executor's in-flight batches instead of allocating fresh
+    device memory each launch — but it is only meaningful on a real
+    accelerator backend: the CPU engine aliases host buffers and XLA
+    just warns that the donation was unusable. So the planes request
+    it exactly when the resolved backend is not `cpu` (honest gating —
+    no pretend-donation on the diagnostic backend)."""
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu"
+    except Exception:
+        return False
+
+
+def make_verdict_fn(plan: RulesetPlan, donate: bool = False):
     """Jitted device verdict: (tables, arrays) -> [B, R_dev] bool.
 
     `pf_hits` optionally feeds a separately-dispatched Stage-A prefilter
     pass (make_prefilter_fn); left None, Stage A traces inline under the
-    active PINGOO_PREFILTER mode."""
+    active PINGOO_PREFILTER mode.
 
-    @jax.jit
+    `donate=True` marks the request arrays (arg 1) as donated buffers
+    so each pipelined batch's upload can be recycled in place by XLA
+    (see donate_batch_buffers for when that is honest to request)."""
+
     def verdict(tables, arrays, pf_hits=None):
         return _matched_cols(plan, tables, arrays, pf_hits=pf_hits)
 
-    return verdict
+    return jax.jit(verdict, donate_argnums=(1,) if donate else ())
 
 
 class PrefilterProgram(NamedTuple):
@@ -821,7 +842,7 @@ LANE_NONE = np.int32(2**30)  # "no rule": sorts after every real index
 
 def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
                  service_groups: list[list[str]] | None = None,
-                 with_rule_hits: bool = False):
+                 with_rule_hits: bool = False, donate: bool = False):
     """Jitted device ACTION-LANE reduction: (tables, arrays) ->
     [3 + max(G, 1), B] i32 rows (first_act_idx, first_act_kind,
     first_block_idx, route lane(s)), indices in ORIGINAL rule-index
@@ -850,7 +871,10 @@ def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
     same dispatch as the lanes — C extra int32s per batch, so
     provenance costs no extra transfer round trip. The fn then returns
     (lanes, rule_hits); columns map to original rule indices via
-    plan.device_rule_indices."""
+    plan.device_rule_indices.
+
+    `donate=True` marks the request arrays (arg 1) as donated buffers
+    (ISSUE 9; see donate_batch_buffers for the backend gating)."""
     if service_groups is not None and services is not None:
         raise ValueError("pass services or service_groups, not both")
     groups = (service_groups if service_groups is not None
@@ -887,7 +911,6 @@ def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
         if dev_route else None
         for dev_route in group_routes]
 
-    @jax.jit
     def lanes(tables, arrays, pf_hits=None, n_valid=None):
         matched = _matched_cols(plan, tables, arrays, pf_hits)  # [B, C]
         B = arrays["asn"].shape[0]
@@ -933,7 +956,7 @@ def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
         return pack(jnp.stack([first_act_idx, kind, first_block_idx]
                               + route_lanes))
 
-    return lanes
+    return jax.jit(lanes, donate_argnums=(1,) if donate else ())
 
 
 def host_rule_lanes(plan: RulesetPlan, batch, lists):

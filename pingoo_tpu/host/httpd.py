@@ -693,6 +693,7 @@ class HttpListener:
             "fail_open": self.stats.fail_open,
             "req_per_s": round(self.stats.requests / uptime, 2) if uptime else 0,
             "verdict": self.verdict.stats.snapshot(),
+            "pipeline": self.verdict.pipeline_snapshot(),
         }
         return Response(200, [("content-type", "application/json")],
                         json.dumps(payload).encode())

@@ -245,6 +245,22 @@ class Ring:
             min(max_batch, self.capacity))
         return self._scratch[:n].copy()
 
+    def dequeue_batch_into(self, out: np.ndarray) -> int:
+        """Zero-copy bulk dequeue (ISSUE 9, docs/EXECUTOR.md): the FFI
+        slot copy lands directly in the caller's REQUEST_SLOT_DTYPE
+        buffer — typically a row offset into the sidecar's pooled
+        accumulation buffer, so multi-ring parts merge WITHOUT the
+        scratch round trip, the per-part `.copy()`, or the launch-time
+        `np.concatenate`. Returns the slot count written; the caller
+        owns `out` for the batch's whole lifetime."""
+        assert out.dtype == REQUEST_SLOT_DTYPE and out.flags.c_contiguous
+        if not len(out):
+            return 0
+        n = self.lib.pingoo_ring_dequeue_requests(
+            self.addr, out.ctypes.data_as(ctypes.c_void_p),
+            min(len(out), self.capacity))
+        return int(n)
+
     def post_verdict(self, ticket: int, action: int, score: float = 0.0) -> bool:
         return self.lib.pingoo_ring_post_verdict(
             self.addr, ticket, action, score) == 0
@@ -444,6 +460,22 @@ class RingSidecar:
         # it hides the device round-trip latency (large when the chip is
         # behind a network tunnel) behind the next batch's host work.
         self.pipeline_depth = max(1, pipeline_depth)
+        # Overlapped zero-copy executor (ISSUE 9, docs/EXECUTOR.md):
+        # PINGOO_PIPELINE=on (default) dequeues straight into pooled
+        # slot buffers (Ring.dequeue_batch_into) and encodes through
+        # the reused StagingEncoder views — no per-batch concatenate /
+        # slots_to_arrays / bucket / pad allocations; =off keeps the
+        # legacy chain (the bench A/B arm and the parity oracle path).
+        # PINGOO_PIPELINE_DEPTH overrides the in-flight bound for both.
+        mode = os.environ.get("PINGOO_PIPELINE", "on").strip().lower()
+        self.pipeline_mode = "off" if mode in ("off", "0", "false") \
+            else "on"
+        try:
+            self.pipeline_depth = max(1, int(os.environ.get(
+                "PINGOO_PIPELINE_DEPTH", str(self.pipeline_depth))))
+        except ValueError:
+            pass
+        self._zero_copy = self.pipeline_mode == "on"
         # Continuous-batching admission scheduler (ISSUE 6, docs/
         # SCHEDULER.md): replaces the fixed drain window (dispatch
         # whatever one dequeue pass returned) with the deadline-slack
@@ -508,9 +540,15 @@ class RingSidecar:
         from .obs.provenance import provenance_enabled
 
         self._provenance_on = provenance_enabled()
+        # Donated request buffers (ISSUE 9): XLA recycles each
+        # pipelined batch's upload in place — requested only on real
+        # accelerator backends (no-op + warning on cpu).
+        from .engine.verdict import donate_batch_buffers
+
         self._lane_fn = make_lane_fn(
             plan, service_groups=self._groups or None,
-            with_rule_hits=self._provenance_on)
+            with_rule_hits=self._provenance_on,
+            donate=donate_batch_buffers())
         # Services whose route predicate fell back to host interpretation
         # are merged into the device route lane per batch (per group).
         self._host_routes: list[list[tuple[int, object]]] = []
@@ -562,6 +600,29 @@ class RingSidecar:
         from .obs import REGISTRY
 
         self._registry = REGISTRY
+        # Pipeline executor substrate (ISSUE 9): the staging encoder's
+        # rotating buffer sets must outlive every in-flight batch that
+        # still reads its views (depth in flight + the one being
+        # filled), and the slot-buffer pool holds one accumulation
+        # buffer per in-flight batch plus the one being filled — a
+        # drained pool allocates a fresh buffer (cold path only).
+        from collections import deque as _deque
+
+        from .engine.batch import StagingEncoder
+        from .obs.pipeline import PipelineStats
+
+        self._pipe = PipelineStats("sidecar", self.pipeline_depth)
+        self._staging = None
+        self._slot_pool: _deque = _deque()
+        if self._zero_copy:
+            caps = dict(FIELD_CAPS)
+            caps["country"] = 2
+            self._staging = StagingEncoder(
+                max_batch, field_specs=caps,
+                nbuf=self.pipeline_depth + 1)
+            for _ in range(self.pipeline_depth + 1):
+                self._slot_pool.append(
+                    np.zeros(max_batch, dtype=REQUEST_SLOT_DTYPE))
         self._stage = {
             stage: REGISTRY.histogram(
                 "pingoo_verdict_stage_ms",
@@ -663,6 +724,12 @@ class RingSidecar:
         pend_parts: list[tuple[Ring, np.ndarray]] = []
         pend_n = 0
         oldest_enq_ms: Optional[int] = None
+        # Zero-copy accumulation buffer (PINGOO_PIPELINE=on): every
+        # ring's dequeue FFI lands its slots contiguously at this
+        # buffer's next free row, so the merged launch batch is one
+        # view — the buffer travels with the batch and returns to the
+        # pool when `_complete` finishes it.
+        pend_buf = self._take_slot_buf() if self._zero_copy else None
         while not self._stop:
             # One merged dequeue pass across all worker rings. The
             # start index rotates so a saturated ring cannot monopolize
@@ -676,12 +743,20 @@ class RingSidecar:
                 if budget <= 0:
                     break
                 r = self.rings[(self._ring_rr + i) % nrings]
-                s = r.dequeue_batch(budget)
+                if pend_buf is not None:
+                    fill = pend_n + got
+                    k = r.dequeue_batch_into(
+                        pend_buf[fill:fill + budget])
+                    s = pend_buf[fill:fill + k]
+                else:
+                    s = r.dequeue_batch(budget)
                 if len(s):
                     if self.geoip is not None:
-                        # Enrich IN the per-ring slot arrays
-                        # (dequeue_batch copies, so this is safe)
-                        # BEFORE merging: both the device batch and the
+                        # Enrich IN the per-ring slot arrays (the
+                        # sidecar owns them: dequeue_batch copies out
+                        # of the ring scratch, dequeue_batch_into
+                        # lands in the batch's pooled buffer) BEFORE
+                        # merging: both the device batch and the
                         # overflow-spill re-interpretation
                         # (_interpret_overflow_row reads the per-ring
                         # part) must see the same geo values.
@@ -703,8 +778,11 @@ class RingSidecar:
                         pend_n, oldest_enq_ms / 1e3, now_ms / 1e3)
             if launch:
                 inflight.append(self._dispatch(pend_parts, pend_n,
-                                               oldest_enq_ms))
+                                               oldest_enq_ms,
+                                               slot_buf=pend_buf))
                 pend_parts, pend_n, oldest_enq_ms = [], 0, None
+                if pend_buf is not None:
+                    pend_buf = self._take_slot_buf()
             if inflight and (len(inflight) >= self.pipeline_depth
                              or not launch):
                 self._complete(*inflight.popleft())
@@ -720,10 +798,22 @@ class RingSidecar:
         # (the data plane would otherwise eat a fail-open timeout).
         if pend_parts:
             inflight.append(self._dispatch(pend_parts, pend_n,
-                                           oldest_enq_ms))
+                                           oldest_enq_ms,
+                                           slot_buf=pend_buf))
+        elif pend_buf is not None:
+            self._slot_pool.append(pend_buf)
         while inflight:
             self._complete(*inflight.popleft())
         return self.processed
+
+    def _take_slot_buf(self) -> np.ndarray:
+        """One pooled REQUEST_SLOT_DTYPE accumulation buffer (pipeline
+        hot path: pop; cold path when every pooled buffer is riding an
+        in-flight batch: allocate — the pool absorbs it back later)."""
+        try:
+            return self._slot_pool.popleft()
+        except IndexError:
+            return np.zeros(self.max_batch, dtype=REQUEST_SLOT_DTYPE)
 
     def _queued_depth(self) -> int:
         """Requests still waiting across this sidecar's rings (the
@@ -737,24 +827,44 @@ class RingSidecar:
                 pass
         return total
 
-    def _dispatch(self, parts, n: int, oldest_enq_ms: Optional[int]):
+    def _dispatch(self, parts, n: int, oldest_enq_ms: Optional[int],
+                  slot_buf=None):
         """Encode + launch one merged batch (jax dispatch is async);
         returns the in-flight tuple `_complete` consumes."""
         from .engine.batch import RequestBatch, bucket_arrays, pad_batch
 
-        slots = parts[0][1] if len(parts) == 1 else np.concatenate(
-            [s for _, s in parts])
-        # Pad the batch axis to one fixed shape (a partial batch
-        # would otherwise be a new XLA program — compile stall on
-        # the serving path) and bucket field lengths to powers of
-        # two so the NFA scan walks the batch's longest value,
-        # not the 2048-byte slot capacity (at most log2(cap)
-        # shapes per field).
+        pipe_slot = self._pipe.enter(self.pipeline_mode)
         t0 = time.monotonic()
-        raw = RequestBatch(size=n, arrays=slots_to_arrays(slots))
-        batch = pad_batch(
-            RequestBatch(size=n, arrays=bucket_arrays(raw.arrays)),
-            self.max_batch)
+        if slot_buf is not None:
+            # Zero-copy plane (PINGOO_PIPELINE=on): the dequeue FFI
+            # already landed every part contiguously in `slot_buf`, so
+            # the merged batch is one view — no concatenate — and the
+            # staging encoder fills its reused bucketed+padded
+            # matrices straight from the slot fields (no
+            # slots_to_arrays intermediates, no bucket/pad copies).
+            # `raw` is the unpadded row view of the same staging
+            # arrays: bucketed columns are a superset of every row's
+            # length, and every consumer (host_rule_lanes,
+            # batch_to_contexts) reads data[:len].
+            slots = slot_buf[:n]
+            batch = self._staging.encode_slots(slots,
+                                               pad_to=self.max_batch)
+            raw = RequestBatch(
+                size=n,
+                arrays={k: v[:n] for k, v in batch.arrays.items()})
+        else:
+            slots = parts[0][1] if len(parts) == 1 else np.concatenate(
+                [s for _, s in parts])
+            # Pad the batch axis to one fixed shape (a partial batch
+            # would otherwise be a new XLA program — compile stall on
+            # the serving path) and bucket field lengths to powers of
+            # two so the NFA scan walks the batch's longest value,
+            # not the 2048-byte slot capacity (at most log2(cap)
+            # shapes per field).
+            raw = RequestBatch(size=n, arrays=slots_to_arrays(slots))
+            batch = pad_batch(
+                RequestBatch(size=n, arrays=bucket_arrays(raw.arrays)),
+                self.max_batch)
         # Mesh placement (ISSUE 6): the device programs read the
         # dp-sharded view; `raw` stays host-resident for host-rule
         # interpretation and spill re-evaluation.
@@ -778,6 +888,18 @@ class RingSidecar:
         self._stage["encode"].observe((t1 - t0) * 1e3)
         self._stage["prefilter"].observe((tpf - t1) * 1e3)
         self._stage["device_dispatch"].observe((t2 - tpf) * 1e3)
+        # Pipeline telemetry + per-stage cost feed (ISSUE 9): the
+        # executor stages are encode (staging fill + mesh placement)
+        # and dispatch (prefilter + lane-fn issue); feeding them to the
+        # stage-aware cost model keeps should_launch's slack estimate
+        # honest once stages of different batches overlap (the single
+        # launch->result wall would double-count overlapped host work).
+        self._pipe.note_stage(pipe_slot, "encode", t0, t1)
+        self._pipe.note_stage(pipe_slot, "dispatch", t1, t2)
+        self.sched.observe_stage_cost("encode", self.max_batch,
+                                      (t1 - t0) * 1e3)
+        self.sched.observe_stage_cost("dispatch", self.max_batch,
+                                      (t2 - t1) * 1e3)
         # Scheduler accounting at launch: occupancy + queue depth, the
         # sidecar's `sched` stage (oldest enqueue -> launch hold on the
         # ring clock), and the fail-open mask for rows whose deadline
@@ -789,17 +911,29 @@ class RingSidecar:
                 max(0.0, float(now_ms - oldest_enq_ms)))
         skip_masks = None
         if self.sched.config.failopen == "allow":
-            skip_masks = self._failopen_late_rows(parts, now_ms)
+            # Per-stage budget slice (ISSUE 9): encode+dispatch are
+            # already spent at this point, so the unmeetable test
+            # charges each row only the REMAINING work — the compute
+            # stage's estimate — instead of the whole-batch wall (which
+            # would fail open rows that could still make the deadline).
+            skip_masks = self._failopen_late_rows(
+                parts, now_ms,
+                est_ms=self.sched.cost.estimate_stage(
+                    "compute", self.max_batch))
         return (parts, slots, raw, dev, rule_hits, pf_aux, n, skip_masks,
-                time.monotonic())
+                time.monotonic(), slot_buf, pipe_slot)
 
-    def _failopen_late_rows(self, parts, now_ms: int) -> list:
+    def _failopen_late_rows(self, parts, now_ms: int,
+                            est_ms: Optional[float] = None) -> list:
         """PINGOO_SCHED_FAILOPEN=allow: rows whose deadline cannot be
         met even by the launch happening right now get an immediate
         allow verdict (the reference's fail-open posture — attacks pass
         rather than stall the data plane); their device verdicts are
-        computed but never posted. Returns one keep-mask per part."""
-        est_ms = self.sched.cost.estimate(self.max_batch)
+        computed but never posted. Returns one keep-mask per part.
+        `est_ms` is the cost still ahead of the rows — the caller's
+        stage-budget slice; defaults to the full-batch estimate."""
+        if est_ms is None:
+            est_ms = self.sched.cost.estimate(self.max_batch)
         deadline_ms = self.sched.config.deadline_ms
         masks = []
         for ring, part in parts:
@@ -847,21 +981,38 @@ class RingSidecar:
                 slots["country"][i] = cc
 
     def _complete(self, parts, slots, raw_batch, dev, rule_hits, pf_aux,
-                  n: int, skip_masks=None, t_disp=None) -> None:
+                  n: int, skip_masks=None, t_disp=None, slot_buf=None,
+                  pipe_slot=None) -> None:
         from .engine.verdict import host_rule_lanes, merge_lanes
 
         # Host-interpreted rules run on the UNPADDED batch while the
         # device lanes are still in flight (jax dispatch is async).
         host = host_rule_lanes(self.plan, raw_batch, self.lists)
+        tc0 = time.monotonic()
         t0 = time.time()
         dev_lanes = np.asarray(dev)[:, :n]  # drop batch-padding rows
         wait_s = time.time() - t0
+        tc1 = time.monotonic()
         self.device_wait_s += wait_s
         self._stage["device_compute"].observe(wait_s * 1e3)
+        # The pipeline's compute window runs dispatch-end -> results
+        # ready, NOT just the residual block at the sync (which shrinks
+        # to ~0 precisely when overlap works): it is the window the
+        # executor hides other batches' host stages behind (the
+        # overlap-ratio denominator, obs/pipeline.py) and the cost a
+        # row's deadline must still cover after launch (the compute
+        # budget slice _dispatch charges in _failopen_late_rows).
+        tcs = t_disp if t_disp is not None else tc0
+        if pipe_slot is not None:
+            self._pipe.note_stage(pipe_slot, "compute", tcs, tc1)
+        self.sched.observe_stage_cost("compute", self.max_batch,
+                                      (tc1 - tcs) * 1e3)
         if t_disp is not None:
             # EWMA cost-model feedback: launch -> device result wall
-            # for the padded size — what should_launch trades the
-            # oldest request's slack against.
+            # for the padded size. With stage observations present the
+            # cost model estimates from per-stage EWMAs (this wall
+            # double-counts host work overlapped with OTHER batches);
+            # the legacy wall still feeds the baseline fallback.
             self.sched.observe_cost(self.max_batch,
                                     (time.monotonic() - t_disp) * 1e3)
         if pf_aux is not None:
@@ -994,6 +1145,8 @@ class RingSidecar:
                 done += ring.post_verdicts(tickets[done:], pacts[done:])
                 if done < k:
                     if self._stop:  # a dead consumer must not wedge stop()
+                        if pipe_slot is not None:
+                            self._pipe.exit()
                         return
                     time.sleep(self.idle_sleep_s)
             # Telemetry: enqueue -> verdict-post wall time for this
@@ -1007,20 +1160,31 @@ class RingSidecar:
         self.sched.note_misses(int(
             ((post_ms - slots["enq_ms"].astype(np.int64))
              > self.sched.config.deadline_ms).sum()))
-        self._stage["resolve"].observe(
-            (time.monotonic() - t_resolve) * 1e3)
+        t_res_end = time.monotonic()
+        self._stage["resolve"].observe((t_res_end - t_resolve) * 1e3)
+        if pipe_slot is not None:
+            self._pipe.note_stage(pipe_slot, "resolve", t_resolve,
+                                  t_res_end)
         t_prov = time.monotonic()
         if self._attribution is not None:
             self._observe_provenance(slots, rule_hits, dev_lanes, host,
                                      raw_batch, unverified,
-                                     verified_block, wait_s, n)
+                                     verified_block, wait_s, n,
+                                     pipe_slot=pipe_slot)
         self._stage["provenance"].observe(
             (time.monotonic() - t_prov) * 1e3)
         self.processed += n
+        # The batch is fully resolved: its accumulation buffer returns
+        # to the pool and its pipeline slot retires.
+        if slot_buf is not None:
+            self._slot_pool.append(slot_buf)
+        if pipe_slot is not None:
+            self._pipe.exit()
 
     def _observe_provenance(self, slots, rule_hits, dev_lanes, host,
                             raw_batch, unverified, verified_block,
-                            device_wait_s, n: int) -> None:
+                            device_wait_s, n: int,
+                            pipe_slot=None) -> None:
         """Sidecar-plane provenance (ISSUE 5): fold the on-device
         attribution aux lane, flight-record the batch, and hand the
         FINAL served lanes (spill rewrites included) to the parity
@@ -1052,14 +1216,20 @@ class RingSidecar:
             for f in ("host", "path", "url", "user_agent", "ip"):
                 crc = _zlib.crc32(slots[f][i].tobytes(), crc)
             first = int(act_idx[i])
+            stages = {
+                "enqueue_to_post_ms": max(
+                    0, now_ms - int(enq_ms[i])),
+                "device_compute_ms": compute_ms,
+            }
+            if pipe_slot is not None:
+                # Pipeline slot id (ISSUE 9): lines this record up
+                # against the pingoo_pipeline_* series — which batches
+                # were in flight together when this request was served.
+                stages["pipeline_slot"] = int(pipe_slot)
             recorder.record(
                 trace_id=trace_ids[i],
                 digest=f"{crc & 0xFFFFFFFF:08x}",
-                stages={
-                    "enqueue_to_post_ms": max(
-                        0, now_ms - int(enq_ms[i])),
-                    "device_compute_ms": compute_ms,
-                },
+                stages=stages,
                 matched_rules=(first,) if first < LANE_NONE else (),
                 action=int(unverified[i]),
                 ticket=int(slots["ticket"][i]))
@@ -1068,8 +1238,22 @@ class RingSidecar:
             # view than the slot arrays — excluded from the audit.
             skip = ((slots["flags"] & SLOT_FLAG_TRUNCATED) != 0) \
                 | (slots["spill_idx"] != SPILL_NONE)
+            raw_for_audit = raw_batch
+            if self._zero_copy and self.parity.sample > 0.0:
+                # The auditor's contexts_builder runs LATER on its
+                # worker thread, but zero-copy `raw_batch` arrays are
+                # views into the rotating staging buffers — recycled a
+                # few batches from now. Snapshot them while they are
+                # still this batch's bytes (audit-mode-only copy; with
+                # sampling off the closure is never invoked).
+                from .engine.batch import RequestBatch
 
-            def contexts_builder(raw=raw_batch, lists=self.lists):
+                raw_for_audit = RequestBatch(
+                    size=raw_batch.size,
+                    arrays={k: np.array(v, copy=True)
+                            for k, v in raw_batch.arrays.items()})
+
+            def contexts_builder(raw=raw_for_audit, lists=self.lists):
                 from .engine.batch import batch_to_contexts
 
                 contexts = batch_to_contexts(raw, lists)
@@ -1190,6 +1374,7 @@ class RingSidecar:
             "ring_telemetry": self.ring_telemetry(),
             "sched": self.sched.snapshot(),
             "mesh": self.mesh.describe(),
+            "pipeline": self._pipe.snapshot(),
         }
 
     def stop(self, join_timeout_s: float = 10.0) -> None:

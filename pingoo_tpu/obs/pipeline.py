@@ -1,0 +1,189 @@
+"""Overlapped-executor pipeline telemetry (ISSUE 9, docs/EXECUTOR.md).
+
+Both engine planes run the zero-copy pipelined executor — the Python
+listener service (engine/service.py, plane="python") and the ring
+sidecar (native_ring.RingSidecar, plane="sidecar") — and each owns one
+`PipelineStats` bundle exporting the obs/schema.PIPELINE_METRICS
+family on its plane label:
+
+  * pingoo_pipeline_inflight{plane}: batches currently between stage
+    entry and final resolve (the executor's live depth; bounded by
+    PINGOO_PIPELINE_DEPTH).
+  * pingoo_pipeline_depth{plane}: the configured in-flight bound.
+  * pingoo_pipeline_stage_occupancy{plane,stage}: fraction of wall
+    time each stage has been busy since boot — a stage near 1.0 is the
+    pipeline's bottleneck, stages summing past 1.0 prove overlap.
+  * pingoo_pipeline_overlap_ratio{plane}: EWMA fraction of each
+    batch's device-compute window that a DIFFERENT in-flight batch
+    spent in host-side encode/dispatch — the acceptance number for
+    "batch N+1 encodes while batch N scans" (> 0 means the executor is
+    actually overlapping, not just queueing).
+  * pingoo_pipeline_batches_total{plane,mode}: batches served, split
+    by executor mode (on = staged overlap, off = legacy lockstep), so
+    an A/B drive can attribute throughput to the arm that produced it.
+
+Interval bookkeeping is host-side float math on the plane's own
+serial context (event loop / drain thread): no locks, no arrays, no
+device access. Overlap is computed from (monotonic) stage wall
+intervals kept in a small ring: whichever of the two intervals in an
+(other-batch host stage, compute) pair is recorded second finds the
+first, so each pair is counted exactly once.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+# Executor stage names, in hot-path order. "encode" and "dispatch" are
+# host-side (staging fill, jit call issue); "compute" is the device
+# wall (the window other batches should overlap); "resolve" is the
+# host-side fan-out after results land.
+PIPELINE_EXEC_STAGES = ("encode", "dispatch", "compute", "resolve")
+
+# Host-side stages whose wall overlapping a DIFFERENT batch's compute
+# window is the overlap the executor exists to create.
+_HOST_STAGES = frozenset(("encode", "dispatch"))
+
+_EWMA_ALPHA = 0.2
+_RECENT_INTERVALS = 32
+
+
+class PipelineStats:
+    """One plane's pipeline instrument bundle + overlap bookkeeping.
+
+    Created eagerly at plane boot (like sched.SchedMetrics) so the full
+    PIPELINE_METRICS inventory exists from the first scrape; the mode
+    counters are created lazily per observed mode label.
+    """
+
+    def __init__(self, plane: str, depth: int, registry=None):
+        if registry is None:
+            from . import REGISTRY as registry  # noqa: N813
+        from . import schema
+
+        self.plane = plane
+        self._registry = registry
+        labels = {"plane": plane}
+        self.inflight = registry.gauge(
+            "pingoo_pipeline_inflight",
+            schema.PIPELINE_METRICS["pingoo_pipeline_inflight"],
+            labels=labels)
+        self.depth = registry.gauge(
+            "pingoo_pipeline_depth",
+            schema.PIPELINE_METRICS["pingoo_pipeline_depth"],
+            labels=labels)
+        self.depth.set(max(1, int(depth)))
+        self.overlap_ratio = registry.gauge(
+            "pingoo_pipeline_overlap_ratio",
+            schema.PIPELINE_METRICS["pingoo_pipeline_overlap_ratio"],
+            labels=labels)
+        self._occupancy = {
+            stage: registry.gauge(
+                "pingoo_pipeline_stage_occupancy",
+                schema.PIPELINE_METRICS["pingoo_pipeline_stage_occupancy"],
+                labels={"plane": plane, "stage": stage})
+            for stage in PIPELINE_EXEC_STAGES}
+        self._batches: dict[str, object] = {}
+        self._slot_seq = 0
+        self._t_boot = time.monotonic()
+        self._busy = dict.fromkeys(PIPELINE_EXEC_STAGES, 0.0)
+        # (slot, stage, t_start, t_end) of recent stage walls; 32 spans
+        # several pipeline depths of history on both planes.
+        self._recent: deque = deque(maxlen=_RECENT_INTERVALS)
+        self._overlap_ewma: float | None = None
+        self.overlap_events = 0
+
+    # -- batch lifecycle (hot) ----------------------------------------------
+
+    def enter(self, mode: str = "on") -> int:
+        """A batch entered the executor; returns its pipeline slot id
+        (monotonic per plane — flight-recorder rows carry it so an
+        explain/debug session can line batches up against the overlap
+        series)."""
+        self._slot_seq += 1
+        self.inflight.inc()
+        counter = self._batches.get(mode)
+        if counter is None:
+            from . import schema
+
+            counter = self._registry.counter(
+                "pingoo_pipeline_batches_total",
+                schema.PIPELINE_METRICS["pingoo_pipeline_batches_total"],
+                labels={"plane": self.plane, "mode": mode})
+            self._batches[mode] = counter
+        counter.inc()
+        return self._slot_seq
+
+    def exit(self) -> None:
+        self.inflight.dec()
+
+    def note_stage(self, slot: int, stage: str, t_start: float,
+                   t_end: float) -> None:
+        """Record one stage's wall interval (monotonic seconds) for the
+        given pipeline slot: updates the stage's occupancy gauge and,
+        when the interval pairs with a different slot's interval of the
+        opposite kind (host stage x compute), the overlap ratio."""
+        dur = t_end - t_start
+        if dur < 0.0:
+            return
+        busy = self._busy.get(stage)
+        if busy is None:  # unknown stage: occupancy only tracks the
+            return        # canonical four
+        self._busy[stage] = busy + dur
+        wall = t_end - self._t_boot
+        if wall > 0.0:
+            self._occupancy[stage].set(
+                min(1.0, round(self._busy[stage] / wall, 6)))
+        if stage == "compute":
+            self._score_overlap(slot, t_start, t_end,
+                                want_host=True, compute_dur=dur)
+        elif stage in _HOST_STAGES:
+            self._score_overlap(slot, t_start, t_end, want_host=False)
+        self._recent.append((slot, stage, t_start, t_end))
+
+    # -- overlap bookkeeping -------------------------------------------------
+
+    def _score_overlap(self, slot: int, t0: float, t1: float,
+                       want_host: bool,
+                       compute_dur: float = 0.0) -> None:
+        """Pair the just-finished interval against stored intervals of
+        the opposite kind from OTHER slots; the ratio denominator is
+        always the compute window (the thing being hidden)."""
+        for o_slot, o_stage, o_t0, o_t1 in self._recent:
+            if o_slot == slot:
+                continue
+            if want_host != (o_stage in _HOST_STAGES):
+                continue
+            ov = min(t1, o_t1) - max(t0, o_t0)
+            if ov <= 0.0:
+                continue
+            denom = compute_dur if want_host else (o_t1 - o_t0)
+            if denom <= 0.0:
+                continue
+            self._note_overlap(min(1.0, ov / denom))
+
+    def _note_overlap(self, ratio: float) -> None:
+        self.overlap_events += 1
+        prev = self._overlap_ewma
+        if prev is None:
+            self._overlap_ewma = ratio
+        else:
+            self._overlap_ewma = prev + _EWMA_ALPHA * (ratio - prev)
+        self.overlap_ratio.set(round(self._overlap_ewma, 6))
+
+    def snapshot(self) -> dict:
+        wall = max(time.monotonic() - self._t_boot, 1e-9)
+        return {
+            "plane": self.plane,
+            "depth": self.depth.value,
+            "inflight": self.inflight.value,
+            "batches": {mode: c.value
+                        for mode, c in sorted(self._batches.items())},
+            "overlap_ratio": (round(self._overlap_ewma, 4)
+                              if self._overlap_ewma is not None else None),
+            "overlap_events": self.overlap_events,
+            "stage_occupancy": {
+                stage: round(self._busy[stage] / wall, 4)
+                for stage in PIPELINE_EXEC_STAGES},
+        }

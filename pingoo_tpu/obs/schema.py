@@ -117,6 +117,32 @@ PARITY_METRICS = {
         "sampled batches dropped because the audit queue was full",
 }
 
+# Overlapped-executor pipeline metrics (ISSUE 9, docs/EXECUTOR.md):
+# exported by every plane that runs the batched verdict engine
+# (plane="python" listener service, plane="sidecar" ring drainer). The
+# instrument bundle lives in obs/pipeline.PipelineStats — both planes
+# construct one at boot, which is what makes the pingoo_pipeline_*
+# series exist under both plane labels. `stage_occupancy` carries a
+# `stage` label over obs/pipeline.PIPELINE_EXEC_STAGES;
+# `batches_total` carries a `mode` label (on = staged overlap,
+# off = legacy lockstep — the PINGOO_PIPELINE A/B arms).
+PIPELINE_METRICS = {
+    "pingoo_pipeline_inflight":
+        "batches currently in flight in the overlapped executor "
+        "(bounded by PINGOO_PIPELINE_DEPTH)",
+    "pingoo_pipeline_depth":
+        "configured executor in-flight bound (PINGOO_PIPELINE_DEPTH)",
+    "pingoo_pipeline_stage_occupancy":
+        "fraction of wall time this executor stage has been busy "
+        "since boot (stages summing past 1.0 prove overlap)",
+    "pingoo_pipeline_overlap_ratio":
+        "EWMA fraction of each batch's device-compute window that a "
+        "different in-flight batch spent in host-side encode/dispatch",
+    "pingoo_pipeline_batches_total":
+        "batches served by the executor, split by mode (on = staged "
+        "overlap, off = legacy lockstep)",
+}
+
 # Continuous-batching scheduler + serving-mesh metrics (ISSUE 6,
 # docs/SCHEDULER.md): exported by every plane that runs the batched
 # verdict engine (plane="python" listener service, plane="sidecar"
@@ -189,4 +215,5 @@ def all_metric_names() -> set[str]:
             | set(PREFILTER_METRICS) | set(DFA_METRICS)
             | set(PROVENANCE_METRICS)
             | set(PARITY_METRICS) | set(SCHED_METRICS)
+            | set(PIPELINE_METRICS)
             | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

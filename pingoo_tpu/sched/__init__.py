@@ -13,12 +13,14 @@ queue, the native plane's shm ring) and the compiled verdict programs:
 """
 
 from .mesh_exec import MeshExecutor, MeshUnavailable, mesh_env_spec
-from .scheduler import (BATCH_SIZE_BUCKETS, CostModel, SchedMetrics,
+from .scheduler import (BATCH_SIZE_BUCKETS, PIPELINE_COST_STAGES,
+                        CostModel, SchedMetrics,
                         Scheduler, SchedulerConfig,
                         seed_from_bench_history)
 
 __all__ = [
     "BATCH_SIZE_BUCKETS",
+    "PIPELINE_COST_STAGES",
     "CostModel",
     "MeshExecutor",
     "MeshUnavailable",

@@ -59,6 +59,18 @@ DEFAULT_SEED_MS = 1.5
 SCHED_MODES = ("continuous", "fixed")
 FAILOPEN_POLICIES = ("serve", "allow", "interpret")
 
+# Per-stage cost decomposition for the overlapped executor (ISSUE 9,
+# docs/EXECUTOR.md): once stages overlap across in-flight batches, the
+# single encode->result wall double-counts the time a batch spent
+# waiting on another batch's stage token, so the planes feed each
+# stage's ACTIVE wall separately and the estimate is their sum.
+PIPELINE_COST_STAGES = ("encode", "dispatch", "compute")
+
+# How the affine seed splits across stages before any per-stage
+# observation lands (fractions sum to 1.0 so a pure-seed estimate
+# matches the legacy single-wall seed exactly).
+STAGE_SEED_SPLIT = {"encode": 0.3, "dispatch": 0.2, "compute": 0.5}
+
 # pingoo_sched_batch_size histogram bounds: pow2 ladder matching the
 # padded launch sizes the engine actually compiles for.
 BATCH_SIZE_BUCKETS = (1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
@@ -155,18 +167,51 @@ class CostModel:
         self.seed_ms = max(float(seed_ms), 1e-3)
         self.alpha = float(alpha)
         self._ewma: dict[int, float] = {}
+        # Per-stage ACTIVE-wall EWMAs (ISSUE 9): stage -> bucket -> ms.
+        # Populated by the overlapped executor; once any stage has
+        # data, estimate() is the SUM of stage estimates — the single
+        # encode->result wall includes stage-token waits under overlap
+        # and would inflate should_launch's slack math.
+        self._stage_ewma: dict[str, dict[int, float]] = {}
 
     def _seed_for(self, bucket: int) -> float:
         cap = _pow2_bucket(self.max_batch, self.max_batch)
         return self.seed_ms * (0.5 + 0.5 * bucket / cap)
 
-    def estimate(self, batch_size: int) -> float:
-        """Expected dispatch+compute wall (ms) for a batch whose padded
-        size covers `batch_size` rows."""
-        bucket = _pow2_bucket(max(1, batch_size), self.max_batch)
+    def _baseline(self, bucket: int) -> float:
+        """Whole-batch wall estimate for one bucket: the legacy EWMA
+        when observed, the affine seed otherwise."""
         est = self._ewma.get(bucket)
         if est is None:
             return self._seed_for(bucket)
+        return est
+
+    def estimate(self, batch_size: int) -> float:
+        """Expected dispatch+compute wall (ms) for a batch whose padded
+        size covers `batch_size` rows. Stage-decomposed when the
+        executor feeds per-stage costs; unobserved stages fall back to
+        their STAGE_SEED_SPLIT share of the whole-batch baseline."""
+        bucket = _pow2_bucket(max(1, batch_size), self.max_batch)
+        if not self._stage_ewma:
+            return self._baseline(bucket)
+        base = self._baseline(bucket)
+        total = 0.0
+        for stage in PIPELINE_COST_STAGES:
+            est = self._stage_ewma.get(stage, {}).get(bucket)
+            if est is None:
+                est = STAGE_SEED_SPLIT[stage] * base
+            total += est
+        return total
+
+    def estimate_stage(self, stage: str, batch_size: int) -> float:
+        """Expected ACTIVE wall (ms) of ONE executor stage — the
+        per-stage fail-open budget checks size their remaining-work
+        slack with this instead of the whole-batch estimate."""
+        bucket = _pow2_bucket(max(1, batch_size), self.max_batch)
+        est = self._stage_ewma.get(stage, {}).get(bucket)
+        if est is None:
+            split = STAGE_SEED_SPLIT.get(stage, 1.0)
+            return split * self._baseline(bucket)
         return est
 
     def observe(self, batch_size: int, ms: float) -> None:
@@ -180,10 +225,31 @@ class CostModel:
         else:
             self._ewma[bucket] = prev + self.alpha * (ms - prev)
 
+    def observe_stage(self, stage: str, batch_size: int,
+                      ms: float) -> None:
+        """EWMA update for one executor stage's ACTIVE wall (hot) —
+        callers must exclude time spent waiting on stage tokens."""
+        if ms < 0 or stage not in STAGE_SEED_SPLIT:
+            return
+        bucket = _pow2_bucket(max(1, batch_size), self.max_batch)
+        stages = self._stage_ewma.get(stage)
+        if stages is None:
+            stages = self._stage_ewma[stage] = {}
+        prev = stages.get(bucket)
+        if prev is None:
+            stages[bucket] = ms
+        else:
+            stages[bucket] = prev + self.alpha * (ms - prev)
+
     def snapshot(self) -> dict:
         return {"seed_ms": round(self.seed_ms, 4),
                 "ewma_ms": {b: round(v, 4)
-                            for b, v in sorted(self._ewma.items())}}
+                            for b, v in sorted(self._ewma.items())},
+                "stage_ewma_ms": {
+                    stage: {b: round(v, 4)
+                            for b, v in sorted(buckets.items())}
+                    for stage, buckets in sorted(
+                        self._stage_ewma.items())}}
 
 
 class SchedMetrics:
@@ -298,6 +364,13 @@ class Scheduler:
 
     def observe_cost(self, batch_size: int, ms: float) -> None:
         self.cost.observe(batch_size, ms)
+
+    def observe_stage_cost(self, stage: str, batch_size: int,
+                           ms: float) -> None:
+        """Per-stage ACTIVE-wall feed from the overlapped executor
+        (hot; ISSUE 9) — keeps should_launch's slack estimate honest
+        once stages overlap across in-flight batches."""
+        self.cost.observe_stage(stage, batch_size, ms)
 
     def snapshot(self) -> dict:
         return {

@@ -65,6 +65,18 @@ HOT_FUNCTIONS = frozenset({
     "pingoo_tpu/sched/scheduler.py::CostModel.observe",
     "pingoo_tpu/sched/scheduler.py::CostModel.estimate",
     "pingoo_tpu/sched/mesh_exec.py::MeshExecutor.shard_batch",
+    # Zero-copy pipelined executor (ISSUE 9): the staging encoders run
+    # per batch under the encode token — they must FILL the reused
+    # buffers, never allocate fresh ones; the per-stage budget check
+    # and the stage cost/telemetry feeds are pure float math between
+    # dispatch and resolve.
+    "pingoo_tpu/engine/batch.py::StagingEncoder.encode_requests",
+    "pingoo_tpu/engine/batch.py::StagingEncoder.encode_slots",
+    "pingoo_tpu/engine/service.py::VerdictService._check_stage_budget",
+    "pingoo_tpu/sched/scheduler.py::CostModel.observe_stage",
+    "pingoo_tpu/sched/scheduler.py::CostModel.estimate_stage",
+    "pingoo_tpu/sched/scheduler.py::Scheduler.observe_stage_cost",
+    "pingoo_tpu/obs/pipeline.py::PipelineStats.note_stage",
 })
 
 # Functions traced by jax.jit that the AST cannot see are jitted (they

@@ -24,6 +24,8 @@ error. Tracked metrics and their directions:
     sched_p99_slack_ms          higher is better (deadline headroom)
     sched_deadline_miss_rate    lower  is better
     dfa_auto_req_per_s   higher is better (ISSUE 8 bitsplit-DFA arm)
+    pipeline_on_req_per_s  higher is better (ISSUE 9 pipelined executor)
+    pipeline_on_p99_ms     lower  is better
 
 Metrics missing from either run are skipped (partial/error lines are
 trajectory too, but only shared keys gate).
@@ -50,6 +52,9 @@ TRACKED = (
     ("sched_deadline_miss_rate", False),
     # Bitsplit-DFA lowering A/B (ISSUE 8, bench.py --dfa).
     ("dfa_auto_req_per_s", True),
+    # Zero-copy pipelined executor A/B (ISSUE 9, bench.py --pipeline).
+    ("pipeline_on_req_per_s", True),
+    ("pipeline_on_p99_ms", False),
 )
 
 DEFAULT_THRESHOLD = 0.10

@@ -128,6 +128,20 @@ def main() -> int:
                 problems.append(
                     f"{label}: scheduler wiring missing {symbol}")
 
+    # Pipelined-executor telemetry (ISSUE 9): the metric-name literals
+    # live in obs/pipeline.py (shared by both engine planes), and both
+    # planes must construct a PipelineStats — that is what makes the
+    # pingoo_pipeline_* series exist under both plane labels.
+    pipe_src = _read("pingoo_tpu/obs/pipeline.py")
+    for name in schema.PIPELINE_METRICS:
+        if name not in pipe_src:
+            problems.append(f"obs/pipeline.py: missing metric {name}")
+    for plane_src, label in ((service_src, "engine/service.py"),
+                             (sidecar_src, "native_ring.py")):
+        if "PipelineStats" not in plane_src:
+            problems.append(
+                f"{label}: pipeline wiring missing PipelineStats")
+
     # Flight-recorder + explain endpoints: the Python listener serves
     # both; the native plane serves its own flightrecorder dump (the
     # C++ exposition is string literals, so the source is the schema).
@@ -155,7 +169,8 @@ def main() -> int:
                             **schema.DFA_METRICS,
                             **schema.PROVENANCE_METRICS,
                             **schema.PARITY_METRICS,
-                            **schema.SCHED_METRICS}.items():
+                            **schema.SCHED_METRICS,
+                            **schema.PIPELINE_METRICS}.items():
         if name == "pingoo_sched_batch_size":
             # The one histogram in the sched family: lint it with its
             # real pow2 bucket ladder.
@@ -179,6 +194,10 @@ def main() -> int:
         "plane": "audit", "bank": "nfa_url@short"}).set(0.5)
     reg.counter("pingoo_dfa_banks_total", "", labels={
         "plane": "audit", "mode": "auto"}).inc()
+    reg.gauge("pingoo_pipeline_stage_occupancy", "", labels={
+        "plane": "audit", "stage": "encode"}).set(0.5)
+    reg.counter("pingoo_pipeline_batches_total", "", labels={
+        "plane": "audit", "mode": "on"}).inc()
     h = reg.histogram(schema.SHARED_WAIT_HISTOGRAM, "wait",
                       buckets=WAIT_BUCKETS_MS, labels={"plane": "audit"})
     for v in (0.5, 3, 70, 2000):
