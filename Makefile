@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: all native test check bench bench-regress audit asan \
-	metrics-smoke mesh-smoke clean \
+	metrics-smoke mesh-smoke chaos-smoke clean \
 	analyze analyze-abi analyze-lint analyze-tidy analyze-tsan
 
 all: native
@@ -19,6 +19,7 @@ check:
 	$(PY) -c "import pingoo_tpu.config, pingoo_tpu.compiler, pingoo_tpu.engine"
 	$(MAKE) analyze
 	$(MAKE) mesh-smoke
+	$(MAKE) chaos-smoke
 
 # Static analysis suite (docs/STATIC_ANALYSIS.md) — offline-safe; each
 # pass skips with a warning when its toolchain is missing, and each is
@@ -79,6 +80,15 @@ audit:
 # Offline-safe: skips with a warning when jax is unavailable.
 mesh-smoke:
 	$(PY) tools/mesh_smoke.py
+
+# Sidecar supervision chaos smoke (ISSUE 10, docs/RESILIENCE.md):
+# SIGKILL the ring sidecar mid-batch and prove crash-reattach
+# reconciliation (zero lost / double-posted tickets, bounded p99,
+# bit-exact verdicts), heartbeat-freeze detection, and ladder demotion
+# under injected device faults. Offline-safe: skips with a warning
+# when jax or the native toolchain is unavailable.
+chaos-smoke:
+	$(PY) tools/chaos_smoke.py
 
 # Live observability smoke: boot the native plane + ring sidecar + a
 # Python listener, scrape both /__pingoo/metrics endpoints in both

@@ -308,6 +308,16 @@ class VerdictService:
         # PINGOO_MESH asks for more than one device.
         self.sched = Scheduler(SchedulerConfig.from_env(max_batch),
                                plane="python")
+        # Degradation ladder (ISSUE 10, docs/RESILIENCE.md): this
+        # plane's scattered fallbacks (staging->legacy encode,
+        # DFA->NFA, mesh->single-device, device->interpreter) report
+        # through one state machine — demotions are counted per rung
+        # and probed back with exponential backoff.
+        from .ladder import DegradationLadder
+
+        self.ladder = DegradationLadder("python")
+        self._dfa_probe = False
+        self._dfa_mode0 = getattr(plan, "dfa_default_mode", "auto")
         self.mesh: Optional[MeshExecutor] = None
         # Double-buffered dispatch: up to this many batches in flight,
         # so batch N+1 assembles/encodes while batch N computes (the
@@ -398,7 +408,11 @@ class VerdictService:
                 elif device is not None:
                     tables = jax.device_put(tables, device)
                 self._tables = tables
-            except Exception:
+            except Exception as exc:
+                # Boot-time demotion is permanent for this service (no
+                # tables to probe against), but still counted/logged
+                # through the ladder's device rung.
+                self.ladder.note_failure("device", exc)
                 self.use_device = False
         else:
             self.use_device = False
@@ -413,13 +427,58 @@ class VerdictService:
             return MeshExecutor(plan, plane="python",
                                 metrics=self.sched.metrics)
         except (MeshUnavailable, ValueError) as exc:
-            from ..logging_utils import get_logger
-
-            get_logger("pingoo_tpu.sched").warning(
-                "serving mesh unavailable; single-device path",
-                extra={"fields": {"error": str(exc)}})
+            self.ladder.note_failure("mesh", exc)
             return MeshExecutor(plan, spec=(1, 1, 1), plane="python",
                                 metrics=self.sched.metrics)
+
+    # -- degradation ladder (ISSUE 10, docs/RESILIENCE.md) --------------------
+
+    def _rebuild_verdict_fn(self, dfa_off: bool) -> None:
+        """Re-trace the verdict fn with the lowered DFAs in or out
+        (plan-level default — what `_resolve_dfa_mode` falls back to
+        when PINGOO_DFA is unset). The next batch pays one re-jit."""
+        from .verdict import donate_batch_buffers
+
+        self.plan.dfa_default_mode = "off" if dfa_off else self._dfa_mode0
+        self._verdict_fn = make_verdict_fn(
+            self.plan, donate=donate_batch_buffers())
+
+    def _dfa_rung_tick(self) -> None:
+        """Demoted-dfa probe: when the backoff window opens, restore
+        the lowered-DFA dispatch for one batch; the device success /
+        failure report then promotes or re-demotes."""
+        if not self.use_device:
+            return
+        if not self.ladder.healthy("dfa") and not self._dfa_probe \
+                and self.ladder.try_rung("dfa"):
+            self._rebuild_verdict_fn(dfa_off=False)
+            self._dfa_probe = True
+
+    def _note_device_failure(self, exc: BaseException) -> None:
+        """Cheapest-rung-first demotion: a device error with lowered
+        DFAs active drops them back to the exact NFA scan before
+        giving up on the device; only a failure with the DFAs already
+        out (or pinned by PINGOO_DFA) demotes the device rung to the
+        host interpreter."""
+        from .verdict import dfa_dispatch_counts
+
+        if self._dfa_probe:
+            self.ladder.note_failure("dfa", exc)
+            self._rebuild_verdict_fn(dfa_off=True)
+            self._dfa_probe = False
+        elif self.ladder.healthy("dfa") \
+                and not os.environ.get("PINGOO_DFA") \
+                and dfa_dispatch_counts(self.plan)[1] > 0:
+            self.ladder.note_failure("dfa", exc)
+            self._rebuild_verdict_fn(dfa_off=True)
+        else:
+            self.ladder.note_failure("device", exc)
+
+    def _note_device_success(self) -> None:
+        if self._dfa_probe:
+            self.ladder.note_success("dfa")
+            self._dfa_probe = False
+        self.ladder.note_success("device")
 
     async def start(self) -> None:
         if self._task is None:
@@ -863,15 +922,27 @@ class VerdictService:
         self._last_batch_stages = stages  # latest batch (introspection)
         pipe_slot = stages.get("pipeline_slot")
         n = len(reqs)
-        if self._staging is not None:
-            with self._stage_tokens["encode"]:
-                t0 = time.monotonic()
-                batch = self._staging.encode_requests(
-                    reqs, pad_to=self._pow2_size(n))
-                t1 = time.monotonic()
-            if pipe_slot is not None:
-                self._pipe.note_stage(pipe_slot, "encode", t0, t1)
-        else:
+        batch = None
+        staged = False
+        if self._staging is not None and self.ladder.try_rung("pipeline"):
+            try:
+                with self._stage_tokens["encode"]:
+                    t0 = time.monotonic()
+                    batch = self._staging.encode_requests(
+                        reqs, pad_to=self._pow2_size(n))
+                    t1 = time.monotonic()
+                staged = True
+                self.ladder.note_success("pipeline")
+                if pipe_slot is not None:
+                    self._pipe.note_stage(pipe_slot, "encode", t0, t1)
+            except Exception as exc:
+                # Ladder pipeline rung: a broken staging encoder
+                # demotes this plane to the legacy encode chain below
+                # (bit-identical, tests/test_pipeline.py) until a
+                # backoff probe re-promotes it.
+                self.ladder.note_failure("pipeline", exc)
+                batch = None
+        if batch is None:
             t0 = time.monotonic()
             batch = encode_requests(reqs, self.plan.field_specs)
             t1 = time.monotonic()
@@ -902,7 +973,7 @@ class VerdictService:
                 # broken scorer must show up on the metrics surface.
                 self.stats.score_errors += 1
         matched = self._evaluate_sync(reqs, batch, stages, t_launch,
-                                      staged=self._staging is not None)
+                                      staged=staged)
         # pingoo: allow(hot-alloc): [B] f32 default score vector
         scores = np.zeros(n, dtype=np.float32)
         if score_dev is not None:
@@ -968,7 +1039,12 @@ class VerdictService:
             staged = False
         pipe_slot = (stages or {}).get("pipeline_slot")
         matched = None
-        if self.use_device:
+        # Ladder device rung: while demoted, skip the dispatch entirely
+        # (the host interpreter serves below) except for backoff probes;
+        # a device exception demotes instead of staying an anonymous
+        # device_errors increment.
+        self._dfa_rung_tick()
+        if self.use_device and self.ladder.try_rung("device"):
             try:
                 if staged:
                     # Staging path (ISSUE 9): the encoder already
@@ -1044,10 +1120,13 @@ class VerdictService:
                 if pf_aux is not None:
                     self._observe_prefilter(pf_aux, fast.size)
                 self._observe_dfa()
+                self._note_device_success()
             except _StageBudgetExceeded:
                 raise
-            except Exception:
+            except Exception as exc:
                 self.stats.device_errors += 1
+                self._note_device_failure(exc)
+                matched = None
         if matched is None:
             self.stats.host_fallback_batches += 1
             # [:n]: the staging batch carries pow2 padding rows the

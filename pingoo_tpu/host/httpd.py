@@ -694,6 +694,7 @@ class HttpListener:
             "req_per_s": round(self.stats.requests / uptime, 2) if uptime else 0,
             "verdict": self.verdict.stats.snapshot(),
             "pipeline": self.verdict.pipeline_snapshot(),
+            "ladder": self.verdict.ladder.snapshot(),
         }
         return Response(200, [("content-type", "application/json")],
                         json.dumps(payload).encode())

@@ -134,6 +134,15 @@ class Server:
         self.verdict = VerdictService(plan, lists, use_device=use_device,
                                       bot_score_params=bot_params)
         await self.verdict.start()
+        # Boot-time degradation surface (ISSUE 10, docs/RESILIENCE.md):
+        # rungs already demoted at startup (broken backend, mesh spec
+        # too big) are easy to miss in counters — log them once, here.
+        demoted = self.verdict.ladder.demoted()
+        if demoted:
+            get_logger("pingoo_tpu.server").warning(
+                "boot with demoted rungs", extra={"fields": {
+                    "demoted": demoted,
+                    "ladder": self.verdict.ladder.snapshot()}})
 
         tls_manager: Optional[TlsManager] = None
         if any(l.protocol.is_tls for l in config.listeners) or \

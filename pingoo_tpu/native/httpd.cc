@@ -40,13 +40,17 @@
 //     the end of the batch so stale events for a reused fd can never
 //     touch a fresh connection.
 //   * SIGPIPE is ignored; short writes buffer and arm EPOLLOUT.
-//   * A sidecar stall fails OPEN twice over: ring-full -> proxy without
-//     a verdict immediately; a verdict never arriving -> the idle sweep
-//     fails the request open after kVerdictTimeoutS (mirrors the
-//     reference's rule-error fail-open, pingoo/rules.rs:41-44).
+//   * A sidecar stall fails OPEN three times over: ring-full -> proxy
+//     without a verdict immediately; a verdict never arriving -> the
+//     per-iteration deadline sweep fails the request open after
+//     kVerdictTimeoutMs (mirrors the reference's rule-error fail-open,
+//     pingoo/rules.rs:41-44); a stale heartbeat (older than
+//     kSidecarTimeoutMs, ring header v5) -> degraded mode: every
+//     awaiting ticket fails open at once and new requests bypass the
+//     ring until a fresh heartbeat lifts it (docs/RESILIENCE.md).
 //   * Idle sweeps cover every state: head/handshake after
-//     kIdleTimeoutS, awaiting-verdict after kVerdictTimeoutS (fail
-//     open), proxying after kProxyIdleTimeoutS.
+//     kIdleTimeoutS, awaiting-verdict via sweep_verdict_deadlines()
+//     (fail open), proxying after kProxyIdleTimeoutS.
 //
 // Usage: httpd <listen-port> <ring-file> <upstream-host> <upstream-port>
 //          [--captcha-upstream host:port] [--jwks path]
@@ -107,8 +111,46 @@ inline size_t parse_max_buffered() {
 }
 const size_t kMaxBuffered = parse_max_buffered();
 constexpr time_t kIdleTimeoutS = 30;
-constexpr time_t kVerdictTimeoutS = 3;   // then fail open
 constexpr time_t kTunnelIdleS = 300;     // upgraded (WebSocket) tunnels
+
+// Per-request verdict fail-open deadline (ISSUE 10). Defaulted from
+// the scheduler's deadline budget — 1500 x PINGOO_DEADLINE_MS, which
+// keeps the historical 3 s at the 2 ms default (the first sidecar
+// batch can sit behind a multi-second XLA compile) while configuring
+// both knobs in one place. PINGOO_VERDICT_TIMEOUT_MS overrides it
+// directly; out-of-range values warn and fall back.
+inline uint64_t parse_verdict_timeout_ms() {
+  double deadline_ms = 2.0;
+  if (const char* d = getenv("PINGOO_DEADLINE_MS")) {
+    double v = atof(d);
+    if (v > 0) deadline_ms = v;
+  }
+  uint64_t def = static_cast<uint64_t>(deadline_ms * 1500.0);
+  if (def == 0) def = 1;
+  const char* e = getenv("PINGOO_VERDICT_TIMEOUT_MS");
+  if (e == nullptr || *e == '\0') return def;
+  long n = atol(e);
+  if (n <= 0) {
+    fprintf(stderr,
+            "PINGOO_VERDICT_TIMEOUT_MS=%s out of range (<= 0); using %llu\n",
+            e, static_cast<unsigned long long>(def));
+    return def;
+  }
+  return static_cast<uint64_t>(n);
+}
+const uint64_t kVerdictTimeoutMs = parse_verdict_timeout_ms();
+
+// Sidecar liveness window (ISSUE 10, docs/RESILIENCE.md): with a ring
+// attached, a heartbeat older than this flips the plane into the
+// degraded fast-path (immediate fail-open, no per-request stall) until
+// a fresh heartbeat arrives. 0 disables detection.
+inline uint64_t parse_sidecar_timeout_ms() {
+  const char* e = getenv("PINGOO_SIDECAR_TIMEOUT_MS");
+  if (e == nullptr || *e == '\0') return 500;
+  long n = atol(e);
+  return n > 0 ? static_cast<uint64_t>(n) : 0;
+}
+const uint64_t kSidecarTimeoutMs = parse_sidecar_timeout_ms();
 // TCP proxy mode (reference tcp_proxy_service.rs:30-84): 3 connect
 // tries, 3 s timeout each. The reference sleeps 5 ms between tries;
 // this plane re-dials immediately on a failed connect (a fresh random
@@ -1881,6 +1923,7 @@ class Server {
     uint64_t upstream_fail = 0;   // 502s
     uint64_t upstream_tls_fail = 0;  // client handshake/verify failures
     uint64_t verdicts = 0;        // verdict bytes applied
+    uint64_t degraded_entered = 0;  // degraded-mode transitions (enter)
     // log-scale verdict wait histogram (enqueue -> apply), upper bounds
     // in ms: 1, 2, 5, 10, 50, 100, 1000, +inf — the SHARED bucket set
     // (pingoo_tpu/obs/schema.py SHARED_WAIT_BUCKETS_MS); the JSON
@@ -1955,6 +1998,10 @@ class Server {
     kv_u64("awaiting", awaiting_.size());
     kv_u64("connections", conns_.size());
     kv_u64("pooled_upstreams", pooled);
+    kv_u64("degraded", degraded_ ? 1 : 0);
+    kv_u64("degraded_entered", stats_.degraded_entered);
+    kv_u64("sidecar_up", (sidecar_seen_ && !degraded_) ? 1 : 0);
+    kv_u64("sidecar_epoch", sidecar_epoch_);
     out += ", \"ring\": {";
     kv_u64("enqueued", tel[0], true);
     kv_u64("enqueue_full", tel[1]);
@@ -2000,6 +2047,15 @@ class Server {
     metric("counter", "pingoo_verdicts_total", stats_.verdicts);
     metric("gauge", "pingoo_connections", conns_.size());
     metric("gauge", "pingoo_pooled_upstreams", pooled);
+    // Sidecar supervision (ISSUE 10): sidecar_up stays 0 until a
+    // heartbeat has ever landed, so "no sidecar yet" and "sidecar
+    // died" alert the same way; epoch counts (re)attaches.
+    metric("gauge", "pingoo_sidecar_up",
+           (sidecar_seen_ && !degraded_) ? 1 : 0);
+    metric("gauge", "pingoo_degraded_mode", degraded_ ? 1 : 0);
+    metric("gauge", "pingoo_sidecar_epoch", sidecar_epoch_);
+    metric("counter", "pingoo_degraded_entered_total",
+           stats_.degraded_entered);
     metric("counter", "pingoo_ring_enqueued_total", tel[0]);
     metric("counter", "pingoo_ring_enqueue_full_total", tel[1]);
     metric("counter", "pingoo_ring_dequeued_total", tel[2]);
@@ -2187,13 +2243,9 @@ class Server {
           if (idle > kIdleTimeoutS) mark_close(c);
           break;
         case ConnState::kAwaitingVerdict:
-          // A stalled/crashed sidecar must not leak connections: fail
-          // OPEN like the ring-full path (pingoo/rules.rs:41-44).
-          if (idle > kVerdictTimeoutS) {
-            drop_ticket(c);
-            stats_.fail_open++;
-            fail_open_proxy(c);
-          }
+          // Verdict deadlines are ms-granularity and handled by
+          // sweep_verdict_deadlines() every event-loop pass; nothing
+          // to do on the 1 s tick.
           break;
         case ConnState::kProxying:
           if (idle > kProxyIdleTimeoutS) mark_close(c);
@@ -2211,26 +2263,11 @@ class Server {
           // WebSockets idle legitimately (pings may be minutes apart).
           if (idle > kTunnelIdleS) mark_close(c);
           break;
-        case ConnState::kH2: {
+        case ConnState::kH2:
           // Streams stuck awaiting verdicts fail open on their own
-          // timers (frame activity keeps last_active fresh, so each
-          // ticket gets a dedicated timestamp).
-          bool failed_open = false;
-          for (auto& kv : c->h2_streams) {
-            H2Stream& st = kv.second;
-            if (st.ticket != UINT64_MAX &&
-                now_ - st.verdict_at > kVerdictTimeoutS) {
-              awaiting_.erase(st.ticket);
-              st.ticket = UINT64_MAX;
-              stats_.fail_open++;
-              h2_stream_fail_open(c, kv.first);
-              failed_open = true;
-            }
-          }
-          if (failed_open) h2_flush(c);
+          // ms-granularity timers in sweep_verdict_deadlines().
           if (idle > kProxyIdleTimeoutS) mark_close(c);
           break;
-        }
       }
     }
   }
@@ -2964,6 +3001,123 @@ class Server {
     }
   }
 
+  // -- sidecar supervision (ISSUE 10, docs/RESILIENCE.md) --------------------
+  // Two independent fail-open layers above the ring-full path:
+  //   1. sweep_verdict_deadlines(): per-ticket ms-granularity deadline
+  //      (kVerdictTimeoutMs) checked every event-loop pass — replaces
+  //      the old once-a-second kVerdictTimeoutS sweep whose coarse
+  //      clock added up to ~1 s of detection slop.
+  //   2. check_sidecar_liveness(): ring-header heartbeat (v5). A stamp
+  //      older than kSidecarTimeoutMs flips degraded mode: every
+  //      awaiting ticket fails open NOW and run_policy bypasses the
+  //      ring entirely, so a dead sidecar costs one detection window
+  //      instead of one verdict timeout per request. A fresh heartbeat
+  //      (the restarted sidecar's attach bumps the epoch) lifts it.
+
+  // Fail one awaiting ticket open and record it. The awaiting_ entry
+  // must already be erased (or never inserted) by the caller.
+  void fail_open_ticket(Conn* c, int32_t sid, uint64_t ticket) {
+    stats_.fail_open++;
+    if (sid != 0) {
+      auto sit = c->h2_streams.find(sid);
+      if (sit == c->h2_streams.end()) return;  // stream reset meanwhile
+      sit->second.ticket = UINT64_MAX;
+      flight_record(sit->second.p, ticket, sit->second.enq_ms, 0, 3);
+      h2_stream_fail_open(c, sid);
+      h2_flush(c);
+    } else {
+      c->ticket = UINT64_MAX;
+      flight_record(c->req, ticket, c->enq_ms, 0, 3);
+      fail_open_proxy(c);
+    }
+  }
+
+  void sweep_verdict_deadlines() {
+    if (awaiting_.empty()) return;
+    uint64_t now = now_ms();
+    if (now == last_deadline_sweep_ms_) return;  // at most one pass per ms
+    last_deadline_sweep_ms_ = now;
+    // Collect first: fail_open_ticket mutates conns/streams and must
+    // not run under the awaiting_ iterator.
+    expired_.clear();
+    for (const auto& kv : awaiting_) {
+      const Awaiting& aw = kv.second;
+      uint64_t enq = 0;
+      if (aw.sid != 0) {
+        auto sit = aw.conn->h2_streams.find(aw.sid);
+        if (sit != aw.conn->h2_streams.end()) enq = sit->second.enq_ms;
+      } else {
+        enq = aw.conn->enq_ms;
+      }
+      if (enq != 0 && now - enq > kVerdictTimeoutMs)
+        expired_.push_back(kv.first);
+    }
+    for (uint64_t ticket : expired_) {
+      auto it = awaiting_.find(ticket);
+      if (it == awaiting_.end()) continue;
+      Awaiting aw = it->second;
+      awaiting_.erase(it);
+      if (aw.conn->dead) continue;
+      fail_open_ticket(aw.conn, aw.sid, ticket);
+    }
+  }
+
+  void fail_open_all_awaiting() {
+    std::vector<std::pair<uint64_t, Awaiting>> inflight;
+    inflight.reserve(awaiting_.size());
+    for (const auto& kv : awaiting_) inflight.push_back(kv);
+    awaiting_.clear();
+    for (const auto& kv : inflight) {
+      if (kv.second.conn->dead) continue;
+      fail_open_ticket(kv.second.conn, kv.second.sid, kv.first);
+    }
+  }
+
+  bool degraded() const { return degraded_; }
+
+  void check_sidecar_liveness() {
+    if (kSidecarTimeoutMs == 0 || tcp_mode_) return;
+    uint64_t lv[5];  // epoch, heartbeat_ms, posted_floor, req_tail, now_ms
+    pingoo_ring_liveness(ring_, lv);
+    sidecar_epoch_ = lv[0];
+    // Bootstrap: until a sidecar has ever attached (heartbeat 0) the
+    // per-request deadline governs — flipping degraded here would only
+    // mask a missing sidecar during bring-up.
+    if (lv[1] == 0) return;
+    sidecar_seen_ = true;
+    uint64_t age = lv[4] > lv[1] ? lv[4] - lv[1] : 0;
+    bool stale = age > kSidecarTimeoutMs;
+    if (stale && !degraded_) {
+      degraded_ = true;
+      stats_.degraded_entered++;
+      std::fprintf(stderr,
+                   "pingoo-httpd: DEGRADED (sidecar heartbeat %llu ms stale, "
+                   "epoch %llu); failing %zu awaiting ticket(s) open\n",
+                   static_cast<unsigned long long>(age),
+                   static_cast<unsigned long long>(lv[0]),
+                   awaiting_.size());
+      flight_record_transition("degraded-enter");
+      fail_open_all_awaiting();
+    } else if (!stale && degraded_) {
+      degraded_ = false;
+      std::fprintf(stderr,
+                   "pingoo-httpd: RECOVERED (sidecar epoch %llu heartbeat "
+                   "fresh); resuming ring enqueues\n",
+                   static_cast<unsigned long long>(lv[0]));
+      flight_record_transition("degraded-exit");
+    }
+  }
+
+  // Degrade/recover transitions land in the flight recorder as
+  // synthetic SYS entries so /__pingoo/flightrecorder shows them
+  // inline with the requests they affected.
+  void flight_record_transition(const char* what) {
+    Parsed p;
+    p.method = "SYS";
+    p.path = std::string("/") + what;
+    flight_record(p, UINT64_MAX, 0, 0, 3);
+  }
+
   // Verdict byte: bits 0-1 unverified action, bit 2 verified-block
   // (native_ring.py RingSidecar) — the reference loop skips Captcha
   // actions for verified clients but still blocks on Block
@@ -3211,6 +3365,11 @@ class Server {
     }
     if (sid != 0) c->h2_streams[sid].verified = verified;
     else c->captcha_verified = verified;
+
+    // Degraded fast-path (stale sidecar heartbeat): don't enqueue a
+    // ticket no one will answer — fail open immediately instead of
+    // stalling the request for a verdict timeout.
+    if (degraded_) return Policy::kFailOpenProxy;
 
     uint8_t ip[16] = {0, 0, 0, 0, 0, 0, 0, 0, 0, 0, 0xff, 0xff, 0, 0, 0, 0};
     in_addr v4{};
@@ -4880,6 +5039,12 @@ class Server {
     int32_t sid;  // 0 = the h1 request cycle, else an h2 stream
   };
   std::unordered_map<uint64_t, Awaiting> awaiting_;
+  // Sidecar supervision state (ISSUE 10, docs/RESILIENCE.md).
+  bool degraded_ = false;        // heartbeat stale: bypass the ring
+  bool sidecar_seen_ = false;    // a sidecar heartbeat has ever landed
+  uint64_t sidecar_epoch_ = 0;   // last epoch read from the ring header
+  uint64_t last_deadline_sweep_ms_ = 0;
+  std::vector<uint64_t> expired_;  // sweep_verdict_deadlines scratch
   std::vector<SockRef*> doomed_refs_;  // per-stream refs freed after the batch
   std::unordered_map<SSL*, Conn*> ssl_conn_;
   std::vector<Conn*> doomed_;
@@ -5204,6 +5369,12 @@ int main(int argc, char** argv) {
     time_t now = time(nullptr);
     server.set_now(now);
     server.drain_verdicts();
+    // Sidecar supervision (ISSUE 10): heartbeat check (a few shm
+    // loads) + ms-granularity verdict deadlines (self-throttled to one
+    // pass per ms) run every iteration, so a dead sidecar costs one
+    // detection window, not a seconds-long stall.
+    server.check_sidecar_liveness();
+    server.sweep_verdict_deadlines();
 
     if (g_sigterm && !draining) {
       draining = true;

@@ -329,6 +329,85 @@ void pingoo_ring_telemetry_snapshot(void* mem, uint64_t* out) {
     out[8 + b] = rd(&tel->wait_hist[b]);
 }
 
+// -- Liveness / supervision protocol (v5, ISSUE 10) --------------------------
+
+uint64_t pingoo_ring_sidecar_attach(void* mem) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  uint64_t epoch =
+      as_atomic(&header->sidecar_epoch)->fetch_add(1, std::memory_order_acq_rel)
+      + 1;
+  as_atomic(&header->sidecar_heartbeat_ms)
+      ->store(pingoo_ring_now_ms(), std::memory_order_release);
+  return epoch;
+}
+
+void pingoo_ring_heartbeat(void* mem) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  as_atomic(&header->sidecar_heartbeat_ms)
+      ->store(pingoo_ring_now_ms(), std::memory_order_relaxed);
+}
+
+void pingoo_ring_liveness(void* mem, uint64_t out[5]) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  out[0] = as_atomic(&header->sidecar_epoch)->load(std::memory_order_acquire);
+  out[1] = as_atomic(&header->sidecar_heartbeat_ms)
+               ->load(std::memory_order_relaxed);
+  out[2] = as_atomic(&header->posted_floor)->load(std::memory_order_relaxed);
+  out[3] = as_atomic(&header->req_tail)->load(std::memory_order_relaxed);
+  out[4] = pingoo_ring_now_ms();
+}
+
+void pingoo_ring_set_posted_floor(void* mem, uint64_t ticket) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  // CAS-max: batches complete FIFO on one drain thread today, but a
+  // monotonic floor must survive any future completion reordering.
+  auto* a = as_atomic(&header->posted_floor);
+  uint64_t cur = a->load(std::memory_order_relaxed);
+  while (ticket > cur &&
+         !a->compare_exchange_weak(cur, ticket, std::memory_order_release)) {
+  }
+}
+
+int pingoo_ring_reclaim_request(void* mem, uint64_t ticket,
+                                PingooRequestSlot* out) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  uint32_t cap = header->capacity;
+  Layout l = layout(mem, cap);
+  PingooRequestSlot* slot = &l.req[ticket & (cap - 1)];
+  uint64_t seq = as_atomic(&slot->seq)->load(std::memory_order_acquire);
+  if (seq == ticket + 1) {
+    // The dead consumer CASed req_tail past this position but died
+    // before releasing the slot seq: the bytes are intact, and nothing
+    // else will ever touch this slot (a producer needs seq == ticket +
+    // cap) — copy, then release, or the ring wedges here forever on
+    // wraparound.
+    std::memcpy(out, slot, sizeof(PingooRequestSlot));
+    as_atomic(&slot->seq)->store(ticket + cap, std::memory_order_release);
+    tel_add(&header->telemetry.dequeued, 1);
+    return 0;
+  }
+  if (seq == ticket + cap) {
+    // Cleanly consumed and released. The bytes survive until a producer
+    // claims position ticket+cap, so guard the copy seqlock-style: the
+    // producer CASes req_head past ticket+cap BEFORE writing, so an
+    // unmoved head after the copy proves the bytes were stable.
+    uint64_t head =
+        as_atomic(&header->req_head)->load(std::memory_order_acquire);
+    if (head <= ticket + cap) {
+      std::memcpy(out, slot, sizeof(PingooRequestSlot));
+      std::atomic_thread_fence(std::memory_order_acquire);
+      uint64_t head2 =
+          as_atomic(&header->req_head)->load(std::memory_order_acquire);
+      uint64_t seq2 = as_atomic(&slot->seq)->load(std::memory_order_acquire);
+      if (head2 <= ticket + cap && seq2 == ticket + cap &&
+          out->ticket == ticket) {
+        return 0;
+      }
+    }
+  }
+  return -1;  // bytes gone (slot reused): the caller fail-opens
+}
+
 int pingoo_ring_poll_verdict(void* mem, uint64_t* ticket_out,
                              uint8_t* action_out, float* score_out) {
   auto* header = static_cast<PingooRingHeader*>(mem);

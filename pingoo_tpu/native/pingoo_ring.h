@@ -42,7 +42,15 @@ extern "C" {
 #define PINGOO_RING_MAGIC 0x50474f52u  // "PGOR"
 // v4: slot carries enq_ms (monotonic enqueue timestamp) and the header
 // grows an atomic telemetry block (ISSUE 2 observability).
-#define PINGOO_RING_VERSION 4u
+// v5: the header grows a liveness block (ISSUE 10 sidecar supervision):
+// sidecar_epoch (monotonically bumped on every sidecar attach, so the
+// data plane can tell a restart from a stall), sidecar_heartbeat_ms
+// (stamped by the sidecar each poll cycle; the httpd event loop flips
+// into the degraded fast-path when it goes stale past
+// PINGOO_SIDECAR_TIMEOUT_MS), and posted_floor (all tickets below it
+// have verdicts posted — the crash-reattach reconciliation scans
+// [posted_floor, req_tail) for orphans).
+#define PINGOO_RING_VERSION 5u
 
 #define PINGOO_METHOD_CAP 16
 #define PINGOO_HOST_CAP 256
@@ -146,6 +154,11 @@ typedef struct {
   PINGOO_ALIGN64 uint64_t ver_head;
   PINGOO_ALIGN64 uint64_t ver_tail;
   PINGOO_ALIGN64 PingooRingTelemetry telemetry;
+  // Liveness block (v5, ISSUE 10): its own cache line so heartbeat
+  // stores never contend with the head/tail CAS lines.
+  PINGOO_ALIGN64 uint64_t sidecar_epoch;   // bumped on sidecar attach
+  uint64_t sidecar_heartbeat_ms;           // pingoo_ring_now_ms stamp
+  uint64_t posted_floor;                   // tickets < floor have verdicts
 } PingooRingHeader;
 
 // Size of the full mapping for a given capacity.
@@ -207,6 +220,40 @@ void pingoo_ring_record_waits(void* mem, const uint64_t* enq_ms,
 // CLOCK_MONOTONIC milliseconds — the enq_ms time base, exported so
 // out-of-process consumers compute waits against the same clock.
 uint64_t pingoo_ring_now_ms(void);
+
+// -- Liveness / supervision protocol (v5, ISSUE 10) --------------------------
+
+// Sidecar attach: bump the epoch (release), stamp the first heartbeat,
+// and return the NEW epoch. Called once per sidecar boot/reattach; a
+// data plane observing the epoch change knows the previous consumer is
+// gone and any reconciliation is the new epoch's responsibility.
+uint64_t pingoo_ring_sidecar_attach(void* mem);
+
+// Stamp the heartbeat with pingoo_ring_now_ms() (relaxed store; the
+// sidecar calls this every poll cycle — staleness, not ordering, is
+// the signal).
+void pingoo_ring_heartbeat(void* mem);
+
+// Snapshot the liveness block into out[5]: epoch, heartbeat_ms,
+// posted_floor, req_tail, now_ms — one call so the data plane's event
+// loop reads a consistent-enough picture with a single FFI/shm touch.
+void pingoo_ring_liveness(void* mem, uint64_t out[5]);
+
+// Advance the posted floor to `ticket` (monotonic max; relaxed CAS
+// loop so late batch completions can't move it backwards). All tickets
+// below the floor have verdicts posted.
+void pingoo_ring_set_posted_floor(void* mem, uint64_t ticket);
+
+// Reclaim one orphaned request ticket during crash-reattach
+// reconciliation (tickets in [posted_floor, req_tail)). Returns 0 and
+// copies the slot into `out` when the request bytes are still intact
+// (the new sidecar re-evaluates them); returns -1 when the bytes are
+// gone (a producer reclaimed the slot — the caller fail-opens the
+// ticket instead). Also releases slots wedged by a consumer that died
+// between its tail-CAS and seq-release, which would otherwise stall
+// the ring forever at that position.
+int pingoo_ring_reclaim_request(void* mem, uint64_t ticket,
+                                PingooRequestSlot* out);
 
 #ifdef __cplusplus
 }  // extern "C"

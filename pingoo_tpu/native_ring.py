@@ -14,6 +14,7 @@ item 4 running end-to-end.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import mmap
 import os
@@ -43,10 +44,10 @@ SPILL_NONE = 0xFF  # PINGOO_SPILL_NONE
 # table (tools/analyze/abi_golden.json). Change the header, the dtypes,
 # and the golden together or the check fails.
 
-RING_FORMAT_VERSION = 4  # PINGOO_RING_VERSION
+RING_FORMAT_VERSION = 5  # PINGOO_RING_VERSION
 REQUEST_SLOT_SIZE = 4688  # sizeof(PingooRequestSlot)
 VERDICT_SLOT_SIZE = 24  # sizeof(PingooVerdictSlot)
-RING_HEADER_SIZE = 448  # sizeof(PingooRingHeader)
+RING_HEADER_SIZE = 512  # sizeof(PingooRingHeader)
 TELEMETRY_BLOCK_SIZE = 128  # sizeof(PingooRingTelemetry)
 SPILL_SLOT_SIZE = 65552  # sizeof(PingooSpillSlot)
 WAIT_BUCKETS = 8  # PINGOO_WAIT_BUCKETS
@@ -96,14 +97,18 @@ TELEMETRY_DTYPE = np.dtype({
     "itemsize": TELEMETRY_BLOCK_SIZE,
 })
 
-# numpy mirror of PingooRingHeader (cache-line-aligned counters).
+# numpy mirror of PingooRingHeader (cache-line-aligned counters; the
+# v5 liveness block — sidecar_epoch / sidecar_heartbeat_ms /
+# posted_floor — rides its own cache line after the telemetry block).
 RING_HEADER_DTYPE = np.dtype({
     "names": ["magic", "version", "capacity", "request_slot_size",
               "verdict_slot_size", "_pad", "req_head", "req_tail",
-              "ver_head", "ver_tail", "telemetry"],
+              "ver_head", "ver_tail", "telemetry", "sidecar_epoch",
+              "sidecar_heartbeat_ms", "posted_floor"],
     "formats": ["<u4", "<u4", "<u4", "<u4", "<u4", "<u4", "<u8", "<u8",
-                "<u8", "<u8", TELEMETRY_DTYPE],
-    "offsets": [0, 4, 8, 12, 16, 20, 64, 128, 192, 256, 320],
+                "<u8", "<u8", TELEMETRY_DTYPE, "<u8", "<u8", "<u8"],
+    "offsets": [0, 4, 8, 12, 16, 20, 64, 128, 192, 256, 320, 448, 456,
+                464],
     "itemsize": RING_HEADER_SIZE,
 })
 
@@ -189,6 +194,17 @@ def _load_lib():
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32]
     lib.pingoo_ring_now_ms.restype = ctypes.c_uint64
     lib.pingoo_ring_now_ms.argtypes = []
+    # Liveness / supervision protocol (v5, ISSUE 10).
+    lib.pingoo_ring_sidecar_attach.restype = ctypes.c_uint64
+    lib.pingoo_ring_sidecar_attach.argtypes = [ctypes.c_void_p]
+    lib.pingoo_ring_heartbeat.argtypes = [ctypes.c_void_p]
+    lib.pingoo_ring_liveness.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)]
+    lib.pingoo_ring_set_posted_floor.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64]
+    lib.pingoo_ring_reclaim_request.restype = ctypes.c_int
+    lib.pingoo_ring_reclaim_request.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_void_p]
     return lib
 
 
@@ -323,6 +339,48 @@ class Ring:
                 ctypes.byref(score)) != 0:
             return None
         return int(ticket.value), int(action.value), float(score.value)
+
+    # -- liveness / supervision protocol (ring v5, docs/RESILIENCE.md) -------
+
+    def sidecar_attach(self) -> int:
+        """Bump the sidecar epoch (one consumer generation = one epoch),
+        stamp the first heartbeat, and return the NEW epoch."""
+        return int(self.lib.pingoo_ring_sidecar_attach(self.addr))
+
+    def heartbeat(self) -> None:
+        """Stamp the liveness heartbeat (called every poll cycle)."""
+        if not self.map.closed:
+            self.lib.pingoo_ring_heartbeat(self.addr)
+
+    def liveness(self) -> dict:
+        """One-call liveness snapshot: epoch, heartbeat_ms (0 = no
+        sidecar has ever attached), posted_floor, req_tail, now_ms —
+        all on the ring's own CLOCK_MONOTONIC ms time base."""
+        buf = (ctypes.c_uint64 * 5)()
+        if not self.map.closed:
+            self.lib.pingoo_ring_liveness(self.addr, buf)
+        return {"epoch": int(buf[0]), "heartbeat_ms": int(buf[1]),
+                "posted_floor": int(buf[2]), "req_tail": int(buf[3]),
+                "now_ms": int(buf[4])}
+
+    def set_posted_floor(self, ticket: int) -> None:
+        """Advance the posted floor (monotonic max): every ticket below
+        it has a verdict posted, so a reattaching sidecar only scans
+        [posted_floor, req_tail) for orphans."""
+        self.lib.pingoo_ring_set_posted_floor(self.addr, ticket)
+
+    def reclaim(self, ticket: int) -> Optional[np.ndarray]:
+        """Reclaim one orphaned ticket during crash-reattach
+        reconciliation: a 1-element REQUEST_SLOT_DTYPE array when the
+        request bytes are still intact (re-evaluate them), or None when
+        the slot was reused (fail-open the ticket). Also unwedges a
+        slot whose consumer died between its tail-CAS and seq-release."""
+        out = np.zeros(1, dtype=REQUEST_SLOT_DTYPE)
+        if self.lib.pingoo_ring_reclaim_request(
+                self.addr, ticket,
+                out.ctypes.data_as(ctypes.c_void_p)) != 0:
+            return None
+        return out
 
 
 def slots_to_arrays(slots: np.ndarray) -> dict:
@@ -566,10 +624,18 @@ class RingSidecar:
         # via pingoo_mesh_devices == 1.
         from .sched import MeshExecutor
 
+        # Degradation ladder (ISSUE 10, docs/RESILIENCE.md): the
+        # scattered fallbacks below route through one explicit state
+        # machine — demotions are counted per rung and probed back
+        # with exponential backoff (engine/ladder.py).
+        from .engine.ladder import DegradationLadder
+
+        self.ladder = DegradationLadder("sidecar")
         try:
             self.mesh = MeshExecutor(plan, plane="sidecar",
                                      metrics=self.sched.metrics)
-        except (MeshUnavailable, ValueError):
+        except (MeshUnavailable, ValueError) as exc:
+            self.ladder.note_failure("mesh", exc)
             self.mesh = MeshExecutor(plan, spec=(1, 1, 1),
                                      plane="sidecar",
                                      metrics=self.sched.metrics)
@@ -692,6 +758,79 @@ class RingSidecar:
                                         recorder=self.flight_recorder)
         self._collector_live = True
         REGISTRY.register_collector(self._export_ring_telemetry)
+        # -- sidecar supervision (ISSUE 10, docs/RESILIENCE.md) ---------------
+        from .obs.chaos import ChaosInjector
+        from .obs.schema import RESILIENCE_METRICS
+
+        self.chaos = ChaosInjector.from_env()
+        self._dfa_probe = False
+        self._dfa_mode0 = getattr(plan, "dfa_default_mode", "auto")
+        # Liveness protocol (ring v5): bump each ring's epoch so the
+        # data plane can tell a restarted sidecar from a frozen one,
+        # then reconcile tickets the dead epoch dequeued but never
+        # answered — BEFORE the drain loop starts, so reconciliation
+        # verdicts can never race this epoch's own posts.
+        self._reattach_counters = {
+            action: REGISTRY.counter(
+                "pingoo_reattach_reconciled_total",
+                RESILIENCE_METRICS["pingoo_reattach_reconciled_total"],
+                labels={"plane": "sidecar", "action": action})
+            for action in ("reeval", "failopen")}
+        self.reconciled = {"reeval": 0, "failopen": 0}
+        self.epochs = [r.sidecar_attach() for r in self.rings]
+        self.epoch = max(self.epochs)
+        REGISTRY.gauge(
+            "pingoo_sidecar_epoch",
+            RESILIENCE_METRICS["pingoo_sidecar_epoch"],
+            labels={"plane": "sidecar"}).set(self.epoch)
+        # Busy-window heartbeat watchdog (docs/RESILIENCE.md): the
+        # drain loop legitimately blocks for seconds inside XLA
+        # compiles (first call per pow2 bucket), the device-result
+        # sync, interpreter fallbacks, and reattach reconciliation —
+        # without this, every such window flips the data plane
+        # degraded and fails live requests open. The watchdog stamps
+        # ONLY while the loop is inside one of those declared windows
+        # (`_hb_busy`), bounded by the grace cap: a SIGKILL silences
+        # it with the process, a loop wedged anywhere else stops
+        # stamping immediately, and a device call hung past the grace
+        # goes dark too (per-ticket verdict timeouts bound the harm
+        # meanwhile).
+        import threading as _threading
+
+        self._busy_since: Optional[float] = None
+        self._hb_watchdog = _threading.Thread(
+            target=self._heartbeat_watchdog, name="pingoo-hb-watchdog",
+            daemon=True)
+        self._hb_watchdog.start()
+        with self._hb_busy():
+            self._reconcile_orphans()
+
+    # A device call (compile/execute) blocked longer than this is
+    # treated as wedged: the watchdog stops covering for it and the
+    # data plane's liveness detector takes over. Far above any real
+    # XLA compile, far below "hung forever".
+    _HB_BUSY_GRACE_S = 120.0
+
+    @contextlib.contextmanager
+    def _hb_busy(self):
+        """Declare a known-blocking drain-loop window (XLA compile,
+        device sync, interpreter fallback, reattach reconciliation):
+        the heartbeat watchdog stamps only inside these."""
+        self._busy_since = time.monotonic()
+        try:
+            yield
+        finally:
+            self._busy_since = None
+
+    def _heartbeat_watchdog(self) -> None:
+        while not self._stop:
+            busy = self._busy_since
+            if busy is not None \
+                    and time.monotonic() - busy < self._HB_BUSY_GRACE_S \
+                    and not self.chaos.heartbeat_frozen():
+                for r in self.rings:
+                    r.heartbeat()
+            time.sleep(0.1)
 
     def run(self, max_requests: Optional[int] = None) -> int:
         """Blocking drain loop; returns requests processed.
@@ -731,6 +870,19 @@ class RingSidecar:
         # pool when `_complete` finishes it.
         pend_buf = self._take_slot_buf() if self._zero_copy else None
         while not self._stop:
+            # Liveness heartbeat (ring v5): one relaxed shm store per
+            # ring per poll cycle. Deliberately stamped from THIS loop
+            # (not a free-running helper thread): a wedged drain loop
+            # must look dead to the data plane's
+            # PINGOO_SIDECAR_TIMEOUT_MS detector. The one exception is
+            # declared known-blocking windows (XLA compile, device
+            # sync, interpreter fallback — `_hb_busy`), which the
+            # bounded watchdog covers so a cold compile under live
+            # traffic does not flip the plane degraded —
+            # docs/RESILIENCE.md.
+            if not self.chaos.heartbeat_frozen():
+                for r in self.rings:
+                    r.heartbeat()
             # One merged dequeue pass across all worker rings. The
             # start index rotates so a saturated ring cannot monopolize
             # the budget and starve its siblings into the data plane's
@@ -834,7 +986,9 @@ class RingSidecar:
         from .engine.batch import RequestBatch, bucket_arrays, pad_batch
 
         pipe_slot = self._pipe.enter(self.pipeline_mode)
+        self.chaos.stage("encode")
         t0 = time.monotonic()
+        batch = raw = None
         if slot_buf is not None:
             # Zero-copy plane (PINGOO_PIPELINE=on): the dequeue FFI
             # already landed every part contiguously in `slot_buf`, so
@@ -847,20 +1001,33 @@ class RingSidecar:
             # length, and every consumer (host_rule_lanes,
             # batch_to_contexts) reads data[:len].
             slots = slot_buf[:n]
-            batch = self._staging.encode_slots(slots,
-                                               pad_to=self.max_batch)
-            raw = RequestBatch(
-                size=n,
-                arrays={k: v[:n] for k, v in batch.arrays.items()})
+            if self.ladder.try_rung("pipeline"):
+                try:
+                    batch = self._staging.encode_slots(
+                        slots, pad_to=self.max_batch)
+                    raw = RequestBatch(
+                        size=n,
+                        arrays={k: v[:n]
+                                for k, v in batch.arrays.items()})
+                    self.ladder.note_success("pipeline")
+                except Exception as exc:
+                    # Ladder pipeline rung: a broken staging encoder
+                    # demotes THIS plane to the legacy encode chain
+                    # below (bit-identical, tests/test_pipeline.py)
+                    # until a backoff probe re-promotes it.
+                    self.ladder.note_failure("pipeline", exc)
+                    batch = raw = None
         else:
             slots = parts[0][1] if len(parts) == 1 else np.concatenate(
                 [s for _, s in parts])
-            # Pad the batch axis to one fixed shape (a partial batch
-            # would otherwise be a new XLA program — compile stall on
-            # the serving path) and bucket field lengths to powers of
-            # two so the NFA scan walks the batch's longest value,
-            # not the 2048-byte slot capacity (at most log2(cap)
-            # shapes per field).
+        if batch is None:
+            # Legacy encode chain (PINGOO_PIPELINE=off, or the ladder's
+            # pipeline rung demoted): pad the batch axis to one fixed
+            # shape (a partial batch would otherwise be a new XLA
+            # program — compile stall on the serving path) and bucket
+            # field lengths to powers of two so the NFA scan walks the
+            # batch's longest value, not the 2048-byte slot capacity
+            # (at most log2(cap) shapes per field).
             raw = RequestBatch(size=n, arrays=slots_to_arrays(slots))
             batch = pad_batch(
                 RequestBatch(size=n, arrays=bucket_arrays(raw.arrays)),
@@ -872,18 +1039,42 @@ class RingSidecar:
         if self.mesh.active:
             arrays = self.mesh.shard_batch(arrays)
         t1 = time.monotonic()
+        self.chaos.stage("dispatch")
         pf_hits = pf_aux = None
-        if self._pf_fn is not None:
-            pf_hits, pf_aux = self._pf_fn(self._tables, arrays)  # async
-        tpf = time.monotonic()
         rule_hits = None
-        if self._provenance_on:
-            # Attribution aux lane rides the SAME dispatch; the
-            # traced n masks batch-padding rows on device.
-            dev, rule_hits = self._lane_fn(
-                self._tables, arrays, pf_hits, np.int32(n))  # async
-        else:
-            dev = self._lane_fn(self._tables, arrays, pf_hits)  # async
+        dev = None
+        tpf = t1
+        self._dfa_rung_tick()
+        # Ladder device rung: while demoted, skip the dispatch entirely
+        # (the interpreter serves in `_complete`) except for backoff
+        # probes; a dispatch-time exception demotes — it no longer
+        # kills the drain thread.
+        if self.ladder.try_rung("device"):
+            try:
+                self.chaos.maybe_xla_error(self.batches)
+                # Busy window: the jitted calls return async once
+                # compiled, but the FIRST call per pow2 bucket blocks
+                # in XLA for seconds — the watchdog heartbeats through
+                # it so the data plane doesn't flip degraded.
+                with self._hb_busy():
+                    if self._pf_fn is not None:
+                        pf_hits, pf_aux = self._pf_fn(
+                            self._tables, arrays)  # async
+                    tpf = time.monotonic()
+                    if self._provenance_on:
+                        # Attribution aux lane rides the SAME dispatch;
+                        # the traced n masks batch-padding rows on
+                        # device.
+                        dev, rule_hits = self._lane_fn(
+                            self._tables, arrays, pf_hits,
+                            np.int32(n))  # async
+                    else:
+                        dev = self._lane_fn(self._tables, arrays,
+                                            pf_hits)  # async
+            except Exception as exc:
+                self._note_device_failure(exc)
+                pf_hits = pf_aux = rule_hits = dev = None
+                tpf = time.monotonic()
         t2 = time.monotonic()
         self._stage["encode"].observe((t1 - t0) * 1e3)
         self._stage["prefilter"].observe((tpf - t1) * 1e3)
@@ -990,7 +1181,18 @@ class RingSidecar:
         host = host_rule_lanes(self.plan, raw_batch, self.lists)
         tc0 = time.monotonic()
         t0 = time.time()
-        dev_lanes = np.asarray(dev)[:, :n]  # drop batch-padding rows
+        dev_lanes = None
+        if dev is not None:
+            try:
+                with self._hb_busy():  # device sync can block for ms-s
+                    dev_lanes = np.asarray(dev)[:, :n]  # drop padding
+                self._note_device_success()
+            except Exception as exc:
+                # jax dispatch is async — a device/runtime error only
+                # surfaces at this sync. Demote (ladder device rung)
+                # and serve the batch from the interpreter below
+                # instead of killing the drain thread.
+                self._note_device_failure(exc)
         wait_s = time.time() - t0
         tc1 = time.monotonic()
         self.device_wait_s += wait_s
@@ -1034,8 +1236,18 @@ class RingSidecar:
             if dfa_rechecks:
                 self._dfa_recheck_counter.inc(dfa_rechecks)
         t_resolve = time.monotonic()
+        self.chaos.stage("resolve")
         self.batches += 1
-        unverified, verified_block = merge_lanes(dev_lanes, host)
+        route = None
+        if dev_lanes is None:
+            # Ladder device-rung fallback: the host interpreter — the
+            # parity oracle every fast path is tested against — serves
+            # the whole batch, bit-identically, at host speed.
+            with self._hb_busy():  # host interpret blocks the loop
+                unverified, verified_block, route = self._interpret_batch(
+                    parts, raw_batch)
+        else:
+            unverified, verified_block = merge_lanes(dev_lanes, host)
         # Rows the producer flagged as truncated (a field exceeded its
         # 2048-byte slot cap) were matched on the slot view — the widest
         # bytes this plane carries. Count them so the residual truncation
@@ -1049,8 +1261,7 @@ class RingSidecar:
         # order at rows 3..3+G; the reference binds a service list per
         # listener, config.rs:241-253). Rows from rings with no service
         # group keep route 0 — their consumer never reads bits 3-7.
-        route = None
-        if self._groups:
+        if self._groups and dev_lanes is not None:
             route = np.zeros(n, dtype=np.int64)
             group_rows: list[list] = [[] for _ in self._groups]
             off = 0
@@ -1142,6 +1353,9 @@ class RingSidecar:
             k = len(tickets)
             done = 0
             while done < k:  # one FFI hop per batch, resume on a full ring
+                if self.chaos.verdict_full():  # injected full-ring stall
+                    time.sleep(self.idle_sleep_s)
+                    continue
                 done += ring.post_verdicts(tickets[done:], pacts[done:])
                 if done < k:
                     if self._stop:  # a dead consumer must not wedge stop()
@@ -1152,6 +1366,13 @@ class RingSidecar:
             # Telemetry: enqueue -> verdict-post wall time for this
             # ring's rows lands in the shm wait histogram (one FFI hop).
             ring.record_waits(waits)
+            # Posted-floor advance (ring v5, docs/RESILIENCE.md): every
+            # ticket of this part now has a verdict (skip-mask rows
+            # were posted at launch), and parts complete in FIFO order,
+            # so posted tickets form a prefix — a reattaching sidecar's
+            # orphan scan starts above this mark.
+            if m:
+                ring.set_posted_floor(int(part["ticket"].max()) + 1)
             off += m
         # Deadline accounting on the ring clock: rows posted after
         # their PINGOO_DEADLINE_MS budget count as misses (one
@@ -1166,7 +1387,10 @@ class RingSidecar:
             self._pipe.note_stage(pipe_slot, "resolve", t_resolve,
                                   t_res_end)
         t_prov = time.monotonic()
-        if self._attribution is not None:
+        if self._attribution is not None and dev_lanes is not None:
+            # Interpreter-served batches (device rung demoted) skip
+            # attribution/parity: the aux lane never ran, and auditing
+            # the oracle against itself proves nothing.
             self._observe_provenance(slots, rule_hits, dev_lanes, host,
                                      raw_batch, unverified,
                                      verified_block, wait_s, n,
@@ -1180,6 +1404,7 @@ class RingSidecar:
             self._slot_pool.append(slot_buf)
         if pipe_slot is not None:
             self._pipe.exit()
+        self.chaos.on_batch_done(self.batches)
 
     def _observe_provenance(self, slots, rule_hits, dev_lanes, host,
                             raw_batch, unverified, verified_block,
@@ -1265,6 +1490,179 @@ class RingSidecar:
                 contexts_builder, unverified[:n].copy(),
                 verified_block[:n].copy(), skip_mask=skip,
                 trace_ids=trace_ids)
+
+    # -- degradation ladder (ISSUE 10, docs/RESILIENCE.md) --------------------
+
+    def _rebuild_lane_fn(self, dfa_off: bool) -> None:
+        """Re-trace the lane fn with the lowered DFAs in or out. The
+        plan-level default is what `_resolve_dfa_mode` falls back to
+        when PINGOO_DFA is unset, so the demotion is per-plan, not
+        process-global. The next dispatch pays one re-jit (a bounded
+        stall during an already-degraded event)."""
+        from .engine.verdict import donate_batch_buffers, make_lane_fn
+
+        self.plan.dfa_default_mode = "off" if dfa_off else self._dfa_mode0
+        self._lane_fn = make_lane_fn(
+            self.plan, service_groups=self._groups or None,
+            with_rule_hits=self._provenance_on,
+            donate=donate_batch_buffers())
+
+    def _dfa_rung_tick(self) -> None:
+        """Demoted-dfa probe: when the backoff window opens, restore
+        the lowered-DFA dispatch for one batch; `_note_device_success`
+        / `_note_device_failure` then promote or re-demote."""
+        if not self.ladder.healthy("dfa") and not self._dfa_probe \
+                and self.ladder.try_rung("dfa"):
+            self._rebuild_lane_fn(dfa_off=False)
+            self._dfa_probe = True
+
+    def _note_device_failure(self, exc: BaseException) -> None:
+        """Cheapest-rung-first demotion: a device error with lowered
+        DFAs active drops them back to the exact NFA scan before
+        giving up on the device entirely; only a failure with the DFAs
+        already out (or pinned by PINGOO_DFA) demotes the device rung
+        to the host interpreter."""
+        from .engine.verdict import dfa_dispatch_counts
+
+        if self._dfa_probe:
+            self.ladder.note_failure("dfa", exc)
+            self._rebuild_lane_fn(dfa_off=True)
+            self._dfa_probe = False
+        elif self.ladder.healthy("dfa") \
+                and not os.environ.get("PINGOO_DFA") \
+                and dfa_dispatch_counts(self.plan)[1] > 0:
+            self.ladder.note_failure("dfa", exc)
+            self._rebuild_lane_fn(dfa_off=True)
+        else:
+            self.ladder.note_failure("device", exc)
+
+    def _note_device_success(self) -> None:
+        if self._dfa_probe:
+            self.ladder.note_success("dfa")
+            self._dfa_probe = False
+        self.ladder.note_success("device")
+
+    def _interpret_batch(self, parts, raw_batch):
+        """Device-rung fallback: serve the whole batch through the
+        host interpreter — the parity oracle every fast path is tested
+        against, so the verdict bytes are identical, just slower.
+        Returns (unverified, verified_block, route-or-None), the same
+        lanes `_complete` composes from the device path."""
+        from .engine.batch import batch_to_contexts
+        from .engine.verdict import LANE_NONE, action_lanes, \
+            interpret_rules_row
+
+        contexts = batch_to_contexts(raw_batch, self.lists)
+        if contexts:
+            rows = np.stack([interpret_rules_row(self.plan, c)
+                             for c in contexts])
+        else:
+            rows = np.zeros((0, len(self.plan.rules)), dtype=bool)
+        unv, vblk = action_lanes(self.plan, rows)
+        route = None
+        if self._groups:
+            route = np.full(len(contexts), int(LANE_NONE),
+                            dtype=np.int64)
+            off = 0
+            for ring, part in parts:
+                gi = self._ring_group_of.get(id(ring))
+                if gi is not None:
+                    svcs = self._groups[gi]
+                    for i in range(off, off + len(part)):
+                        for order, name in enumerate(svcs):
+                            ridx = self.plan.route_index.get(name)
+                            if ridx is None or rows[i, ridx]:
+                                route[i] = order
+                                break
+                off += len(part)
+        return (np.asarray(unv, dtype=np.int32),
+                np.asarray(vblk, dtype=bool), route)
+
+    # -- crash-reattach reconciliation (ISSUE 10, docs/RESILIENCE.md) ---------
+
+    def _reconcile_orphans(self) -> None:
+        """Resolve tickets the PREVIOUS sidecar epoch dequeued but
+        never answered. posted_floor only advances once a part's
+        verdicts are all posted, and parts complete in FIFO order, so
+        every ticket below the floor has a verdict and the orphan
+        window is exactly [posted_floor, req_tail). Slots whose bytes
+        survived the crash (wedged mid-dequeue, or consumed but not
+        yet overwritten — the C reclaim's seqlock proves which) are
+        RE-EVALUATED through the host interpreter; recycled slots fail
+        open (allow), the same posture as every other unanswerable
+        path. Each orphan resolves exactly once: this scan runs before
+        the drain loop starts (no race with this epoch's posts), and a
+        duplicate post for a ticket the data plane already timed out
+        is dropped by its unknown-ticket check."""
+        for ring in self.rings:
+            lv = ring.liveness()
+            floor, tail = lv["posted_floor"], lv["req_tail"]
+            if tail <= floor:
+                continue
+            # A pre-v5 (or never-completing) epoch leaves the floor at
+            # 0; slots more than one capacity old are certainly
+            # recycled, so bound the scan — everything below `start`
+            # long ago hit the data plane's own verdict timeout.
+            start = max(floor, tail - ring.capacity)
+            for ticket in range(start, tail):
+                slot = ring.reclaim(ticket)
+                action = 0
+                kind = "failopen"
+                if slot is not None:
+                    try:
+                        action = self._reeval_reclaimed(ring, slot)
+                        kind = "reeval"
+                    except Exception:
+                        action = 0  # interpreter error: fail open
+                self._post_one(ring, ticket, action)
+                self.reconciled[kind] += 1
+                self._reattach_counters[kind].inc()
+                if self.flight_recorder is not None:
+                    self.flight_recorder.record(
+                        trace_id=f"t-{ticket}",
+                        digest="reattach",
+                        stages={"reattach": kind, "epoch": self.epoch},
+                        matched_rules=(),
+                        action=action & 3,
+                        ticket=ticket)
+            ring.set_posted_floor(tail)
+
+    def _reeval_reclaimed(self, ring: Ring, slots1: np.ndarray) -> int:
+        """Verdict byte for one reclaimed orphan slot via the host
+        interpreter — the same lane composition `_complete` posts:
+        bits 0-1 unverified, bit 2 verified-block, bits 3-7 route
+        (when the slot's ring has a service group)."""
+        if self.geoip is not None:
+            self._enrich_slots(slots1)
+        s = slots1[0]
+        url = bytes(s["url"][:int(s["url_len"])])
+        path = bytes(s["path"][:int(s["path_len"])])
+        idx = int(s["spill_idx"])
+        if idx != SPILL_NONE:
+            full = ring.spill_read(idx)
+            if full is not None:
+                url, path = full
+            ring.spill_release(idx)
+        gi = self._ring_group_of.get(id(ring))
+        svcs = self._groups[gi] if gi is not None else None
+        unv, vblk, rt = self._interpret_overflow_row(s, url, path, svcs)
+        action = unv | (int(vblk) << 2)
+        if svcs is not None:
+            action |= min(rt, 31) << 3
+        return action
+
+    def _post_one(self, ring: Ring, ticket: int, action: int) -> None:
+        tickets = np.asarray([ticket], dtype=np.uint64)
+        acts = np.asarray([action & 0xFF], dtype=np.uint8)
+        # Bounded retry: a full verdict ring with a LIVE consumer
+        # drains in microseconds; a dead consumer must not wedge
+        # reattach forever (its tickets are long failed open anyway).
+        for _ in range(10000):
+            if ring.post_verdicts(tickets, acts):
+                return
+            if self._stop:
+                return
+            time.sleep(self.idle_sleep_s)
 
     def _interpret_overflow_row(self, slot, url: bytes, path: bytes,
                                 services=None) -> tuple[int, bool, int]:
@@ -1375,6 +1773,9 @@ class RingSidecar:
             "sched": self.sched.snapshot(),
             "mesh": self.mesh.describe(),
             "pipeline": self._pipe.snapshot(),
+            "ladder": self.ladder.snapshot(),
+            "supervision": {"epoch": self.epoch,
+                            "reconciled": dict(self.reconciled)},
         }
 
     def stop(self, join_timeout_s: float = 10.0) -> None:
@@ -1398,3 +1799,9 @@ class RingSidecar:
         t = self._thread
         if t is not None and t.is_alive()                 and t is not _threading.current_thread():
             t.join(timeout=join_timeout_s)
+        # Join the heartbeat watchdog too (exits within one 0.1 s tick
+        # of _stop): a stamp against an unmapped ring would be the same
+        # use-after-munmap the drain-loop join exists to prevent.
+        w = getattr(self, "_hb_watchdog", None)
+        if w is not None and w.is_alive()                 and w is not _threading.current_thread():
+            w.join(timeout=join_timeout_s)

@@ -1,0 +1,158 @@
+"""PINGOO_CHAOS fault injector (ISSUE 10, docs/RESILIENCE.md).
+
+Deterministic fault injection for the supervision machinery: the
+chaos harness (tools/chaos_smoke.py, tests/test_resilience.py) needs
+to kill, pause and corrupt the sidecar at EXACT points in the batch
+lifecycle to prove the liveness protocol's bounds, and doing that
+from outside the process races the very windows under test. The
+injector is dormant unless PINGOO_CHAOS is set — the parse itself is
+the only cost on the serving path (one attribute check per hook).
+
+Spec grammar — comma-separated faults, each ``name[:arg[:arg]]``::
+
+  kill[:N]          SIGKILL this process after N completed batches
+                    (default 1) — the crash-reattach scenario.
+  pause:MS[:N]      sleep MS ms in the drain loop after N completed
+                    batches (default 1), once — freezes the heartbeat
+                    AND the in-flight batches, the "hung sidecar"
+                    scenario (detection, not crash).
+  heartbeat_freeze  never stamp the ring heartbeat — isolates the
+                    liveness detector from real drain-loop health.
+  stall:STAGE:MS    sleep MS ms inside pipeline stage STAGE
+                    (encode|dispatch|resolve), every batch — bounded
+                    per-stage latency injection.
+  xla_error[:N]     raise ChaosXlaError from device dispatch on the
+                    Nth batch (default 1), once — drives the
+                    degradation ladder's device rung.
+  verdict_full:N    report the verdict ring full for the next N post
+                    attempts — exercises the post-retry loop.
+
+Every injected fault increments
+``pingoo_chaos_injected_total{fault=}`` so a chaos run's metrics
+surface shows exactly what was injected where.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Optional
+
+
+class ChaosXlaError(RuntimeError):
+    """Injected stand-in for jaxlib's XlaRuntimeError (the real class
+    only exists when jax is importable; ladder handlers catch broad
+    Exception either way)."""
+
+
+class ChaosInjector:
+    """Parsed PINGOO_CHAOS faults + the hook points the sidecar calls.
+
+    All hooks are cheap no-ops when the spec is empty (`self.active`
+    is False and every hook checks it first).
+    """
+
+    def __init__(self, spec: str = ""):
+        self.spec = (spec or "").strip()
+        self.active = bool(self.spec)
+        self.kill_after: Optional[int] = None
+        self.pause_ms = 0
+        self.pause_after: Optional[int] = None
+        self.freeze_heartbeat = False
+        self.stalls: dict[str, float] = {}   # stage -> ms
+        self.xla_error_at: Optional[int] = None
+        self.verdict_full_budget = 0
+        self._fired: set[str] = set()
+        self._counters: dict[str, object] = {}
+        if not self.active:
+            return
+        for part in self.spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, rest = part.partition(":")
+            args = rest.split(":") if rest else []
+            try:
+                if name == "kill":
+                    self.kill_after = int(args[0]) if args else 1
+                elif name == "pause":
+                    self.pause_ms = int(args[0])
+                    self.pause_after = int(args[1]) if len(args) > 1 else 1
+                elif name == "heartbeat_freeze":
+                    self.freeze_heartbeat = True
+                elif name == "stall":
+                    self.stalls[args[0]] = float(args[1])
+                elif name == "xla_error":
+                    self.xla_error_at = int(args[0]) if args else 1
+                elif name == "verdict_full":
+                    self.verdict_full_budget = int(args[0])
+                else:
+                    raise ValueError(name)
+            except (IndexError, ValueError):
+                raise ValueError(
+                    f"PINGOO_CHAOS: malformed fault {part!r}") from None
+
+    @classmethod
+    def from_env(cls) -> "ChaosInjector":
+        return cls(os.environ.get("PINGOO_CHAOS", ""))
+
+    def _count(self, fault: str) -> None:
+        ctr = self._counters.get(fault)
+        if ctr is None:
+            from . import REGISTRY
+            from .schema import RESILIENCE_METRICS
+
+            ctr = REGISTRY.counter(
+                "pingoo_chaos_injected_total",
+                RESILIENCE_METRICS["pingoo_chaos_injected_total"],
+                labels={"plane": "sidecar", "fault": fault})
+            self._counters[fault] = ctr
+        ctr.inc()
+
+    # -- hook points (called by RingSidecar) ----------------------------------
+
+    def heartbeat_frozen(self) -> bool:
+        return self.active and self.freeze_heartbeat
+
+    def on_batch_done(self, batches: int) -> None:
+        """After a batch fully resolves: the kill / pause triggers.
+        SIGKILL (not sys.exit) on purpose — the reattach protocol must
+        survive a consumer that never ran ANY cleanup."""
+        if not self.active:
+            return
+        if self.pause_after is not None and batches >= self.pause_after \
+                and "pause" not in self._fired:
+            self._fired.add("pause")
+            self._count("pause")
+            time.sleep(self.pause_ms / 1e3)
+        if self.kill_after is not None and batches >= self.kill_after:
+            self._count("kill")
+            os.kill(os.getpid(), signal.SIGKILL)
+
+    def maybe_xla_error(self, batches: int) -> None:
+        """Inside device dispatch: one injected device failure."""
+        if not self.active or self.xla_error_at is None:
+            return
+        if batches + 1 >= self.xla_error_at and "xla" not in self._fired:
+            self._fired.add("xla")
+            self._count("xla_error")
+            raise ChaosXlaError("PINGOO_CHAOS: injected XlaRuntimeError")
+
+    def stage(self, stage: str) -> None:
+        """Inside a pipeline stage: bounded injected stall."""
+        if not self.active:
+            return
+        ms = self.stalls.get(stage)
+        if ms:
+            self._count(f"stall_{stage}")
+            time.sleep(ms / 1e3)
+
+    def verdict_full(self) -> bool:
+        """Before a verdict post attempt: True = pretend the ring is
+        full (the caller's retry loop backs off and re-tries)."""
+        if not self.active or self.verdict_full_budget <= 0:
+            return False
+        self.verdict_full_budget -= 1
+        self._count("verdict_full")
+        return True

@@ -181,6 +181,39 @@ RING_METRICS = {
     "pingoo_ring_depth_hwm": "high-water mark of queued request slots",
 }
 
+# Sidecar supervision + degradation-ladder metrics (ISSUE 10,
+# docs/RESILIENCE.md). The liveness trio (sidecar_up / degraded_mode /
+# sidecar_epoch) is exported by BOTH planes from the same ring-header
+# liveness block (v5): the native httpd reads it to decide the
+# degraded fast-path, the sidecar writes it. pingoo_degrade_total is
+# the ladder's per-rung demotion counter (engine/ladder.py), exported
+# wherever a ladder runs (plane="python" and plane="sidecar");
+# reattach/chaos counters are sidecar-plane.
+RESILIENCE_METRICS = {
+    "pingoo_sidecar_up":
+        "1 while a sidecar heartbeat is fresh (0 before any sidecar "
+        "ever attached AND while degraded — both alert the same way)",
+    "pingoo_degraded_mode":
+        "1 while the native plane bypasses the ring (stale heartbeat "
+        "past PINGOO_SIDECAR_TIMEOUT_MS): every request fails open",
+    "pingoo_sidecar_epoch":
+        "monotonic sidecar attach count from the ring header (a bump "
+        "= a sidecar restart; reconciliation ran)",
+    "pingoo_degraded_entered_total":
+        "degraded-mode entries (each one failed every awaiting ticket "
+        "open at once)",
+    "pingoo_reattach_reconciled_total":
+        "tickets a restarting sidecar reconciled from the dead epoch, "
+        "by action (reeval = slot bytes intact, re-evaluated; "
+        "failopen = bytes recycled, allow posted)",
+    "pingoo_degrade_total":
+        "degradation-ladder demotions by rung (pipeline|dfa|mesh|"
+        "device; engine/ladder.py)",
+    "pingoo_chaos_injected_total":
+        "faults injected by the PINGOO_CHAOS harness, by fault "
+        "(obs/chaos.py; absent in production)",
+}
+
 # Native-plane-only counters (httpd.cc Stats), exported with
 # plane="native" under these names.
 NATIVE_METRICS = {
@@ -215,5 +248,5 @@ def all_metric_names() -> set[str]:
             | set(PREFILTER_METRICS) | set(DFA_METRICS)
             | set(PROVENANCE_METRICS)
             | set(PARITY_METRICS) | set(SCHED_METRICS)
-            | set(PIPELINE_METRICS)
+            | set(PIPELINE_METRICS) | set(RESILIENCE_METRICS)
             | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

@@ -133,6 +133,9 @@ int main() {
   FIELD(PingooRingHeader, ver_head);
   FIELD(PingooRingHeader, ver_tail);
   FIELD(PingooRingHeader, telemetry);
+  FIELD(PingooRingHeader, sidecar_epoch);
+  FIELD(PingooRingHeader, sidecar_heartbeat_ms);
+  FIELD(PingooRingHeader, posted_floor);
   STRUCT_CLOSE();
 
   STRUCT_OPEN(PingooSpillSlot);

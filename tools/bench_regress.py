@@ -55,6 +55,10 @@ TRACKED = (
     # Zero-copy pipelined executor A/B (ISSUE 9, bench.py --pipeline).
     ("pipeline_on_req_per_s", True),
     ("pipeline_on_p99_ms", False),
+    # Sidecar supervision chaos smoke (ISSUE 10, tools/chaos_smoke.py):
+    # p99 enqueue->resolution during a sidecar outage must stay within
+    # the degraded fail-open bound.
+    ("degraded_failopen_p99_ms", False),
 )
 
 DEFAULT_THRESHOLD = 0.10

@@ -142,6 +142,42 @@ def main() -> int:
             problems.append(
                 f"{label}: pipeline wiring missing PipelineStats")
 
+    # Sidecar supervision (ISSUE 10, docs/RESILIENCE.md): the liveness
+    # gauges/counter are C++ string literals in the native exposition;
+    # the reattach/epoch names live in the sidecar, the ladder counter
+    # in engine/ladder.py, the chaos counter in obs/chaos.py. Both
+    # engine planes must wire a DegradationLadder — that is what makes
+    # the pingoo_degrade_total series exist under both plane labels —
+    # and the native plane must carry the liveness detector itself.
+    for name in ("pingoo_sidecar_up", "pingoo_degraded_mode",
+                 "pingoo_sidecar_epoch", "pingoo_degraded_entered_total"):
+        if name not in native_src:
+            problems.append(f"native/httpd.cc: missing metric {name}")
+    if "check_sidecar_liveness" not in native_src:
+        problems.append(
+            "native/httpd.cc: liveness detector check_sidecar_liveness "
+            "missing")
+    for name in ("pingoo_reattach_reconciled_total",
+                 "pingoo_sidecar_epoch"):
+        if name not in sidecar_src:
+            problems.append(f"native_ring.py: missing metric {name}")
+    ladder_src = _read("pingoo_tpu/engine/ladder.py")
+    if "pingoo_degrade_total" not in ladder_src:
+        problems.append(
+            "engine/ladder.py: missing metric pingoo_degrade_total")
+    chaos_src = _read("pingoo_tpu/obs/chaos.py")
+    if "pingoo_chaos_injected_total" not in chaos_src:
+        problems.append(
+            "obs/chaos.py: missing metric pingoo_chaos_injected_total")
+    for plane_src, label in ((service_src, "engine/service.py"),
+                             (sidecar_src, "native_ring.py")):
+        if "DegradationLadder" not in plane_src:
+            problems.append(
+                f"{label}: ladder wiring missing DegradationLadder")
+    if "ChaosInjector" not in sidecar_src:
+        problems.append(
+            "native_ring.py: chaos wiring missing ChaosInjector")
+
     # Flight-recorder + explain endpoints: the Python listener serves
     # both; the native plane serves its own flightrecorder dump (the
     # C++ exposition is string literals, so the source is the schema).
@@ -170,7 +206,8 @@ def main() -> int:
                             **schema.PROVENANCE_METRICS,
                             **schema.PARITY_METRICS,
                             **schema.SCHED_METRICS,
-                            **schema.PIPELINE_METRICS}.items():
+                            **schema.PIPELINE_METRICS,
+                            **schema.RESILIENCE_METRICS}.items():
         if name == "pingoo_sched_batch_size":
             # The one histogram in the sched family: lint it with its
             # real pow2 bucket ladder.
@@ -198,6 +235,12 @@ def main() -> int:
         "plane": "audit", "stage": "encode"}).set(0.5)
     reg.counter("pingoo_pipeline_batches_total", "", labels={
         "plane": "audit", "mode": "on"}).inc()
+    reg.counter("pingoo_reattach_reconciled_total", "", labels={
+        "plane": "audit", "action": "reeval"}).inc()
+    reg.counter("pingoo_degrade_total", "", labels={
+        "plane": "audit", "rung": "device"}).inc()
+    reg.counter("pingoo_chaos_injected_total", "", labels={
+        "plane": "audit", "fault": "verdict_full"}).inc()
     h = reg.histogram(schema.SHARED_WAIT_HISTOGRAM, "wait",
                       buckets=WAIT_BUCKETS_MS, labels={"plane": "audit"})
     for v in (0.5, 3, 70, 2000):
