@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: all native test check bench bench-regress audit asan \
 	metrics-smoke mesh-smoke chaos-smoke clean \
-	analyze analyze-abi analyze-lint analyze-tidy analyze-tsan
+	analyze analyze-abi analyze-lint analyze-tidy analyze-tsan fuzz
 
 all: native
 
@@ -30,7 +30,9 @@ check:
 #                 hazards, hot-function allocation)
 #   analyze-tidy  clang-tidy bugprone/concurrency vs tracked baseline
 #   analyze-tsan  extended ring_stress under -fsanitize=thread
-analyze: analyze-abi analyze-lint analyze-tidy analyze-tsan
+#   fuzz          differential HTTP-parsing fuzzer across all three
+#                 parse paths (docs/FUZZING.md)
+analyze: analyze-abi analyze-lint analyze-tidy analyze-tsan fuzz
 	$(PY) tools/check_metrics_schema.py
 
 analyze-abi:
@@ -44,6 +46,14 @@ analyze-tidy:
 
 analyze-tsan:
 	$(PY) -m tools.analyze tsan
+
+# Differential parsing fuzzer (ISSUE 11, docs/FUZZING.md): 5k seeded
+# framing/encoding mutants through the native listener, the python
+# listener's parse oracle, and interpreter field extraction; any
+# non-documented divergence of RequestTuple fields or verdict bits
+# fails. Deterministic, offline-safe (no native toolchain -> 2-path).
+fuzz: native
+	env JAX_PLATFORMS=cpu $(PY) -m tools.analyze fuzz
 
 bench: native
 	$(PY) bench.py

@@ -33,11 +33,18 @@ FORMAT_VERSION = 10  # bump when plan/table layout changes
 
 
 def ruleset_fingerprint(rules: list[RuleConfig], lists: dict,
-                        field_specs=None, routes=None) -> str:
+                        field_specs=None, routes=None,
+                        tenant: str = "") -> str:
     from .lowering import DEFAULT_FIELD_SPECS
 
     h = hashlib.sha256()
     h.update(str(FORMAT_VERSION).encode())
+    if tenant:
+        # Multi-tenant hot-swap (ISSUE 11): identical rulesets under
+        # different tenants stay distinct artifacts, so one tenant's
+        # tuned plan (update_cached_plan) never leaks into another's.
+        # Empty tenant hashes nothing — pre-tenant artifacts stay valid.
+        h.update(b"\x04tenant:" + tenant.encode() + b"\x05")
     # Plan-shaping env knobs (halo partition on/off + footprint budget)
     # change the np_tables layout, so they are part of the identity.
     h.update(split_config_token().encode())
@@ -68,11 +75,13 @@ def compile_ruleset_cached(
     cache_dir: Optional[str] = None,
     field_specs=None,
     routes=None,
+    tenant: str = "",
 ) -> RulesetPlan:
     """compile_ruleset with a transparent on-disk artifact cache."""
     if cache_dir is None:
         return compile_ruleset(rules, lists, field_specs, routes=routes)
-    fingerprint = ruleset_fingerprint(rules, lists, field_specs, routes=routes)
+    fingerprint = ruleset_fingerprint(rules, lists, field_specs,
+                                      routes=routes, tenant=tenant)
     path = os.path.join(cache_dir, f"ruleset-{fingerprint[:32]}.plan")
     plan = _load(path, fingerprint)
     if plan is not None:
@@ -89,13 +98,14 @@ def update_cached_plan(
     cache_dir: str,
     field_specs=None,
     routes=None,
+    tenant: str = "",
 ) -> str:
     """Re-persist a (mutated) plan under its ruleset fingerprint — the
     path bench.py's micro-autotune uses to record measured scan-strategy
     selections (plan.scan_plans) into the artifact cache so the next
     boot starts from the tuned choice. Returns the artifact path."""
     fingerprint = ruleset_fingerprint(rules, lists, field_specs,
-                                      routes=routes)
+                                      routes=routes, tenant=tenant)
     path = os.path.join(cache_dir, f"ruleset-{fingerprint[:32]}.plan")
     _save(path, fingerprint, plan)
     return path

@@ -60,6 +60,23 @@ from .verdict import (action_lanes, finish_batch, make_prefilter_fn,
 PIPELINE_STAGE_BUDGET = {"encode": 0.45, "dispatch": 0.75}
 
 
+class _PlanSwap:
+    """Admission-queue sentinel carrying a prepared ruleset hot-swap
+    (ISSUE 11, docs/RESILIENCE.md). It travels the SAME queue as
+    requests, so its queue position IS the epoch boundary: requests
+    admitted ahead of it resolve on the old plan, requests behind it on
+    the new one — no request is dropped or resolved twice."""
+
+    __slots__ = ("plan", "lists", "tenant", "state", "fut")
+
+    def __init__(self, plan, lists, tenant, state, fut):
+        self.plan = plan
+        self.lists = lists
+        self.tenant = tenant
+        self.state = state
+        self.fut = fut
+
+
 class _StageBudgetExceeded(RuntimeError):
     """A pipeline stage blew its slice of the deadline budget; the
     batch reroutes through the fail-open machinery instead of holding
@@ -156,6 +173,11 @@ class Verdict:
     # read non-action columns (service routing) must fall back to
     # interpretation instead of trusting it.
     degraded: bool = False
+    # Ruleset hot-swap (ISSUE 11): which plan epoch evaluated this
+    # request. Batches flip plans only at launch boundaries, so every
+    # verdict in a batch carries the same epoch — the per-epoch
+    # bit-exactness contract tests/test_hotswap.py asserts.
+    epoch: int = 0
 
     @property
     def block(self) -> bool:
@@ -374,48 +396,91 @@ class VerdictService:
                                                 plane="python")
             self.parity = ParityAuditor(plan, lists, plane="python",
                                         recorder=self.flight_recorder)
+        # Ruleset hot-swap (ISSUE 11, docs/RESILIENCE.md): the plan
+        # epoch this plane is serving (0 = boot plan); swap_plan()
+        # prepares a new engine state off the serving path and the
+        # collector flips to it at a batch boundary.
+        self.ruleset_epoch = 0
+        self.tenant = "default"
+        self._device_hint = device
+        from .hotswap import set_epoch_gauge
+
+        set_epoch_gauge("python", 0)
         if use_device and ensure_jax_backend():
-            # Fail-open boot (SURVEY.md §5 failure detection): a broken
-            # accelerator backend degrades to the XLA CPU engine, and a
-            # broken XLA entirely to the interpreter — never crash the
-            # data plane.
-            try:
-                import jax
-
-                # Donated request buffers (ISSUE 9): XLA recycles each
-                # pipelined batch's upload in place — requested only on
-                # real accelerator backends (no-op + warning on cpu).
-                from .verdict import donate_batch_buffers
-
-                self._verdict_fn = make_verdict_fn(
-                    plan, donate=donate_batch_buffers())
-                # Stage-A prefilter as its own dispatch so the pipeline
-                # stage is separately timeable (None when the plan has
-                # no factors or PINGOO_PREFILTER=off).
-                pf = make_prefilter_fn(plan)
-                if pf is not None:
-                    self._pf_fn = pf.fn
-                    self._pf_gated_banks = len(pf.gated)
-                    if provenance_enabled():
-                        self._pf_attr = PrefilterAttribution(
-                            pf.masked, plane="python")
-                # Mesh BEFORE table materialization: tp padding must
-                # land in plan.np_tables before device_tables() runs.
-                self.mesh = self._build_mesh(plan)
-                tables = plan.device_tables()
-                if self.mesh.active:
-                    tables = self.mesh.place_tables(tables)
-                elif device is not None:
-                    tables = jax.device_put(tables, device)
-                self._tables = tables
-            except Exception as exc:
-                # Boot-time demotion is permanent for this service (no
-                # tables to probe against), but still counted/logged
-                # through the ladder's device rung.
-                self.ladder.note_failure("device", exc)
+            state = self._build_engine_state(plan, device)
+            if state is None:
                 self.use_device = False
+            else:
+                self._adopt_engine_state(state)
         else:
             self.use_device = False
+
+    def _build_engine_state(self, plan: RulesetPlan,
+                            device: Optional[object] = None
+                            ) -> Optional[dict]:
+        """Compile the plan-derived engine bundle (jitted fns, placed
+        tables, mesh, staging buffers) WITHOUT touching the serving
+        references. Backs both boot and swap_plan — for a swap it runs
+        off the serving path, so admissions never wait on a compile.
+        Returns None after a boot/build failure (fail-open: SURVEY.md
+        §5 failure detection — a broken accelerator backend degrades to
+        the XLA CPU engine, and a broken XLA entirely to the
+        interpreter; never crash the data plane)."""
+        try:
+            import jax
+
+            # Donated request buffers (ISSUE 9): XLA recycles each
+            # pipelined batch's upload in place — requested only on
+            # real accelerator backends (no-op + warning on cpu).
+            from .verdict import donate_batch_buffers
+
+            state: dict = {"plan": plan}
+            state["verdict_fn"] = make_verdict_fn(
+                plan, donate=donate_batch_buffers())
+            # Stage-A prefilter as its own dispatch so the pipeline
+            # stage is separately timeable (None when the plan has
+            # no factors or PINGOO_PREFILTER=off).
+            pf = make_prefilter_fn(plan)
+            state["pf_fn"] = pf.fn if pf is not None else None
+            state["pf_gated_banks"] = \
+                len(pf.gated) if pf is not None else 0
+            state["pf_attr"] = (
+                PrefilterAttribution(pf.masked, plane="python")
+                if pf is not None and provenance_enabled() else None)
+            # Mesh BEFORE table materialization: tp padding must
+            # land in plan.np_tables before device_tables() runs.
+            mesh = self._build_mesh(plan)
+            tables = plan.device_tables()
+            if mesh.active:
+                tables = mesh.place_tables(tables)
+            elif device is not None:
+                tables = jax.device_put(tables, device)
+            state["mesh"] = mesh
+            state["tables"] = tables
+            state["staging"] = (
+                StagingEncoder(self.max_batch, plan.field_specs,
+                               nbuf=self._pipeline_depth + 1)
+                if self.pipeline_mode == "on" else None)
+            return state
+        except Exception as exc:
+            # Boot-time demotion is permanent for this service (no
+            # tables to probe against), but still counted/logged
+            # through the ladder's device rung.
+            self.ladder.note_failure("device", exc)
+            return None
+
+    def _adopt_engine_state(self, state: dict) -> None:
+        """Install a pre-built engine bundle as the serving references.
+        Only called with no batch in flight (boot, or the collector's
+        swap point after the drain), so nothing reads these mid-flip."""
+        self._verdict_fn = state["verdict_fn"]
+        self._pf_fn = state["pf_fn"]
+        self._pf_gated_banks = state["pf_gated_banks"]
+        self._pf_attr = state["pf_attr"]
+        self.mesh = state["mesh"]
+        self._tables = state["tables"]
+        if state.get("staging") is not None:
+            self._staging = state["staging"]
 
     def _build_mesh(self, plan) -> MeshExecutor:
         """The serving mesh for this plane (PINGOO_MESH). Degrades to
@@ -600,6 +665,9 @@ class VerdictService:
         sem = asyncio.Semaphore(self._pipeline_depth)
         while True:
             item = await self._queue.get()
+            if isinstance(item, _PlanSwap):
+                await self._apply_swap(item)
+                continue
             t_first = time.monotonic()
             self.stats.observe_stage(
                 "queue_wait", (t_first - item[2]) * 1e3)
@@ -609,6 +677,12 @@ class VerdictService:
             pending = [(item[0], item[1], item[2], t_first)]
             oldest_enq = item[2]
             fixed_deadline = t_first + self.max_wait_s
+            # A swap sentinel popped mid-assembly closes the batch: the
+            # requests admitted so far launch on the old plan, the flip
+            # happens right after the launch (and drains it), and the
+            # requests still queued behind the sentinel admit next
+            # iteration on the new plan.
+            swap = None
             while len(pending) < self.max_batch:
                 now = time.monotonic()
                 if continuous:
@@ -622,6 +696,9 @@ class VerdictService:
                     item = await asyncio.wait_for(self._queue.get(), timeout)
                 except asyncio.TimeoutError:
                     break
+                if isinstance(item, _PlanSwap):
+                    swap = item
+                    break
                 t_adm = time.monotonic()
                 self.stats.observe_stage(
                     "queue_wait", (t_adm - item[2]) * 1e3)
@@ -631,10 +708,13 @@ class VerdictService:
             # oldest request's slack is exhausted — launching
             # singletons under overload would only make every
             # follower later).
-            while len(pending) < self.max_batch:
+            while swap is None and len(pending) < self.max_batch:
                 try:
                     item = self._queue.get_nowait()
                 except asyncio.QueueEmpty:
+                    break
+                if isinstance(item, _PlanSwap):
+                    swap = item
                     break
                 t_adm = time.monotonic()
                 self.stats.observe_stage(
@@ -657,6 +737,124 @@ class VerdictService:
                 self._run_batch_guarded(pending, t_launch, sem))
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
+            if swap is not None:
+                await self._apply_swap(swap)
+
+    # -- ruleset hot-swap (ISSUE 11, docs/RESILIENCE.md) ----------------------
+
+    async def swap_plan(self, plan: RulesetPlan,
+                        lists: Optional[dict] = None,
+                        tenant: str = "default") -> dict:
+        """Hot-swap the serving ruleset at the next batch boundary.
+
+        The new plan's engine state (jitted programs, placed tables,
+        staging buffers) is built and warmed HERE, off the serving path
+        — compile-ahead; with the artifact cache / TenantPlanStore the
+        plan itself was typically already compiled. Then a sentinel
+        rides the admission queue: the collector launches everything
+        admitted ahead of it on the old plan, awaits the in-flight
+        batches, flips the references, and bumps `ruleset_epoch`. The
+        returned dict carries {epoch, tenant, pause_ms}; pause_ms is
+        the drain+flip wall (the admission stall the swap cost — the
+        number bench_regress tracks as swap_pause_p99_ms)."""
+        from .hotswap import note_swap
+
+        if self._task is None:
+            raise RuntimeError("swap_plan requires a started service")
+        loop = asyncio.get_running_loop()
+        state = None
+        if self.use_device:
+            state = await loop.run_in_executor(
+                None, self._build_engine_state, plan, self._device_hint)
+            if state is None:
+                note_swap("python", tenant, "rejected")
+                raise RuntimeError(
+                    f"hot-swap rejected for tenant {tenant!r}: engine "
+                    f"state build failed (old plan keeps serving)")
+            # Warm the jitted programs off-path so the first post-swap
+            # batch doesn't pay an XLA compile inside its deadline.
+            await loop.run_in_executor(None, self._warm_state, state)
+        fut: asyncio.Future = loop.create_future()
+        await self._queue.put(_PlanSwap(plan, lists, tenant, state, fut))
+        return await fut
+
+    def _warm_state(self, state: dict) -> None:
+        """Trace/compile the new state's device programs on a dummy
+        row (best-effort — a warm failure surfaces later through the
+        normal ladder machinery, not as a rejected swap)."""
+        try:
+            plan = state["plan"]
+            batch = encode_requests([RequestTuple()], plan.field_specs)
+            fast = pad_batch(
+                RequestBatch(size=1, arrays=bucket_arrays(batch.arrays)),
+                1)
+            dev_arrays = fast.arrays
+            mesh = state["mesh"]
+            if mesh is not None and mesh.active:
+                dev_arrays = mesh.shard_batch(dev_arrays)
+            pf_hits = None
+            if state["pf_fn"] is not None:
+                pf_hits, _ = state["pf_fn"](state["tables"], dev_arrays)
+            state["verdict_fn"](state["tables"], dev_arrays, pf_hits)
+        except Exception:
+            pass
+
+    async def _apply_swap(self, swap: _PlanSwap) -> None:
+        """The epoch flip, in collector context at a batch boundary.
+        Awaiting the in-flight set first is what makes it atomic:
+        _run_batch reads self.plan/_tables/_verdict_fn when it runs, so
+        no launched batch can observe a half-installed state — and no
+        future is dropped (every pending request launched) or resolved
+        twice (each launched exactly once)."""
+        from .hotswap import note_swap, set_epoch_gauge
+
+        t0 = time.monotonic()
+        while self._inflight:
+            await asyncio.gather(*list(self._inflight),
+                                 return_exceptions=True)
+        try:
+            self._install_plan(swap)
+        except Exception as exc:
+            note_swap("python", swap.tenant, "rejected")
+            if not swap.fut.done():
+                swap.fut.set_exception(exc)
+            return
+        self.ruleset_epoch += 1
+        self.tenant = swap.tenant
+        pause_ms = (time.monotonic() - t0) * 1e3
+        set_epoch_gauge("python", self.ruleset_epoch)
+        note_swap("python", swap.tenant, "ok")
+        self.stats.observe_stage("sched", pause_ms)
+        if not swap.fut.done():
+            swap.fut.set_result({"epoch": self.ruleset_epoch,
+                                 "tenant": swap.tenant,
+                                 "pause_ms": round(pause_ms, 3)})
+
+    def _install_plan(self, swap: _PlanSwap) -> None:
+        plan = swap.plan
+        if self.use_device:
+            if swap.state is None:
+                raise RuntimeError("hot-swap with no prepared state")
+            self._adopt_engine_state(swap.state)
+        self.plan = plan
+        if swap.lists is not None:
+            self.lists = swap.lists
+        self._dfa_mode0 = getattr(plan, "dfa_default_mode", "auto")
+        self._dfa_probe = False
+        # Provenance follows the plan: rule names/indices changed, so
+        # attribution, the parity oracle, and flight-record annotation
+        # restart on the new plan's shape (counters are cumulative
+        # across epochs; the per-rule label sets re-seed).
+        if self._attribution is not None:
+            self._attribution.close()
+            self._attribution = RuleAttribution(plan.rule_names,
+                                                plane="python")
+        if self.parity is not None:
+            self.parity.stop()
+            self.flight_recorder = register_recorder(FlightRecorder(
+                "python", rule_names=plan.rule_names))
+            self.parity = ParityAuditor(plan, self.lists, plane="python",
+                                        recorder=self.flight_recorder)
 
     async def _run_batch_guarded(self, pending, t_launch, sem) -> None:
         try:
@@ -672,7 +870,7 @@ class VerdictService:
                 if not fut.done():
                     fut.set_result(Verdict(
                         action=0, matched=np.zeros(R, dtype=bool),
-                        degraded=True))
+                        degraded=True, epoch=self.ruleset_epoch))
         finally:
             sem.release()
 
@@ -768,7 +966,8 @@ class VerdictService:
                     fut.set_result(
                         Verdict(action=int(actions[i]), matched=matched[i],
                                 bot_score=float(scores[i]),
-                                verified_block=bool(verified_block[i])))
+                                verified_block=bool(verified_block[i]),
+                                epoch=self.ruleset_epoch))
             t_res_end = time.monotonic()
             self.stats.observe_stage(
                 "resolve", (t_res_end - t_resolve) * 1e3)
@@ -809,7 +1008,8 @@ class VerdictService:
                 if not fut.done():
                     fut.set_result(Verdict(
                         action=int(acts[i]), matched=matched[i],
-                        verified_block=bool(vblk[i])))
+                        verified_block=bool(vblk[i]),
+                        epoch=self.ruleset_epoch))
             return
         t_res = time.monotonic()
         for _, fut, t_enq, _t_adm in pending:
@@ -818,7 +1018,7 @@ class VerdictService:
             if not fut.done():
                 fut.set_result(Verdict(
                     action=0, matched=np.zeros(R, dtype=bool),
-                    degraded=True))
+                    degraded=True, epoch=self.ruleset_epoch))
 
     async def _apply_failopen(self, pending: list) -> list:
         """Fail open the requests whose deadline is unmeetable even by
@@ -846,7 +1046,7 @@ class VerdictService:
                 if not fut.done():
                     fut.set_result(Verdict(
                         action=0, matched=np.zeros(R, dtype=bool),
-                        degraded=True))
+                        degraded=True, epoch=self.ruleset_epoch))
             return keep
         # interpret: a real verdict, just off the device path — the
         # same degradation rung the watchdog fallback uses.
@@ -863,7 +1063,8 @@ class VerdictService:
             if not fut.done():
                 fut.set_result(Verdict(
                     action=int(acts[i]), matched=matched[i],
-                    verified_block=bool(vblk[i])))
+                    verified_block=bool(vblk[i]),
+                    epoch=self.ruleset_epoch))
         return keep
 
     def _observe_provenance(self, reqs, pending, matched, actions,

@@ -31,6 +31,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import re
 import time
 from dataclasses import dataclass, field
 from typing import Optional
@@ -53,7 +54,84 @@ from .services import Response, match_route
 
 USER_AGENT_MAX_LENGTH = 256
 HOSTNAME_MAX_LENGTH = 256
-MAX_BODY_BYTES = 16 * 1024 * 1024
+
+
+def _int_env(name: str, default: int, floor: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        value = int(raw)
+    except ValueError:
+        return default
+    return value if value >= floor else default
+
+
+# Request-size caps, shared knob-for-knob with the native plane
+# (native/httpd.cc reads the same env vars) so oversized requests get
+# the same status on both listeners: 431 for a head beyond
+# PINGOO_MAX_HEADER_BYTES, 413 for a body beyond PINGOO_MAX_BODY_BYTES
+# (ISSUE 11; parity test in tests/test_fuzz_corpus.py).
+MAX_HEADER_BYTES = _int_env("PINGOO_MAX_HEADER_BYTES", 32 * 1024, 256)
+MAX_BODY_BYTES = _int_env("PINGOO_MAX_BODY_BYTES", 16 * 1024 * 1024, 1)
+
+# End of an h1 request head, tolerating the bare-LF variants h11
+# accepts (the strict gate below then rejects them explicitly rather
+# than letting the two listener planes diverge on them).
+_HEAD_END_RE = re.compile(rb"\r?\n\r?\n")
+
+_RAW_400 = (b"HTTP/1.1 400 Bad Request\r\nserver: pingoo\r\n"
+            b"content-length: 0\r\nconnection: close\r\n\r\n")
+_RAW_413 = (b"HTTP/1.1 413 Content Too Large\r\nserver: pingoo\r\n"
+            b"content-length: 0\r\nconnection: close\r\n\r\n")
+_RAW_431 = (b"HTTP/1.1 431 Request Header Fields Too Large\r\n"
+            b"server: pingoo\r\n"
+            b"content-length: 0\r\nconnection: close\r\n\r\n")
+
+
+def strict_head_violation(head: bytes) -> Optional[str]:
+    """WAFFLED-class strict gate over the RAW request head, applied
+    before h11 parses it (and mirrored in native/httpd.cc parse_head):
+    h11 is lenient exactly where parser pairs historically disagree —
+    it joins obsolete line folds, collapses value-identical duplicate
+    Content-Length headers, accepts bare-LF line endings and
+    Transfer-Encoding alongside Content-Length. Each of those is a
+    framing ambiguity one hop may read differently from the next
+    (request smuggling), so both listener planes refuse them outright.
+    Returns a short reason string, or None when the head is clean."""
+    if b"\n" in head.replace(b"\r\n", b""):
+        return "bare-lf-line-ending"
+    lines = head.split(b"\r\n")
+    # h11 tolerates versions up to HTTP/2.x on an h1 socket; the native
+    # plane serves exactly 1.0/1.1. Pin the gate to the intersection.
+    if not (lines[0].endswith(b" HTTP/1.1")
+            or lines[0].endswith(b" HTTP/1.0")):
+        return "http-version"
+    cl_seen = 0
+    te_seen = False
+    for line in lines[1:]:
+        if not line:
+            break
+        if line[:1] in (b" ", b"\t"):
+            return "obs-fold"
+        name, sep, value = line.partition(b":")
+        if not sep:
+            return "colonless-field-line"
+        if name != name.rstrip(b" \t"):
+            return "whitespace-before-colon"
+        lname = name.lower()
+        if lname == b"content-length":
+            cl_seen += 1
+            # Digits only (after OWS): h11 collapses a value-identical
+            # list ("3, 3") that the native plane refuses; and signs,
+            # blanks, or separators are framing ambiguity either way.
+            if not value.strip(b" \t").isdigit():
+                return "bad-content-length"
+        elif lname == b"transfer-encoding":
+            te_seen = True
+    if cl_seen > 1:
+        return "duplicate-content-length"
+    if te_seen and cl_seen:
+        return "te-with-cl"
+    return None
 GRACEFUL_SHUTDOWN_S = 20  # listeners/mod.rs:28
 
 
@@ -133,6 +211,84 @@ def get_host(req: Request) -> str:
                 host = _strip_port(value)
                 break
     return host if len(host) <= HOSTNAME_MAX_LENGTH else ""
+
+
+def declared_content_length(head: bytes) -> Optional[int]:
+    """The head's Content-Length value, or None when absent/garbled.
+    Only meaningful AFTER strict_head_violation passed (at most one CL,
+    no folded lines)."""
+    for line in head.split(b"\r\n")[1:]:
+        if not line:
+            break
+        name, sep, value = line.partition(b":")
+        if sep and name.lower() == b"content-length":
+            try:
+                return int(value.strip())
+            except ValueError:
+                return None
+    return None
+
+
+def extract_request_fields(req: Request) -> tuple[str, str]:
+    """(host, user_agent) exactly as the serving path computes them.
+    The differential fuzzer (tools/analyze/fuzz.py) calls this so its
+    oracle can never drift from the listener's own extraction."""
+    host = get_host(req)
+    user_agent = ""
+    for name, value in req.headers:
+        if name.lower() == "user-agent":
+            user_agent = value.strip()
+            break
+    if len(user_agent) >= USER_AGENT_MAX_LENGTH:
+        user_agent = ""  # heapless from_str overflow -> default empty
+    return host, user_agent
+
+
+def parse_request_bytes(data: bytes):
+    """One-shot parse oracle: run DATA through exactly the gates and
+    h11 parse the live listener applies, without sockets. Returns
+    ("ok", Request), ("reject", "400"|"413"|"431"), or
+    ("incomplete", None) when DATA ends before a full message."""
+    m = _HEAD_END_RE.search(data)
+    if m is None:
+        return ("reject", "431") if len(data) > MAX_HEADER_BYTES \
+            else ("incomplete", None)
+    if m.end() > MAX_HEADER_BYTES:
+        return ("reject", "431")
+    if strict_head_violation(data[:m.end()]) is not None:
+        return ("reject", "400")
+    cl = declared_content_length(data[:m.end()])
+    if cl is not None and cl > MAX_BODY_BYTES:
+        return ("reject", "413")
+    conn = h11.Connection(h11.SERVER,
+                          max_incomplete_event_size=MAX_HEADER_BYTES)
+    try:
+        conn.receive_data(data)
+        conn.receive_data(b"")  # EOF: flush a read-to-close body
+        req_event = None
+        body = bytearray()
+        while True:
+            event = conn.next_event()
+            if event is h11.NEED_DATA or event is h11.PAUSED:
+                return ("incomplete", None)
+            if isinstance(event, h11.Request):
+                req_event = event
+            elif isinstance(event, h11.Data):
+                body += event.data
+                if len(body) > MAX_BODY_BYTES:
+                    return ("reject", "413")
+            elif isinstance(event, h11.EndOfMessage):
+                break
+            elif isinstance(event, h11.ConnectionClosed) or event is None:
+                return ("incomplete", None)
+    except h11.RemoteProtocolError:
+        return ("reject", "400")
+    target = req_event.target.decode("latin-1")
+    headers = [(n.decode("latin-1"), v.decode("latin-1"))
+               for n, v in req_event.headers]
+    return ("ok", Request(method=req_event.method.decode("ascii"),
+                          target=target, path=target.split("?", 1)[0],
+                          headers=headers, body=bytes(body)))
 
 
 def request_tuple_to_context(tup: RequestTuple, lists: dict) -> Context:
@@ -269,11 +425,17 @@ class HttpListener:
                     await self._serve_h2(reader, writer, peer,
                                          initial=initial)
                     return
-        conn = h11.Connection(h11.SERVER)
+        conn = h11.Connection(h11.SERVER,
+                              max_incomplete_event_size=MAX_HEADER_BYTES)
         if initial:
             conn.receive_data(initial)
         try:
             while True:
+                raw = await self._gate_head(conn, reader)
+                if raw is not None:
+                    writer.write(raw)
+                    await writer.drain()
+                    break
                 event = await self._next_event(conn, reader)
                 if event is h11.PAUSED or isinstance(
                         event, (h11.ConnectionClosed, type(None))):
@@ -289,7 +451,19 @@ class HttpListener:
                     if conn.our_state is h11.MUST_CLOSE:
                         break
                     conn.start_next_cycle()
-        except (h11.RemoteProtocolError, OSError, asyncio.IncompleteReadError):
+        except h11.RemoteProtocolError as exc:
+            # Answer before closing (the native plane does too): 413
+            # for the body cap, 400 for everything h11 refused — unless
+            # a response already started, where injecting one would
+            # corrupt the client's framing.
+            try:
+                if conn.our_state is h11.IDLE:
+                    writer.write(_RAW_413 if "body too large" in str(exc)
+                                 else _RAW_400)
+                    await writer.drain()
+            except (OSError, asyncio.IncompleteReadError):
+                pass
+        except (OSError, asyncio.IncompleteReadError):
             pass
         finally:
             try:
@@ -336,6 +510,33 @@ class HttpListener:
                 up_writer.close()
             except OSError:
                 pass
+
+    async def _gate_head(self, conn, reader) -> Optional[bytes]:
+        """Buffer the next request head RAW (h11 sees every byte too —
+        this only mirrors, never consumes) and apply the strict gate
+        plus the PINGOO_MAX_HEADER_BYTES cap before h11 parses it.
+        Returns a raw response to send-and-close (431/400), or None
+        when the head passed / the peer closed. h11's trailing_data
+        seeds the scan so pipelined requests gate correctly."""
+        scan = bytearray(conn.trailing_data[0])
+        while _HEAD_END_RE.search(scan) is None:
+            if len(scan) > MAX_HEADER_BYTES:
+                return _RAW_431
+            data = await reader.read(65536)
+            if not data:
+                return None  # EOF: the event loop settles the state
+            conn.receive_data(data)
+            scan += data
+        end = _HEAD_END_RE.search(scan).end()
+        if end > MAX_HEADER_BYTES:
+            return _RAW_431
+        head = bytes(scan[:end])
+        if strict_head_violation(head) is not None:
+            return _RAW_400
+        cl = declared_content_length(head)
+        if cl is not None and cl > MAX_BODY_BYTES:
+            return _RAW_413  # eager, like the native plane: never buffer
+        return None
 
     async def _next_event(self, conn, reader):
         while True:
@@ -549,7 +750,7 @@ class HttpListener:
                     if first:
                         client_ip = first
                     break
-        host = get_host(req)
+        host, user_agent = extract_request_fields(req)
 
         geoip_record = GeoipRecord()
         if self.geoip is not None:
@@ -557,14 +758,6 @@ class HttpListener:
                 geoip_record = self.geoip.lookup(client_ip)
             except (AddressNotFound, ValueError):
                 pass
-
-        user_agent = ""
-        for name, value in req.headers:
-            if name.lower() == "user-agent":
-                user_agent = value.strip()
-                break
-        if len(user_agent) >= USER_AGENT_MAX_LENGTH:
-            user_agent = ""  # heapless from_str overflow -> default empty
 
         client_id = generate_captcha_client_id(client_ip, user_agent, host)
         cookies = parse_cookies(req.headers)

@@ -95,6 +95,48 @@ constexpr size_t kH2PrefaceLen = 24;
 constexpr size_t kMaxHead = 32 * 1024;
 constexpr size_t kMaxBufferedDefault = 1 << 20;  // per-direction backlog
 
+// Request-head byte cap, env-tunable (PINGOO_MAX_HEADER_BYTES) and
+// shared with the Python listener plane (host/httpd.py reads the same
+// knob) so oversized-head handling is identical on both: exceed it and
+// the request gets 431, not a parser-dependent mix of 400/close
+// (ISSUE 11 fuzzer parity). Response heads from upstreams keep the
+// compile-time kMaxHead — that bound protects us from the upstream,
+// not the client, and is not part of the request-parse surface.
+inline size_t parse_max_req_head() {
+  const char* e = getenv("PINGOO_MAX_HEADER_BYTES");
+  if (e == nullptr || *e == '\0') return kMaxHead;
+  long n = atol(e);
+  if (n < 256) {
+    fprintf(stderr,
+            "PINGOO_MAX_HEADER_BYTES=%s out of range (< 256); using %zu\n",
+            e, kMaxHead);
+    return kMaxHead;
+  }
+  return static_cast<size_t>(n);
+}
+const size_t kMaxReqHead = parse_max_req_head();
+
+// Request-body byte cap (PINGOO_MAX_BODY_BYTES, default 16 MiB — the
+// Python listener's historical MAX_BODY_BYTES). A Content-Length
+// beyond it is refused up front with 413. Chunked uploads stream
+// through under the proxy backpressure gates instead of buffering, so
+// they are bounded by PINGOO_MAX_BUFFER rather than this knob — a
+// documented delta vs the Python plane, which buffers the whole body
+// (docs/FUZZING.md known-deltas).
+inline long long parse_max_body_bytes() {
+  const char* e = getenv("PINGOO_MAX_BODY_BYTES");
+  long long def = 16LL * 1024 * 1024;
+  if (e == nullptr || *e == '\0') return def;
+  long long n = atoll(e);
+  if (n < 1) {
+    fprintf(stderr, "PINGOO_MAX_BODY_BYTES=%s out of range (< 1); using %lld\n",
+            e, def);
+    return def;
+  }
+  return n;
+}
+const long long kMaxBodyBytes = parse_max_body_bytes();
+
 // Buffering cap, env-tunable (PINGOO_MAX_BUFFER) so tests can exercise
 // the backpressure/re-pump paths without multi-MB payloads. Resolved
 // once at process start; out-of-range values warn and fall back.
@@ -603,9 +645,16 @@ struct BodyFramer {
               if (!is_hex) break;
               ++hex_len;
             }
+            // BWS after the size (before ';' or CRLF) is tolerated —
+            // h11 accepts "3 \r\n"/"3\t\r\n" and the two planes must
+            // frame identically (differential fuzzer, ISSUE 11).
+            size_t bws_end = hex_len;
+            while (bws_end + 2 < linebuf.size() &&
+                   (linebuf[bws_end] == ' ' || linebuf[bws_end] == '\t'))
+              ++bws_end;
             bool valid_size =
                 hex_len > 0 &&
-                (hex_len + 2 == linebuf.size() || linebuf[hex_len] == ';');
+                (bws_end + 2 == linebuf.size() || linebuf[bws_end] == ';');
             long long sz = valid_size ? strtoll(linebuf.c_str(), nullptr, 16)
                                       : -1;
             linebuf.clear();
@@ -669,7 +718,10 @@ struct Parsed {
   std::string verified_cookie;  // __pingoo_captcha_verified value
   long long content_length = 0;
   bool has_content_length = false;
-  bool bad_content_length = false;  // dup-with-different-value/garbage
+  bool bad_content_length = false;  // duplicate/garbage Content-Length
+  bool obs_fold = false;  // obsolete line folding seen (RFC 7230 §3.2.4)
+  bool bad_header = false;  // colonless line / ws before colon / bare LF
+  bool has_host = false;    // first Host seen; a repeat sets bad_header
   bool chunked = false;
   bool has_transfer_encoding = false;
   bool keep_alive = true;  // HTTP/1.1 default
@@ -757,6 +809,14 @@ std::string extract_verified_cookie(const std::string& value);
 // Parse a request head (request line + headers).
 Parsed parse_head(const std::string& head) {
   Parsed p;
+  // A bare LF (not preceded by CR) inside the head is invisible to the
+  // CRLF line scan below: "ua\nx-smuggle: 1" would read as ONE header
+  // value here while an LF-tolerant parser (h11 accepts bare-LF line
+  // endings at the transport layer) sees TWO lines — exactly the
+  // per-hop disagreement request smuggling needs. Reject the head.
+  for (size_t i = 0; i < head.size(); ++i)
+    if (head[i] == '\n' && (i == 0 || head[i - 1] != '\r'))
+      p.bad_header = true;
   size_t line_end = head.find("\r\n");
   if (line_end == std::string::npos) return p;
   const std::string line = head.substr(0, line_end);
@@ -780,26 +840,55 @@ Parsed parse_head(const std::string& head) {
   while (pos < head.size()) {
     size_t eol = head.find("\r\n", pos);
     if (eol == std::string::npos || eol == pos) break;
+    if (head[pos] == ' ' || head[pos] == '\t') {
+      // Obsolete line folding (RFC 7230 §3.2.4). Previously skipped
+      // silently — but the Python plane's h11 parser REJECTS folds, so
+      // a folded Transfer-Encoding read one way by this parser and
+      // another by anything downstream is a smuggling vector the
+      // differential fuzzer flags (ISSUE 11). Reject at admission.
+      p.obs_fold = true;
+      pos = eol + 2;
+      continue;
+    }
     size_t colon = head.find(':', pos);
-    if (colon != std::string::npos && colon < eol) {
+    if (colon == std::string::npos || colon >= eol) {
+      // A field line without a colon is not skippable noise: a parser
+      // that drops it and one that rejects the message (h11 does)
+      // disagree about every header that follows (RFC 9112 §2.2).
+      p.bad_header = true;
+      pos = eol + 2;
+      continue;
+    }
+    {
+      // RFC 7230 §3.2.4: whitespace between field-name and ":" MUST be
+      // rejected — "Host : x" is a smuggling classic (one hop reads a
+      // Host header, the next reads none).
+      char last = colon > pos ? head[colon - 1] : '\0';
+      if (last == ' ' || last == '\t') p.bad_header = true;
       std::string name = lower(head.substr(pos, colon - pos));
       std::string value = trim(head.substr(colon + 1, eol - colon - 1));
       if (name == "host") {
+        // RFC 9112 §3.2: more than one Host is a MUST-reject (h11
+        // refuses too). First-wins here + last-wins upstream would
+        // route and verdict on different vhosts.
+        if (p.has_host) p.bad_header = true;
+        p.has_host = true;
         p.host = strip_host_port(value);
       } else if (name == "user-agent") {
         p.user_agent = value;
       } else if (name == "accept") {
         p.accept = lower(value);
       } else if (name == "content-length") {
-        // RFC 7230 §3.3.3: reject non-numeric values and duplicates
-        // that disagree — silent last-wins framing would desync the
-        // proxy from any first-wins upstream (request smuggling).
+        // RFC 7230 §3.3.3: reject non-numeric values and ANY repeat —
+        // even value-identical duplicates (h11 refuses them too, and a
+        // first-wins upstream may not treat them as identical after
+        // its own normalization). Silent last-wins framing would
+        // desync the proxy from the upstream (request smuggling).
         bool numeric = !value.empty();
         for (char ch : value)
           if (ch < '0' || ch > '9') numeric = false;
         long long v = numeric ? strtoll(value.c_str(), nullptr, 10) : -1;
-        if (!numeric || v < 0 ||
-            (p.has_content_length && v != p.content_length)) {
+        if (!numeric || v < 0 || p.has_content_length) {
           p.bad_content_length = true;
         } else {
           p.content_length = v;
@@ -1172,6 +1261,12 @@ const char k502[] =
     "connection: close\r\n\r\nBad Gateway";
 const char k400[] =
     "HTTP/1.1 400 Bad Request\r\nserver: pingoo\r\n"
+    "content-length: 0\r\nconnection: close\r\n\r\n";
+const char k413[] =
+    "HTTP/1.1 413 Content Too Large\r\nserver: pingoo\r\n"
+    "content-length: 0\r\nconnection: close\r\n\r\n";
+const char k431[] =
+    "HTTP/1.1 431 Request Header Fields Too Large\r\nserver: pingoo\r\n"
     "content-length: 0\r\nconnection: close\r\n\r\n";
 const char k404[] =
     "HTTP/1.1 404 Not Found\r\nserver: pingoo\r\n"
@@ -3189,7 +3284,7 @@ class Server {
       if (r > 0) {
         size_t old = c->inbuf.size();
         c->inbuf.append(buf, static_cast<size_t>(r));
-        if (c->inbuf.size() > kMaxHead + kMaxBuffered) {
+        if (c->inbuf.size() > kMaxReqHead + kMaxBuffered) {
           mark_close(c);
           return;
         }
@@ -3242,11 +3337,18 @@ class Server {
     }
     size_t head_end = c->inbuf.find("\r\n\r\n");
     if (head_end == std::string::npos) {
-      if (c->inbuf.size() > kMaxHead) {
-        respond_close(c, k400);
+      if (c->inbuf.size() > kMaxReqHead) {
+        // 431, not 400: the Python listener plane answers its
+        // PINGOO_MAX_HEADER_BYTES breach the same way (parity test in
+        // tests/test_fuzz_corpus.py).
+        respond_close(c, k431);
         return;
       }
       if (eof) mark_close(c);  // EOF before a complete head
+      return;
+    }
+    if (head_end + 4 > kMaxReqHead) {
+      respond_close(c, k431);
       return;
     }
     Parsed p = parse_head(c->inbuf.substr(0, head_end + 4));
@@ -3259,11 +3361,22 @@ class Server {
     if (++c->requests_served > kMaxRequestsPerConn) c->req.keep_alive = false;
 
     // A Transfer-Encoding we cannot frame (anything but chunked), a
-    // malformed/conflicting Content-Length, or TE+CL together would
-    // desync the proxy from the upstream: refuse them (RFC 7230
-    // §3.3.3 smuggling rules).
-    if ((p.has_transfer_encoding && !p.chunked) || p.bad_content_length) {
+    // malformed/duplicated Content-Length, TE and CL together, obsolete
+    // header folding, or a malformed field line would desync the proxy
+    // from the upstream: refuse them (RFC 9112 §6.1/§6.3 smuggling
+    // rules; RFC 7230 §3.2.4). The Python listener plane applies the
+    // identical gate (host/httpd.py strict_head_violation) so the
+    // differential fuzzer holds both to one behavior.
+    if ((p.has_transfer_encoding && !p.chunked) || p.bad_content_length ||
+        (p.has_transfer_encoding && p.has_content_length) || p.obs_fold ||
+        p.bad_header) {
       respond_close(c, k400);
+      return;
+    }
+    // Declared body beyond the cap: refuse before framing starts (the
+    // Python plane enforces the same PINGOO_MAX_BODY_BYTES with 413).
+    if (p.has_content_length && p.content_length > kMaxBodyBytes) {
+      respond_close(c, k413);
       return;
     }
     // Request body framing (bytes beyond it are the NEXT request and

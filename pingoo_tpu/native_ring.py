@@ -504,8 +504,6 @@ class RingSidecar:
                  idle_sleep_s: float = 0.0002, pipeline_depth: int = 3,
                  services: Optional[list] = None, geoip=None,
                  ring_services: Optional[list] = None):
-        from .engine.verdict import make_lane_fn
-
         self.rings: list[Ring] = list(ring) if isinstance(
             ring, (list, tuple)) else [ring]
         self.ring = self.rings[0]  # single-ring callers' view
@@ -598,32 +596,6 @@ class RingSidecar:
         from .obs.provenance import provenance_enabled
 
         self._provenance_on = provenance_enabled()
-        # Donated request buffers (ISSUE 9): XLA recycles each
-        # pipelined batch's upload in place — requested only on real
-        # accelerator backends (no-op + warning on cpu).
-        from .engine.verdict import donate_batch_buffers
-
-        self._lane_fn = make_lane_fn(
-            plan, service_groups=self._groups or None,
-            with_rule_hits=self._provenance_on,
-            donate=donate_batch_buffers())
-        # Services whose route predicate fell back to host interpretation
-        # are merged into the device route lane per batch (per group).
-        self._host_routes: list[list[tuple[int, object]]] = []
-        by_index = {r.index: r for r in plan.rules}
-        for g in self._groups:
-            hr = []
-            for order, name in enumerate(g):
-                ridx = plan.route_index.get(name)
-                if ridx is not None and by_index[ridx].host:
-                    hr.append((order, by_index[ridx].program))
-            self._host_routes.append(hr)
-        # Serving mesh (ISSUE 6): tp padding must land in plan.np_tables
-        # before device_tables() materializes; failures degrade to the
-        # single-device path (never crash the drain) and stay visible
-        # via pingoo_mesh_devices == 1.
-        from .sched import MeshExecutor
-
         # Degradation ladder (ISSUE 10, docs/RESILIENCE.md): the
         # scattered fallbacks below route through one explicit state
         # machine — demotions are counted per rung and probed back
@@ -631,17 +603,6 @@ class RingSidecar:
         from .engine.ladder import DegradationLadder
 
         self.ladder = DegradationLadder("sidecar")
-        try:
-            self.mesh = MeshExecutor(plan, plane="sidecar",
-                                     metrics=self.sched.metrics)
-        except (MeshUnavailable, ValueError) as exc:
-            self.ladder.note_failure("mesh", exc)
-            self.mesh = MeshExecutor(plan, spec=(1, 1, 1),
-                                     plane="sidecar",
-                                     metrics=self.sched.metrics)
-        tables = plan.device_tables()
-        self._tables = (self.mesh.place_tables(tables)
-                        if self.mesh.active else tables)
         # The C++ plane has no mmdb decoder: it enqueues slots with
         # asn=0 / country="XX" (its unknown markers). The reference
         # resolves geoip per request in the listener
@@ -700,21 +661,8 @@ class RingSidecar:
         # Stage-A literal prefilter (docs/PREFILTER.md): the sidecar is
         # the native plane's verdict engine, so it exports the same
         # candidate-rate/skip metrics the Python listener plane does.
-        from .engine.verdict import make_prefilter_fn
         from .obs.schema import PREFILTER_METRICS
 
-        self._pf_fn = None
-        self._pf_gated_banks = 0
-        self._pf_attr = None
-        pf = make_prefilter_fn(plan)
-        if pf is not None:
-            self._pf_fn = pf.fn
-            self._pf_gated_banks = len(pf.gated)
-            if self._provenance_on:
-                from .obs.provenance import PrefilterAttribution
-
-                self._pf_attr = PrefilterAttribution(
-                    pf.masked, plane="sidecar")
         self._pf_rate_gauge = REGISTRY.gauge(
             "pingoo_prefilter_candidate_rate",
             PREFILTER_METRICS["pingoo_prefilter_candidate_rate"],
@@ -743,19 +691,25 @@ class RingSidecar:
         self._attribution = None
         self.flight_recorder = None
         self.parity = None
-        self._dev_cols = np.asarray(plan.device_rule_indices,
-                                    dtype=np.int64)
-        if self._provenance_on:
-            from .obs.flightrecorder import (FlightRecorder,
-                                             register_recorder)
-            from .obs.provenance import ParityAuditor, RuleAttribution
+        # Ruleset hot-swap (ISSUE 11, docs/RESILIENCE.md): every
+        # plan-derived piece of engine state (jitted lane fn, host
+        # routes, mesh+tables, prefilter, attribution, dev cols) is
+        # built by _build_plan_state and installed by _adopt_plan_state
+        # — at init here, and again at a drain-loop batch boundary when
+        # request_swap hands over a plan compiled ahead of time.
+        import threading as _threading
 
-            self.flight_recorder = register_recorder(FlightRecorder(
-                "sidecar", rule_names=plan.rule_names))
-            self._attribution = RuleAttribution(plan.rule_names,
-                                                plane="sidecar")
-            self.parity = ParityAuditor(plan, lists, plane="sidecar",
-                                        recorder=self.flight_recorder)
+        self._swap_lock = _threading.Lock()
+        self._swap_queue: list = []
+        self.ruleset_epoch = 0
+        self.tenant = "default"
+        # drain+flip pause per applied swap (ms) — chaos_smoke folds
+        # the p99 into the bench summary (swap_pause_p99_ms).
+        self.swap_pauses_ms: list = []
+        self._adopt_plan_state(plan, None, self._build_plan_state(plan))
+        from .engine.hotswap import set_epoch_gauge
+
+        set_epoch_gauge("sidecar", 0)
         self._collector_live = True
         REGISTRY.register_collector(self._export_ring_telemetry)
         # -- sidecar supervision (ISSUE 10, docs/RESILIENCE.md) ---------------
@@ -763,8 +717,6 @@ class RingSidecar:
         from .obs.schema import RESILIENCE_METRICS
 
         self.chaos = ChaosInjector.from_env()
-        self._dfa_probe = False
-        self._dfa_mode0 = getattr(plan, "dfa_default_mode", "auto")
         # Liveness protocol (ring v5): bump each ring's epoch so the
         # data plane can tell a restarted sidecar from a frozen one,
         # then reconcile tickets the dead epoch dequeued but never
@@ -832,6 +784,173 @@ class RingSidecar:
                     r.heartbeat()
             time.sleep(0.1)
 
+    # -- ruleset hot-swap (ISSUE 11, docs/RESILIENCE.md) ----------------------
+
+    def _build_plan_state(self, plan) -> dict:
+        """Every plan-derived piece of the sidecar's engine state, built
+        OFF the drain loop (init, or a request_swap caller's thread —
+        compile-ahead through compiler/cache): the drain loop's flip is
+        then pointer assignment at a batch boundary, never compilation."""
+        from .engine.verdict import (donate_batch_buffers, make_lane_fn,
+                                     make_prefilter_fn)
+        from .sched import MeshExecutor, MeshUnavailable
+
+        state: dict = {"plan": plan}
+        state["lane_fn"] = make_lane_fn(
+            plan, service_groups=self._groups or None,
+            with_rule_hits=self._provenance_on,
+            donate=donate_batch_buffers())
+        # Services whose route predicate fell back to host interpretation
+        # are merged into the device route lane per batch (per group).
+        host_routes: list = []
+        by_index = {r.index: r for r in plan.rules}
+        for g in self._groups:
+            hr = []
+            for order, name in enumerate(g):
+                ridx = plan.route_index.get(name)
+                if ridx is not None and by_index[ridx].host:
+                    hr.append((order, by_index[ridx].program))
+            host_routes.append(hr)
+        state["host_routes"] = host_routes
+        # Serving mesh (ISSUE 6): tp padding must land in plan.np_tables
+        # before device_tables() materializes; failures degrade to the
+        # single-device path (never crash the drain) and stay visible
+        # via pingoo_mesh_devices == 1.
+        try:
+            mesh = MeshExecutor(plan, plane="sidecar",
+                                metrics=self.sched.metrics)
+        except (MeshUnavailable, ValueError) as exc:
+            self.ladder.note_failure("mesh", exc)
+            mesh = MeshExecutor(plan, spec=(1, 1, 1), plane="sidecar",
+                                metrics=self.sched.metrics)
+        state["mesh"] = mesh
+        tables = plan.device_tables()
+        state["tables"] = (mesh.place_tables(tables)
+                           if mesh.active else tables)
+        state["pf_fn"] = None
+        state["pf_gated_banks"] = 0
+        state["pf_attr"] = None
+        pf = make_prefilter_fn(plan)
+        if pf is not None:
+            state["pf_fn"] = pf.fn
+            state["pf_gated_banks"] = len(pf.gated)
+            if self._provenance_on:
+                from .obs.provenance import PrefilterAttribution
+
+                state["pf_attr"] = PrefilterAttribution(
+                    pf.masked, plane="sidecar")
+        state["dev_cols"] = np.asarray(plan.device_rule_indices,
+                                       dtype=np.int64)
+        return state
+
+    def _adopt_plan_state(self, plan, lists, state: dict) -> None:
+        """Flip the drain loop onto a prebuilt plan state. Only safe at
+        a batch boundary (init, or _apply_swaps after a full drain):
+        _dispatch/_complete read these references per batch."""
+        self.plan = plan
+        if lists is not None:
+            self.lists = lists
+        self._lane_fn = state["lane_fn"]
+        self._host_routes = state["host_routes"]
+        self.mesh = state["mesh"]
+        self._tables = state["tables"]
+        self._pf_fn = state["pf_fn"]
+        self._pf_gated_banks = state["pf_gated_banks"]
+        self._pf_attr = state["pf_attr"]
+        self._dev_cols = state["dev_cols"]
+        self._dfa_mode0 = getattr(plan, "dfa_default_mode", "auto")
+        self._dfa_probe = False
+        self._plan_state = state
+        if self._provenance_on:
+            from .obs.flightrecorder import (FlightRecorder,
+                                             register_recorder)
+            from .obs.provenance import ParityAuditor, RuleAttribution
+
+            if self._attribution is not None:
+                self._attribution.close()
+            if self.parity is not None:
+                self.parity.stop()
+            self.flight_recorder = register_recorder(FlightRecorder(
+                "sidecar", rule_names=plan.rule_names))
+            self._attribution = RuleAttribution(plan.rule_names,
+                                                plane="sidecar")
+            self.parity = ParityAuditor(plan, self.lists,
+                                        plane="sidecar",
+                                        recorder=self.flight_recorder)
+
+    def request_swap(self, plan, lists=None, tenant: str = "default",
+                     state: Optional[dict] = None):
+        """Thread-safe ruleset hot-swap request.
+
+        Builds the new plan's engine state HERE — the caller's thread,
+        off the drain loop (pair with compiler/cache's
+        compile_ruleset_cached or engine/hotswap.TenantPlanStore for
+        compile-ahead) — then queues a SwapHandle the drain loop flips
+        to at its next batch boundary: in-flight batches finish on the
+        old plan, admissions after the flip use the new one, and every
+        verdict belongs to exactly one epoch. `handle.wait()` blocks
+        until the flip; the loop must be running (a request made after
+        shutdown resolves "rejected" at the final flush)."""
+        from .engine.hotswap import SwapHandle, note_swap
+
+        if state is None:
+            try:
+                state = self._build_plan_state(plan)
+            except Exception as exc:
+                note_swap("sidecar", tenant, "rejected")
+                raise RuntimeError(
+                    f"hot-swap build failed for tenant {tenant!r}: "
+                    f"{exc}") from exc
+        handle = SwapHandle(plan=plan, tenant=tenant, lists=lists,
+                            state=state)
+        with self._swap_lock:
+            self._swap_queue.append(handle)
+        return handle
+
+    def _apply_swaps(self, inflight, pend_parts, pend_n,
+                     oldest_enq_ms, pend_buf):
+        """Apply every queued hot-swap at this batch boundary: launch
+        and complete everything ADMITTED on the old plan first (each
+        ticket posts exactly once, on the plan of its admission epoch —
+        zero dropped, zero double-posted), then flip to the prebuilt
+        state. The pause clock covers drain+flip only; the requester
+        compiled ahead on its own thread (engine/hotswap.py)."""
+        from .engine.hotswap import note_swap, set_epoch_gauge
+
+        t0 = time.monotonic()
+        with self._hb_busy():
+            if pend_parts:
+                inflight.append(self._dispatch(pend_parts, pend_n,
+                                               oldest_enq_ms,
+                                               slot_buf=pend_buf))
+                pend_parts, pend_n, oldest_enq_ms = [], 0, None
+                pend_buf = self._take_slot_buf() if self._zero_copy \
+                    else None
+            while inflight:
+                self._complete(*inflight.popleft())
+            while True:
+                with self._swap_lock:
+                    if not self._swap_queue:
+                        break
+                    handle = self._swap_queue.pop(0)
+                try:
+                    self._adopt_plan_state(handle.plan, handle.lists,
+                                           handle.state)
+                except Exception as exc:  # never kill the drain loop
+                    note_swap("sidecar", handle.tenant, "rejected")
+                    handle.resolve(self.ruleset_epoch, 0.0,
+                                   result="rejected", error=exc)
+                    continue
+                self.ruleset_epoch += 1
+                self.tenant = handle.tenant
+                pause_ms = (time.monotonic() - t0) * 1e3
+                set_epoch_gauge("sidecar", self.ruleset_epoch)
+                note_swap("sidecar", handle.tenant, "ok")
+                self._stage["sched"].observe(pause_ms)
+                self.swap_pauses_ms.append(pause_ms)
+                handle.resolve(self.ruleset_epoch, pause_ms)
+        return pend_parts, pend_n, oldest_enq_ms, pend_buf
+
     def run(self, max_requests: Optional[int] = None) -> int:
         """Blocking drain loop; returns requests processed.
 
@@ -883,6 +1002,17 @@ class RingSidecar:
             if not self.chaos.heartbeat_frozen():
                 for r in self.rings:
                     r.heartbeat()
+            # Ruleset hot-swap boundary (ISSUE 11). The swap-storm
+            # chaos rung re-requests the CURRENT plan so any verdict
+            # drift it produces is a swap-protocol bug by construction
+            # (state reused: the storm isolates drain/flip mechanics).
+            if self.chaos.swap_due(self.batches):
+                self.request_swap(self.plan, tenant=self.tenant,
+                                  state=self._plan_state)
+            if self._swap_queue:
+                pend_parts, pend_n, oldest_enq_ms, pend_buf = \
+                    self._apply_swaps(inflight, pend_parts, pend_n,
+                                      oldest_enq_ms, pend_buf)
             # One merged dequeue pass across all worker rings. The
             # start index rotates so a saturated ring cannot monopolize
             # the budget and starve its siblings into the data plane's
@@ -956,6 +1086,18 @@ class RingSidecar:
             self._slot_pool.append(pend_buf)
         while inflight:
             self._complete(*inflight.popleft())
+        # A swap that never reached a batch boundary before shutdown is
+        # rejected, not leaked: wake its requester.
+        with self._swap_lock:
+            leftovers, self._swap_queue = self._swap_queue, []
+        if leftovers:
+            from .engine.hotswap import note_swap
+
+            for handle in leftovers:
+                note_swap("sidecar", handle.tenant, "rejected")
+                handle.resolve(self.ruleset_epoch, 0.0,
+                               result="rejected",
+                               error=RuntimeError("sidecar stopped"))
         return self.processed
 
     def _take_slot_buf(self) -> np.ndarray:

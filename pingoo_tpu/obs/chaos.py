@@ -26,6 +26,12 @@ Spec grammar — comma-separated faults, each ``name[:arg[:arg]]``::
                     degradation ladder's device rung.
   verdict_full:N    report the verdict ring full for the next N post
                     attempts — exercises the post-retry loop.
+  swap_storm[:N]    request a same-plan ruleset hot-swap every N
+                    completed batches (default 5) — hammers the
+                    epoch-switch drain/flip boundary under live load
+                    (ISSUE 11); same plan, so any verdict drift the
+                    storm produces is a swap-protocol bug by
+                    construction.
 
 Every injected fault increments
 ``pingoo_chaos_injected_total{fault=}`` so a chaos run's metrics
@@ -63,6 +69,8 @@ class ChaosInjector:
         self.stalls: dict[str, float] = {}   # stage -> ms
         self.xla_error_at: Optional[int] = None
         self.verdict_full_budget = 0
+        self.swap_every: Optional[int] = None
+        self._last_swap_batch = 0
         self._fired: set[str] = set()
         self._counters: dict[str, object] = {}
         if not self.active:
@@ -87,6 +95,10 @@ class ChaosInjector:
                     self.xla_error_at = int(args[0]) if args else 1
                 elif name == "verdict_full":
                     self.verdict_full_budget = int(args[0])
+                elif name == "swap_storm":
+                    self.swap_every = int(args[0]) if args else 5
+                    if self.swap_every < 1:
+                        raise ValueError(part)
                 else:
                     raise ValueError(name)
             except (IndexError, ValueError):
@@ -147,6 +159,18 @@ class ChaosInjector:
         if ms:
             self._count(f"stall_{stage}")
             time.sleep(ms / 1e3)
+
+    def swap_due(self, batches: int) -> bool:
+        """At the drain-loop top: True = the storm wants a hot-swap at
+        this batch boundary. Fires at most once per completed-batch
+        count (the loop passes the same count many times)."""
+        if not self.active or not self.swap_every or batches <= 0:
+            return False
+        if batches == self._last_swap_batch or batches % self.swap_every:
+            return False
+        self._last_swap_batch = batches
+        self._count("swap_storm")
+        return True
 
     def verdict_full(self) -> bool:
         """Before a verdict post attempt: True = pretend the ring is

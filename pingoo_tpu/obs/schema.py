@@ -214,6 +214,28 @@ RESILIENCE_METRICS = {
         "(obs/chaos.py; absent in production)",
 }
 
+# Ruleset hot-swap + differential-fuzzer metrics (ISSUE 11,
+# docs/RESILIENCE.md Hot-swap section / docs/FUZZING.md). The epoch
+# gauge and swap counter are exported by every plane that runs the
+# batched verdict engine (plane="python" listener service,
+# plane="sidecar" ring drainer): the epoch is the count of plan swaps
+# this plane has applied (0 = the boot plan; every verdict is
+# attributable to exactly one epoch), and the swap counter carries
+# {tenant, result} labels (result: ok | rejected). The fuzz counter is
+# emitted by the differential fuzzer (tools/analyze/fuzz.py) when a
+# run's registry is scraped — absent in production serving.
+HOTSWAP_METRICS = {
+    "pingoo_ruleset_epoch":
+        "ruleset plan epoch on this plane (bumps once per applied "
+        "hot-swap; in-flight batches always finish on their epoch)",
+    "pingoo_ruleset_swap_total":
+        "ruleset hot-swap attempts by {tenant, result} (ok = flipped "
+        "at a batch boundary, rejected = build/validation failed)",
+    "pingoo_fuzz_discrepancy_total":
+        "differential-fuzzer parse discrepancies by class (not a "
+        "documented known-delta; tools/analyze/fuzz.py)",
+}
+
 # Native-plane-only counters (httpd.cc Stats), exported with
 # plane="native" under these names.
 NATIVE_METRICS = {
@@ -249,4 +271,5 @@ def all_metric_names() -> set[str]:
             | set(PROVENANCE_METRICS)
             | set(PARITY_METRICS) | set(SCHED_METRICS)
             | set(PIPELINE_METRICS) | set(RESILIENCE_METRICS)
+            | set(HOTSWAP_METRICS)
             | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

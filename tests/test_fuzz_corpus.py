@@ -1,0 +1,135 @@
+"""Fuzz-corpus regression replay (ISSUE 11, docs/FUZZING.md).
+
+Every file in tools/analyze/corpus/ is a parser divergence the
+differential fuzzer found (and this PR fixed) — or a deliberate pin of
+a documented delta / limit behavior. `make fuzz` replays them before
+mutating; this suite replays the same pins inside tier-1 so a parser
+change that re-opens one fails fast, with the offending corpus file
+named, even when nobody runs the fuzzer.
+
+Python-plane pins run the listener's one-shot parse oracle
+(host/httpd.py parse_request_bytes) directly; native pins drive the
+real httpd binary through the fuzzer's loopback harness.
+"""
+
+import base64
+
+import pytest
+
+from pingoo_tpu import native_ring
+from tools.analyze import fuzz
+
+
+def _has_jax():
+    try:
+        import jax  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+needs_jax = pytest.mark.skipif(not _has_jax(), reason="jax unavailable")
+needs_native = pytest.mark.skipif(not native_ring.ensure_built(),
+                                  reason="native toolchain unavailable")
+
+CASES = fuzz.load_corpus()
+# Refusal pins (reject-*/drop) never reach the rules, so they need no
+# interpreter; allow/block pins do.
+PY_REFUSE = [c for c in CASES if fuzz._is_refusal(c["python"])
+             or c["python"] == "drop"]
+PY_VERDICT = [c for c in CASES if c not in PY_REFUSE]
+NATIVE = [c for c in CASES if c.get("native")]
+
+
+def _ids(cases):
+    return [c["_file"] for c in cases]
+
+
+def test_corpus_is_committed_and_well_formed():
+    assert len(CASES) >= 15, "corpus went missing — fuzzer pins gone"
+    for case in CASES:
+        assert case["python"] in {"reject-400", "reject-413",
+                                  "reject-431", "drop", "allow",
+                                  "block"}, case["_file"]
+        assert base64.b64decode(case["raw_b64"]), case["_file"]
+        assert case.get("desc"), case["_file"]
+
+
+@pytest.mark.parametrize("case", PY_REFUSE, ids=_ids(PY_REFUSE))
+def test_python_plane_refusal_pins(case):
+    mutant = fuzz.corpus_mutant(case)
+    # plan=None: a refusal classification must never consult the rules;
+    # if the parse unexpectedly accepts, the None plan blows up — which
+    # IS the regression this pin exists to catch.
+    got, _ = fuzz.classify_python(mutant.raw, None)
+    assert got == case["python"], \
+        f"{case['_file']}: {case['desc']} (got {got})"
+
+
+@needs_jax
+@pytest.mark.parametrize("case", PY_VERDICT, ids=_ids(PY_VERDICT))
+def test_python_plane_verdict_pins(case):
+    got, _ = fuzz.classify_python(fuzz.corpus_mutant(case).raw,
+                                  fuzz._fuzz_plan())
+    assert got == case["python"], \
+        f"{case['_file']}: {case['desc']} (got {got})"
+
+
+@needs_native
+@needs_jax
+class TestNativePins:
+    @pytest.fixture(scope="class")
+    def harness(self, tmp_path_factory):
+        h = fuzz.NativeHarness(
+            fuzz._fuzz_plan(),
+            str(tmp_path_factory.mktemp("fuzz_corpus")))
+        yield h
+        h.close()
+
+    @pytest.mark.parametrize("case", NATIVE, ids=_ids(NATIVE))
+    def test_native_plane_pins(self, harness, case):
+        got, _ = harness.roundtrip(fuzz.corpus_mutant(case))
+        assert got == case["native"], \
+            f"{case['_file']}: {case['desc']} (got {got})"
+
+    def test_full_replay_matches_make_fuzz(self, harness):
+        """The exact check `make fuzz` runs first — zero regressions."""
+        assert fuzz.replay_corpus(fuzz._fuzz_plan(), harness) == []
+
+
+class TestLimitKnobs:
+    """PINGOO_MAX_HEADER_BYTES / PINGOO_MAX_BODY_BYTES parsing: both
+    planes read the same env contract (431 head / eager 413 body pins
+    themselves live in the corpus above)."""
+
+    def test_int_env_floor_and_fallback(self, monkeypatch):
+        from pingoo_tpu.host.httpd import _int_env
+
+        monkeypatch.setenv("PINGOO_T", "1024")
+        assert _int_env("PINGOO_T", 99, 256) == 1024
+        # Below the floor -> fall back to the default, same as the
+        # native plane's "out of range; using default" path.
+        monkeypatch.setenv("PINGOO_T", "12")
+        assert _int_env("PINGOO_T", 99, 256) == 99
+        monkeypatch.setenv("PINGOO_T", "zebra")
+        assert _int_env("PINGOO_T", 99, 1) == 99
+        monkeypatch.delenv("PINGOO_T")
+        assert _int_env("PINGOO_T", 99, 1) == 99
+
+    def test_defaults_match_native_plane(self):
+        """The committed defaults must stay equal on both planes —
+        httpd.cc kMaxReqHead/kMaxBodyBytes read the same knobs."""
+        from pingoo_tpu.host import httpd
+
+        assert httpd.MAX_HEADER_BYTES == 32 * 1024
+        assert httpd.MAX_BODY_BYTES == 16 * 1024 * 1024
+        import os
+        import re
+        src = open(os.path.join(
+            os.path.dirname(httpd.__file__), "..", "native",
+            "httpd.cc")).read()
+        # Head cap falls back to kMaxHead; body cap to an inline 16MiB.
+        assert re.search(r"kMaxHead\s*=\s*32\s*\*\s*1024", src)
+        assert re.search(r"def\s*=\s*16LL\s*\*\s*1024\s*\*\s*1024", src)
+        assert 'getenv("PINGOO_MAX_HEADER_BYTES")' in src
+        assert 'getenv("PINGOO_MAX_BODY_BYTES")' in src

@@ -1,0 +1,436 @@
+"""Epoch-switched ruleset hot-swap (ISSUE 11, docs/RESILIENCE.md).
+
+Contract under test, on both engine planes: a new RulesetPlan compiled
+AHEAD of the switch flips in atomically at a batch boundary — verdicts
+admitted before the flip are bit-exact under the OLD plan, verdicts
+admitted after are bit-exact under the NEW one, and no ticket is
+dropped or double-posted across the boundary. The subprocess/storm end
+of this lives in tools/chaos_smoke.py (PINGOO_CHAOS=swap_storm); here
+the same protocol is driven in-process so tier-1 stays fast.
+"""
+
+import threading
+import time
+
+import pytest
+
+from pingoo_tpu import native_ring
+from pingoo_tpu.compiler import compile_ruleset
+from pingoo_tpu.config.schema import Action, RuleConfig
+from pingoo_tpu.engine.hotswap import TenantPlanStore
+from pingoo_tpu.expr import compile_expression
+
+
+def _has_jax():
+    try:
+        import jax  # noqa: F401
+        return True
+    except Exception:
+        return False
+
+
+needs_jax = pytest.mark.skipif(not _has_jax(), reason="jax unavailable")
+needs_native = pytest.mark.skipif(not native_ring.ensure_built(),
+                                  reason="native toolchain unavailable")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in ("PINGOO_CHAOS", "PINGOO_DFA", "PINGOO_MESH",
+                "PINGOO_SCHED_MODE", "PINGOO_PARITY_SAMPLE",
+                "PINGOO_PIPELINE", "PINGOO_PIPELINE_DEPTH"):
+        monkeypatch.delenv(var, raising=False)
+
+
+def _plan(prefix: str, extra_rules: int = 0):
+    """Plan that blocks path.starts_with(prefix); `extra_rules` pads
+    with never-matching rules so the two epochs' plans differ in shape,
+    not just content (table re-layout is part of the flip)."""
+    rules = [RuleConfig(
+        name=f"block-{prefix.strip('/')}", actions=(Action.BLOCK,),
+        expression=compile_expression(
+            f'http_request.path.starts_with("{prefix}")'))]
+    for i in range(extra_rules):
+        rules.append(RuleConfig(
+            name=f"pad{i}", actions=(Action.BLOCK,),
+            expression=compile_expression(
+                f'http_request.path.starts_with("/never/{i}/")')))
+    return compile_ruleset(rules, {})
+
+
+def _want(path: str, epoch: int) -> int:
+    """Expected action lane for `path` under the plan of `epoch`:
+    epoch 0 serves the /alpha plan, every later epoch the /beta plan."""
+    blocked = "/alpha" if epoch == 0 else "/beta"
+    return 1 if path.startswith(blocked) else 0
+
+
+# -- python plane: VerdictService.swap_plan -------------------------------
+
+
+@needs_jax
+class TestServiceSwap:
+    def test_swap_flips_epoch_and_actions(self, loop_runner):
+        from pingoo_tpu.engine.batch import RequestTuple
+        from pingoo_tpu.engine.service import VerdictService
+
+        async def go():
+            service = VerdictService(_plan("/alpha"), {},
+                                     use_device=True)
+            await service.start()
+            try:
+                async def ask(path):
+                    return await service.evaluate(RequestTuple(
+                        path=path, url=path, user_agent="x"))
+
+                va = await ask("/alpha/1")
+                vb = await ask("/beta/1")
+                assert (va.epoch, va.action) == (0, 1)
+                assert (vb.epoch, vb.action) == (0, 0)
+
+                res = await service.swap_plan(_plan("/beta", 3))
+                assert res["epoch"] == 1
+                assert res["tenant"] == "default"
+                assert res["pause_ms"] >= 0
+                assert service.ruleset_epoch == 1
+
+                va = await ask("/alpha/2")
+                vb = await ask("/beta/2")
+                assert (va.epoch, va.action) == (1, 0)
+                assert (vb.epoch, vb.action) == (1, 1)
+            finally:
+                await service.stop()
+
+        loop_runner.run(go())
+
+    def test_concurrent_evaluates_bit_exact_per_epoch(self, loop_runner):
+        """Race a swap against a stream of in-flight evaluates: every
+        verdict must carry an epoch, and its action must be exactly
+        what THAT epoch's plan says for that path — the per-epoch
+        attribution contract (Verdict.epoch)."""
+        import asyncio
+
+        from pingoo_tpu.engine.batch import RequestTuple
+        from pingoo_tpu.engine.service import VerdictService
+
+        paths = [("/alpha/%d" if i % 2 else "/beta/%d") % i
+                 for i in range(48)]
+
+        async def go():
+            service = VerdictService(_plan("/alpha"), {},
+                                     use_device=True, max_batch=8)
+            await service.start()
+            try:
+                async def ask(path):
+                    v = await service.evaluate(RequestTuple(
+                        path=path, url=path, user_agent="x"))
+                    return path, v
+
+                # First wave is in flight (queued, batching, some on
+                # device) when the swap sentinel joins the queue — the
+                # flip has to drain them on the OLD plan.
+                first = [asyncio.ensure_future(ask(p))
+                         for p in paths[:24]]
+                res = await service.swap_plan(_plan("/beta", 3))
+                assert res["epoch"] == 1
+                rest = [asyncio.ensure_future(ask(p))
+                        for p in paths[24:]]
+                results = await asyncio.gather(*first, *rest)
+                epochs = set()
+                for path, v in results:
+                    assert v.epoch in (0, 1)
+                    assert not v.degraded
+                    assert v.action == _want(path, v.epoch), \
+                        (path, v.epoch, v.action)
+                    epochs.add(v.epoch)
+                # The flip happened mid-stream: the wave admitted
+                # before the sentinel rode epoch 0, the tail epoch 1.
+                assert epochs == {0, 1}
+            finally:
+                await service.stop()
+
+        loop_runner.run(go())
+
+    def test_swap_exports_epoch_gauge_and_counter(self, loop_runner):
+        from pingoo_tpu.engine.service import VerdictService
+        from pingoo_tpu.obs import REGISTRY
+        from pingoo_tpu.obs.schema import HOTSWAP_METRICS
+
+        async def go():
+            service = VerdictService(_plan("/alpha"), {},
+                                     use_device=True)
+            await service.start()
+            try:
+                await service.swap_plan(_plan("/beta"), tenant="acme")
+            finally:
+                await service.stop()
+
+        loop_runner.run(go())
+        gauge = REGISTRY.gauge(
+            "pingoo_ruleset_epoch",
+            HOTSWAP_METRICS["pingoo_ruleset_epoch"],
+            labels={"plane": "python"})
+        assert gauge.value >= 1
+        counter = REGISTRY.counter(
+            "pingoo_ruleset_swap_total",
+            HOTSWAP_METRICS["pingoo_ruleset_swap_total"],
+            labels={"plane": "python", "tenant": "acme",
+                    "result": "ok"})
+        assert counter.value >= 1
+
+
+# -- sidecar plane: RingSidecar.request_swap ------------------------------
+
+
+def _enq(ring, i, phase="alpha"):
+    path = (b"/%s/%d" % (phase.encode(), i)) if i % 3 == 0 \
+        else b"/ok/%d" % i
+    return ring.enqueue(method=b"GET", host=b"r.test", path=path,
+                        url=path, user_agent=b"Mozilla/5.0")
+
+
+def _want_ring(i, phase_blocked):
+    return 1 if (i % 3 == 0 and phase_blocked) else 0
+
+
+def _poll_all(ring, need, timeout=120.0):
+    got: dict = {}
+    count = 0
+    deadline = time.monotonic() + timeout
+    while count < need and time.monotonic() < deadline:
+        v = ring.poll_verdict()
+        if v is None:
+            time.sleep(0.002)
+            continue
+        t, a, _ = v
+        got.setdefault(t, []).append(a)
+        count += 1
+    grace = time.monotonic() + 0.2
+    while time.monotonic() < grace:
+        v = ring.poll_verdict()
+        if v is None:
+            time.sleep(0.01)
+            continue
+        t, a, _ = v
+        got.setdefault(t, []).append(a)
+    return got
+
+
+@needs_native
+@needs_jax
+class TestSidecarSwap:
+    def test_swap_changes_ruleset_bit_exact_per_epoch(self, tmp_path):
+        """Phase A tickets verdict under the /alpha plan, the swap
+        lands, phase B tickets verdict under the /beta plan — zero
+        lost, zero doubled, and each phase bit-exact under ITS plan
+        (the storm smoke swaps identical plans; this is the stronger
+        cross-plan version)."""
+        from pingoo_tpu.native_ring import Ring, RingSidecar
+
+        ring = Ring(str(tmp_path / "ring"), capacity=256, create=True)
+        sidecar = RingSidecar(ring, _plan("/alpha"), {}, max_batch=16)
+        n = 24
+        try:
+            worker = threading.Thread(target=sidecar.run, daemon=True)
+            worker.start()
+            for i in range(n):
+                assert _enq(ring, i, "alpha") is not None
+            got_a = _poll_all(ring, n)
+
+            handle = sidecar.request_swap(_plan("/beta", 3))
+            assert handle.wait(120) and handle.result == "ok"
+            assert handle.epoch == sidecar.ruleset_epoch >= 1
+            assert handle.pause_ms >= 0
+
+            for i in range(n, 2 * n):
+                assert _enq(ring, i, "beta") is not None
+            got_b = _poll_all(ring, n)
+            sidecar.stop()
+            worker.join(30)
+            assert not worker.is_alive()
+
+            assert sorted(got_a) == list(range(n))
+            assert sorted(got_b) == list(range(n, 2 * n))
+            for got in (got_a, got_b):
+                assert all(len(a) == 1 for a in got.values())
+            # Epoch 0: /alpha blocked, /beta not; epoch >=1: inverse.
+            for i in range(n):
+                assert got_a[i][0] & 3 == _want_ring(i, True), i
+            for i in range(n, 2 * n):
+                assert got_b[i][0] & 3 == _want_ring(i, True), i
+            # swap pause recorded for bench_regress's p99 track.
+            assert len(sidecar.swap_pauses_ms) == sidecar.ruleset_epoch
+        finally:
+            sidecar.stop()
+            ring.close()
+
+    def test_swap_under_parity_sampling(self, tmp_path, monkeypatch):
+        """Swap storm with the ParityAuditor sampling 100% of batches:
+        the interpreter shadow-checks every device verdict across the
+        flip, so a half-installed table would surface as a parity
+        mismatch, not just a wrong bit."""
+        monkeypatch.setenv("PINGOO_PARITY_SAMPLE", "1")
+        from pingoo_tpu.native_ring import Ring, RingSidecar
+
+        plan = _plan("/alpha")
+        ring = Ring(str(tmp_path / "ring"), capacity=256, create=True)
+        sidecar = RingSidecar(ring, plan, {}, max_batch=8)
+        n = 48
+        try:
+            worker = threading.Thread(target=sidecar.run, daemon=True)
+            worker.start()
+            handles = []
+            for i in range(n):
+                assert _enq(ring, i, "alpha") is not None
+                if i and i % 12 == 0:
+                    # Same compiled plan each swap: any verdict drift
+                    # across the flips is a swap-protocol bug.
+                    handles.append(sidecar.request_swap(plan))
+                time.sleep(0.001)
+            got = _poll_all(ring, n)
+            for h in handles:
+                assert h.wait(120) and h.result == "ok", h.result
+            sidecar.stop()
+            worker.join(30)
+            assert not worker.is_alive()
+
+            assert sorted(got) == list(range(n))
+            assert all(len(a) == 1 for a in got.values())
+            for i in range(n):
+                assert got[i][0] & 3 == _want_ring(i, True), i
+            assert sidecar.ruleset_epoch >= len(handles)
+            assert sidecar.parity is not None
+            assert sidecar.parity.flush(60)
+            assert sidecar.parity.mismatch_total.value == 0
+        finally:
+            sidecar.stop()
+            ring.close()
+
+    def test_swap_while_ladder_demoted(self, tmp_path, monkeypatch):
+        """A swap landing while the degradation ladder is serving a
+        fallback rung must still apply cleanly, and the demoted rung
+        must serve the NEW plan bit-exactly (docs/RESILIENCE.md: the
+        ladder degrades the execution tier, never the ruleset)."""
+        from pingoo_tpu.native_ring import Ring, RingSidecar
+
+        monkeypatch.setenv("PINGOO_CHAOS", "xla_error:1")
+        ring = Ring(str(tmp_path / "ring"), capacity=256, create=True)
+        sidecar = RingSidecar(ring, _plan("/alpha"), {}, max_batch=16)
+        monkeypatch.delenv("PINGOO_CHAOS")
+        n = 16
+        try:
+            for i in range(n):
+                assert _enq(ring, i, "alpha") is not None
+            t = threading.Thread(target=sidecar.run,
+                                 kwargs={"max_requests": n},
+                                 daemon=True)
+            t.start()
+            got = _poll_all(ring, n)
+            t.join(60)
+            assert not t.is_alive()
+            assert sidecar.ladder.demoted(), \
+                "chaos fault did not demote — test premise broken"
+            assert sorted(got) == list(range(n))
+
+            handle = sidecar.request_swap(_plan("/beta", 3))
+            for i in range(n, 2 * n):
+                assert _enq(ring, i, "beta") is not None
+            t = threading.Thread(target=sidecar.run,
+                                 kwargs={"max_requests": n},
+                                 daemon=True)
+            t.start()
+            got2 = _poll_all(ring, n)
+            t.join(60)
+            assert not t.is_alive()
+            assert handle.wait(1) and handle.result == "ok"
+            assert sorted(got2) == list(range(n, 2 * n))
+            assert all(len(a) == 1 for a in got2.values())
+            for i in range(n, 2 * n):
+                assert got2[i][0] & 3 == _want_ring(i, True), i
+        finally:
+            sidecar.stop()
+            ring.close()
+
+
+# -- multi-tenant compile-ahead store -------------------------------------
+
+
+class TestTenantPlanStore:
+    def _rules(self, tenant: str, n: int = 2):
+        return [RuleConfig(
+            name=f"{tenant}-r{i}", actions=(Action.BLOCK,),
+            expression=compile_expression(
+                f'http_request.path.starts_with("/{tenant}/{i}/")'))
+            for i in range(n)]
+
+    def test_tenant_scoped_fingerprints(self, tmp_path):
+        """IDENTICAL rules under different tenant keys must cache and
+        fingerprint separately — tenant isolation in the artifact
+        cache (compiler/cache.py)."""
+        store = TenantPlanStore(cache_dir=str(tmp_path))
+        shared = self._rules("shared", 3)
+        tenants = ["acme", "globex", "initech", "umbrella"]
+        entries = {t: store.prepare(t, shared, {}) for t in tenants}
+        fps = {e.fingerprint for e in entries.values()}
+        assert len(fps) == len(tenants)
+        assert store.tenants() == sorted(tenants)
+        assert store.total_rules() == 3 * len(tenants)
+        for t in tenants:
+            assert store.get(t) is entries[t]
+            assert entries[t].plan.rule_names[0] == "shared-r0"
+        assert store.get("nosuch") is None
+
+    def test_failed_prepare_keeps_serving_plan(self, tmp_path):
+        store = TenantPlanStore(cache_dir=str(tmp_path))
+        good = store.prepare("acme", self._rules("acme"), {})
+        with pytest.raises(Exception):
+            store.prepare("acme", [object()], {})
+        assert store.get("acme") is good
+
+    def test_multi_tenant_scale_2k_rules(self, tmp_path):
+        """ISSUE 11 floor: >=4 tenants, 2k+ rules total, every tenant's
+        plan independently compiled/fingerprinted and swappable."""
+        store = TenantPlanStore(cache_dir=str(tmp_path))
+        tenants = ["acme", "globex", "initech", "umbrella"]
+        for t in tenants:
+            store.prepare(t, self._rules(t, 512), {})
+        assert store.total_rules() == 2048
+        assert len({store.get(t).fingerprint for t in tenants}) == 4
+        # Re-prepare hits the tenant-scoped cache: same fingerprint,
+        # fresh entry (the store always reflects the LAST good push).
+        fp0 = store.get("acme").fingerprint
+        again = store.prepare("acme", self._rules("acme", 512), {})
+        assert again.fingerprint == fp0
+        assert store.get("acme") is again
+
+    @needs_jax
+    def test_prepared_tenant_plan_swaps_into_service(self, tmp_path,
+                                                     loop_runner):
+        """End-to-end: store.prepare -> swap_plan, per-tenant epochs."""
+        from pingoo_tpu.engine.batch import RequestTuple
+        from pingoo_tpu.engine.service import VerdictService
+
+        store = TenantPlanStore(cache_dir=str(tmp_path))
+        acme = store.prepare("acme", self._rules("acme"), {})
+        globex = store.prepare("globex", self._rules("globex"), {})
+
+        async def go():
+            service = VerdictService(acme.plan, acme.lists,
+                                     use_device=True)
+            await service.start()
+            try:
+                res = await service.swap_plan(
+                    globex.plan, lists=globex.lists, tenant="globex")
+                assert res["tenant"] == "globex"
+                assert service.tenant == "globex"
+                v = await service.evaluate(RequestTuple(
+                    path="/globex/0/x", url="/globex/0/x",
+                    user_agent="x"))
+                assert v.action == 1 and v.epoch == res["epoch"]
+                v = await service.evaluate(RequestTuple(
+                    path="/acme/0/x", url="/acme/0/x", user_agent="x"))
+                assert v.action == 0
+            finally:
+                await service.stop()
+
+        loop_runner.run(go())

@@ -5,6 +5,7 @@
     python -m tools.analyze lint [files…]  # JAX hot-path linter
     python -m tools.analyze tidy           # clang-tidy vs baseline
     python -m tools.analyze tsan           # ring_stress concurrency gate
+    python -m tools.analyze fuzz           # differential parsing fuzzer
 
 Passes are offline-safe; missing toolchains (C++ compiler, clang-tidy,
 TSAN runtime) downgrade the affected pass to skip-with-warning.
@@ -27,10 +28,16 @@ def main(argv=None) -> int:
                         help="files to lint (default: configured dirs)")
     sub.add_parser("tidy", help="clang-tidy (bugprone/concurrency)")
     sub.add_parser("tsan", help="ring_stress thread-sanitizer gate")
+    p_fuzz = sub.add_parser(
+        "fuzz", help="differential HTTP-parsing fuzzer (ISSUE 11)")
+    p_fuzz.add_argument("--mutants", type=int, default=None)
+    p_fuzz.add_argument("--seed", type=int, default=None)
+    p_fuzz.add_argument("--corpus-only", action="store_true")
+    p_fuzz.add_argument("--no-native", action="store_true")
     sub.add_parser("all", help="run every pass")
     args = parser.parse_args(argv)
 
-    from . import abi, lint, native
+    from . import abi, fuzz, lint, native
 
     if args.cmd == "abi":
         return abi.run(regen=args.regen)
@@ -40,11 +47,20 @@ def main(argv=None) -> int:
         return native.run_tidy()
     if args.cmd == "tsan":
         return native.run_tsan()
+    if args.cmd == "fuzz":
+        kwargs = {}
+        if args.mutants is not None:
+            kwargs["mutants"] = args.mutants
+        if args.seed is not None:
+            kwargs["seed"] = args.seed
+        return fuzz.run(corpus_only=args.corpus_only,
+                        no_native=args.no_native, **kwargs)
     rc = 0
     rc |= abi.run()
     rc |= lint.run()
     rc |= native.run_tidy()
     rc |= native.run_tsan()
+    rc |= fuzz.run()
     return rc
 
 

@@ -1,0 +1,807 @@
+"""Differential HTTP-parsing fuzzer (`make fuzz`; ISSUE 11 tentpole).
+
+Request smuggling is a PARSING-DISCREPANCY attack: it only works when
+two components of the same deployment read one byte stream as two
+different requests. This plane has three parsers that must agree —
+
+  native   the C++ epoll listener (pingoo_tpu/native/httpd.cc),
+           spawned here on loopback in front of a real verdict ring;
+           the harness consumer dequeues the slots the listener
+           enqueued, which ARE the natively-parsed RequestTuple fields
+           verbatim, and posts back interpreter verdicts over them.
+  python   host/httpd.py's `parse_request_bytes` one-shot oracle: the
+           exact strict gate + h11 parse + `extract_request_fields`
+           the python listener applies to live sockets.
+  interp   engine-side extraction: `tuple_to_context` +
+           `interpret_rules_row` + `action_lanes` over each plane's
+           fields — the verdict bits a request actually earns.
+
+Every mutant is a deterministic seed-driven perturbation of HTTP/1.1
+framing: chunk-size extensions and hex casing, chunk/TCP boundary
+splits mid-token, header folding/duplication/whitespace, percent- and
+double-URL-encoding, path normalization shapes (`..`, `//`, `;`),
+Content-Length vs Transfer-Encoding conflicts, bare-LF line endings.
+A DISCREPANCY is any mutant where (a) one plane evaluates a request
+the other refuses, (b) both evaluate but the extracted RequestTuple
+fields differ, or (c) the verdict bits differ — modulo the documented
+KNOWN_DELTAS table (docs/FUZZING.md). Discrepancies increment
+`pingoo_fuzz_discrepancy_total{class=...}` and fail the run.
+
+Found-and-fixed cases live in tools/analyze/corpus/*.json and replay
+first on every run (and in tests/test_fuzz_corpus.py) as regression
+pins. Offline-safe: no native toolchain downgrades the native path to
+skip-with-warning and the python/interp differential still runs.
+
+    python -m tools.analyze fuzz [--mutants N] [--seed S]
+                                 [--corpus-only] [--no-native]
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import os
+import random
+import select
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(os.path.dirname(HERE))
+CORPUS_DIR = os.path.join(HERE, "corpus")
+DEFAULT_MUTANTS = 5000
+DEFAULT_SEED = 1106  # ISSUE 11; fixed so CI runs are reproducible
+
+if REPO not in sys.path:  # `python tools/analyze/fuzz.py` convenience
+    sys.path.insert(0, REPO)
+
+
+# --------------------------------------------------------------------------
+# mutants
+# --------------------------------------------------------------------------
+
+class Mutant:
+    """One fuzz case: raw bytes + an optional TCP segmentation plan
+    (byte offsets to split the send at — boundary splits mid-token are
+    a mutation class of their own)."""
+
+    __slots__ = ("cls", "raw", "splits", "note")
+
+    def __init__(self, cls: str, raw: bytes, splits=(), note: str = ""):
+        self.cls = cls
+        self.raw = raw
+        self.splits = tuple(splits)
+        self.note = note
+
+    def segments(self):
+        if not self.splits:
+            return [self.raw]
+        out, prev = [], 0
+        for cut in sorted(set(self.splits)):
+            if 0 < cut < len(self.raw):
+                out.append(self.raw[prev:cut])
+                prev = cut
+        out.append(self.raw[prev:])
+        return [s for s in out if s]
+
+
+UAS = [b"Mozilla/5.0", b"curl/8.5", b"sqlmap/1.8", b"pingoo-fuzz"]
+HOSTS = [b"fuzz.test", b"evil.test", b"a.example"]
+PATHS = [b"/", b"/index.html", b"/admin/panel", b"/api/v1/users",
+         b"/static/app.js", b"/search"]
+QUERIES = [b"", b"?q=1", b"?a=b&c=d", b"?x=<script>"]
+
+
+def _head(rng, method=b"GET", path=None, extra=(), ua=None, host=None,
+          version=b"HTTP/1.1"):
+    path = path if path is not None else (
+        rng.choice(PATHS) + rng.choice(QUERIES))
+    lines = [method + b" " + path + b" " + version,
+             b"host: " + (host if host is not None else rng.choice(HOSTS)),
+             b"user-agent: " + (ua if ua is not None else rng.choice(UAS))]
+    lines += list(extra)
+    lines.append(b"connection: close")
+    return b"\r\n".join(lines) + b"\r\n\r\n", path
+
+
+def _chunked(body_chunks, sizeline=None, trailer=b""):
+    out = b""
+    for chunk in body_chunks:
+        size = (b"%x" % len(chunk)) if sizeline is None else sizeline
+        out += size + b"\r\n" + chunk + b"\r\n"
+        sizeline = None  # custom size line applies to the first chunk
+    return out + b"0\r\n" + trailer + b"\r\n"
+
+
+def mut_chunk_ext(rng) -> Mutant:
+    """Chunk-size extensions, hex casing, leading zeros."""
+    chunk = bytes(rng.choice(b"abcdef") for _ in range(rng.randint(1, 30)))
+    size = b"%x" % len(chunk)
+    shape = rng.randrange(4)
+    if shape == 0:
+        size += b";" + rng.choice([b"ext", b"ext=v", b"a=1;b=2", b";"])
+    elif shape == 1:
+        size = (b"%X" % len(chunk))  # uppercase hex
+    elif shape == 2:
+        size = b"0" * rng.randint(1, 4) + size  # leading zeros
+    else:
+        size += b" "  # trailing space before CRLF
+    head, _ = _head(rng, method=b"POST",
+                    extra=[b"transfer-encoding: chunked"])
+    return Mutant("chunk-ext", head + _chunked([chunk], sizeline=size))
+
+
+def mut_chunk_bad(rng) -> Mutant:
+    """Chunk framing both planes must refuse identically."""
+    chunk = b"abc"
+    size = rng.choice([b"0x3", b"3 3", b"g", b"-3", b"+3", b"3\x00"])
+    head, _ = _head(rng, method=b"POST",
+                    extra=[b"transfer-encoding: chunked"])
+    return Mutant("chunk-bad", head + _chunked([chunk], sizeline=size))
+
+
+def mut_chunk_split(rng) -> Mutant:
+    """Valid message, TCP segment boundaries mid-token: the parsers
+    must reassemble identically no matter where the wire splits."""
+    chunks = [bytes(rng.choice(b"xyz") for _ in range(rng.randint(1, 20)))
+              for _ in range(rng.randint(1, 3))]
+    head, _ = _head(rng, method=b"POST",
+                    extra=[b"transfer-encoding: chunked"])
+    raw = head + _chunked(chunks)
+    splits = sorted(rng.sample(range(1, len(raw)),
+                               k=min(rng.randint(1, 4), len(raw) - 1)))
+    return Mutant("chunk-split", raw, splits=splits)
+
+
+def mut_trailer(rng) -> Mutant:
+    trailer = rng.choice([b"x-check: 1\r\n", b"x-a: 1\r\nx-b: 2\r\n"])
+    head, _ = _head(rng, method=b"POST",
+                    extra=[b"transfer-encoding: chunked"])
+    return Mutant("chunk-trailer", head + _chunked([b"data"],
+                                                   trailer=trailer))
+
+
+def mut_header_fold(rng) -> Mutant:
+    """Obsolete line folding — strict gates on both planes reject."""
+    cont = rng.choice([b" folded", b"\tfolded", b"  two  words"])
+    head, _ = _head(rng, extra=[b"x-long: start", cont])
+    return Mutant("header-fold", head)
+
+
+def mut_header_dup(rng) -> Mutant:
+    """Duplicate headers; duplicate Content-Length is the classic
+    smuggling primitive and must 400 on both planes."""
+    shape = rng.randrange(4)
+    if shape == 0:
+        extra = [b"content-length: 3", b"content-length: 3"]
+        body = b"abc"
+    elif shape == 1:
+        extra = [b"content-length: 3", b"content-length: 30"]
+        body = b"abc"
+    elif shape == 2:
+        extra = [b"x-dup: one", b"x-dup: two"]
+        body = b""
+    else:
+        extra = [b"host: second.test"]  # second Host on top of _head's
+        body = b""
+    head, _ = _head(rng, method=b"POST" if body else b"GET", extra=extra)
+    return Mutant("header-dup", head + body)
+
+
+def mut_header_ws(rng) -> Mutant:
+    shape = rng.randrange(3)
+    if shape == 0:
+        extra = [b"x-pad : v"]  # whitespace before colon -> 400
+    elif shape == 1:
+        extra = [b"x-pad:    spaced out   "]  # OWS around value: legal
+    else:
+        extra = [b"x-pad:\tv"]  # tab OWS: legal
+    head, _ = _head(rng, extra=extra)
+    return Mutant("header-ws", head)
+
+
+def mut_pct_encode(rng) -> Mutant:
+    """Percent/double encoding in the target: neither plane decodes, so
+    the extracted url/path bytes must be identical on both."""
+    core = rng.choice([b"%2e%2e%2f", b"%252e%252e", b"%2E%2E/", b"%c0%af",
+                       b"%00", b"%zz", b"%"])
+    path = b"/files/" + core + b"etc/passwd"
+    head, _ = _head(rng, path=path)
+    return Mutant("pct-encode", head)
+
+
+def mut_path_norm(rng) -> Mutant:
+    """Dot-segment / slash shapes that a normalizing parser would
+    collapse — these planes must both pass them through raw."""
+    path = rng.choice([b"/a/../b", b"/a/./b", b"//double//slash",
+                       b"/a;param=1/b", b"/a/..", b"/.", b"/a\\b",
+                       b"/%2e/secret", b"/a//../../b"])
+    head, _ = _head(rng, path=path)
+    return Mutant("path-norm", head)
+
+
+def mut_cl_te(rng) -> Mutant:
+    """Content-Length vs Transfer-Encoding conflicts (smuggling's
+    bread and butter) and malformed CL values."""
+    shape = rng.randrange(6)
+    if shape == 0:
+        extra = [b"content-length: 3", b"transfer-encoding: chunked"]
+        body = _chunked([b"abc"])
+    elif shape == 1:
+        extra = [b"transfer-encoding: chunked", b"content-length: 3"]
+        body = _chunked([b"abc"])
+    elif shape == 2:
+        extra = [b"transfer-encoding: gzip"]
+        body = b""
+    elif shape == 3:
+        extra = [b"content-length: +3"]
+        body = b"abc"
+    elif shape == 4:
+        extra = [b"content-length: 3, 3"]
+        body = b"abc"
+    else:
+        extra = [b"content-length:  3  "]  # OWS-padded value: legal
+        body = b"abc"
+    head, _ = _head(rng, method=b"POST", extra=extra)
+    return Mutant("cl-te", head + body)
+
+
+def mut_bare_lf(rng) -> Mutant:
+    head, _ = _head(rng)
+    if rng.randrange(2):
+        raw = head.replace(b"\r\n", b"\n")  # all-LF head
+    else:  # one LF line amid CRLF
+        lines = head.split(b"\r\n")
+        i = rng.randrange(1, max(2, len(lines) - 2))
+        raw = b"\r\n".join(lines[:i]) + b"\r\n" + lines[i] + b"\n" + \
+            b"\r\n".join(lines[i + 1:])
+    return Mutant("bare-lf", raw)
+
+
+def mut_reqline(rng) -> Mutant:
+    """Request-line shapes: method casing, versions, junk."""
+    shape = rng.randrange(5)
+    if shape == 0:
+        head, _ = _head(rng, method=b"get")
+    elif shape == 1:
+        head, _ = _head(rng, version=b"HTTP/1.0")
+    elif shape == 2:
+        head, _ = _head(rng, version=b"HTTP/2.7")
+    elif shape == 3:
+        head, _ = _head(rng, method=b"DELETE")
+    else:
+        head = b"NONSENSE\r\n\r\n"
+    return Mutant("reqline", head)
+
+
+def mut_head_split(rng) -> Mutant:
+    """Valid request, TCP boundaries inside the head (mid header name,
+    mid CRLF) — reassembly must not change what is extracted."""
+    head, _ = _head(rng, method=b"POST", extra=[b"content-length: 4"])
+    raw = head + b"body"
+    splits = sorted(rng.sample(range(1, len(raw)),
+                               k=min(rng.randint(1, 5), len(raw) - 1)))
+    return Mutant("head-split", raw, splits=splits)
+
+
+def mut_ua_edge(rng) -> Mutant:
+    """UA edge shapes around the 256-byte extraction cap and the
+    empty-UA 403 — the caps must agree bit-exactly."""
+    shape = rng.randrange(4)
+    if shape == 0:
+        ua = b""
+    elif shape == 1:
+        ua = b"a" * rng.choice([254, 255, 256, 257])
+    elif shape == 2:
+        ua = b"  padded  "
+    else:
+        head, _ = _head(rng)  # drop the UA header entirely
+        return Mutant("ua-edge",
+                      head.replace(b"user-agent: ", b"x-was-ua: ", 1))
+    head, _ = _head(rng, ua=ua)
+    return Mutant("ua-edge", head)
+
+
+MUTATORS = [mut_chunk_ext, mut_chunk_bad, mut_chunk_split, mut_trailer,
+            mut_header_fold, mut_header_dup, mut_header_ws,
+            mut_pct_encode, mut_path_norm, mut_cl_te, mut_bare_lf,
+            mut_reqline, mut_head_split, mut_ua_edge]
+
+
+def generate(n: int, seed: int):
+    rng = random.Random(seed)
+    return [MUTATORS[i % len(MUTATORS)](rng) for i in range(n)]
+
+
+# --------------------------------------------------------------------------
+# known deltas — every entry here is documented in docs/FUZZING.md
+# --------------------------------------------------------------------------
+
+def _delta_lf_drop(mutant, native_cls, python_cls):
+    """LF-only heads: the native head scanner is CRLF-terminated, so a
+    bare-LF head never completes and the connection drops on EOF with
+    no status; the python gate answers 400. Both REFUSE the bytes."""
+    return python_cls == "reject-400" and native_cls == "drop" and \
+        b"\r\n\r\n" not in mutant.raw
+
+
+def _python_head_ok(raw: bytes) -> bool:
+    """True when the python plane accepts the HEAD (the reject, if any,
+    was earned by the body). No EOF is fed: head-only acceptance is the
+    question, not whether the body ever completes."""
+    import h11
+
+    from pingoo_tpu.host.httpd import (MAX_HEADER_BYTES, _HEAD_END_RE,
+                                       strict_head_violation)
+
+    m = _HEAD_END_RE.search(raw)
+    if m is None or m.end() > MAX_HEADER_BYTES:
+        return False
+    head = raw[:m.end()]
+    if strict_head_violation(head) is not None:
+        return False
+    conn = h11.Connection(h11.SERVER,
+                          max_incomplete_event_size=MAX_HEADER_BYTES)
+    try:
+        conn.receive_data(head)
+        while True:
+            event = conn.next_event()
+            if event is h11.NEED_DATA:
+                return False
+            if isinstance(event, h11.Request):
+                return True
+    except h11.RemoteProtocolError:
+        return False
+
+
+def _delta_head_first_verdict(mutant, native_cls, python_cls):
+    """The native listener verdicts on the HEAD while the body still
+    streams (that overlap is the data plane's point), so a message
+    whose BODY framing is invalid can already have earned a 403 — or
+    an abort mid-proxy (drop), or a 400 once the framer hits the bad
+    chunk. The python plane buffers the whole message first and 400s.
+    Every one of those outcomes refuses the message; only a completed
+    200 proxy would be a real divergence (and stays one)."""
+    return (python_cls in ("reject-400", "reject-413") and
+            native_cls in ("block", "drop", "reject-400") and
+            _python_head_ok(mutant.raw))
+
+
+KNOWN_DELTAS = [
+    ("bare-lf-drop-vs-400", _delta_lf_drop),
+    ("head-first-verdict", _delta_head_first_verdict),
+]
+
+
+def known_delta(mutant, native_cls, python_cls):
+    for name, pred in KNOWN_DELTAS:
+        if pred(mutant, native_cls, python_cls):
+            return name
+    return None
+
+
+# --------------------------------------------------------------------------
+# the three parse paths
+# --------------------------------------------------------------------------
+
+REFUSED = ("drop",)  # plus any reject-*
+
+
+def _is_refusal(cls: str) -> bool:
+    return cls in REFUSED or cls.startswith("reject-")
+
+
+def _fuzz_plan():
+    """Small fixed ruleset whose verdicts flip on exactly the fields
+    the mutators perturb, so extraction skew becomes a verdict skew."""
+    from pingoo_tpu.compiler import compile_ruleset
+    from pingoo_tpu.config.schema import Action, RuleConfig
+    from pingoo_tpu.expr import compile_expression
+
+    exprs = [
+        'http_request.path.contains("../")',
+        'http_request.path.starts_with("/admin")',
+        'http_request.url.contains("%2e%2e")',
+        'http_request.user_agent.contains("sqlmap")',
+        'http_request.host.contains("evil")',
+    ]
+    rules = [RuleConfig(name=f"fuzz{i}", actions=(Action.BLOCK,),
+                        expression=compile_expression(e))
+             for i, e in enumerate(exprs)]
+    return compile_ruleset(rules, {})
+
+
+def _interp_action(plan, fields: dict) -> int:
+    """Verdict bits via the interpreter over extracted fields — the
+    third parse path. 0 allow / 1 block (the fuzz plan has no captcha
+    or route rules, so lane 0 is the whole verdict)."""
+    from pingoo_tpu.engine.batch import RequestTuple, tuple_to_context
+    from pingoo_tpu.engine.verdict import action_lanes, interpret_rules_row
+
+    tup = RequestTuple(
+        host=fields["host"], url=fields["url"], path=fields["path"],
+        method=fields["method"], user_agent=fields["user_agent"],
+        ip="127.0.0.1", remote_port=0, asn=0, country="XX")
+    row = interpret_rules_row(plan, tuple_to_context(tup, {}))
+    lanes = action_lanes(plan, row[None, :])
+    return int(lanes[0][0])
+
+
+def classify_python(raw: bytes, plan) -> tuple:
+    """-> (class, fields|None). Class is reject-400/413/431, drop,
+    block, or allow — the python listener's observable behavior."""
+    from pingoo_tpu.host.httpd import extract_request_fields, \
+        parse_request_bytes
+
+    status, detail = parse_request_bytes(raw)
+    if status == "reject":
+        return f"reject-{detail}", None
+    if status == "incomplete":
+        return "drop", None
+    req = detail
+    host, user_agent = extract_request_fields(req)
+    if not user_agent:
+        return "block", None  # empty/oversized UA 403s pre-ring
+    fields = {"method": req.method, "host": host, "path": req.path,
+              "url": req.target, "user_agent": user_agent}
+    action = _interp_action(plan, fields)
+    return ("block" if action == 1 else "allow"), fields
+
+
+class NativeHarness:
+    """Loopback stack: httpd + upstream + a ring consumer that records
+    the natively-parsed fields per ticket and answers with interpreter
+    verdicts over exactly those fields (so the ONLY free variable is
+    the parse, never the rules)."""
+
+    def __init__(self, plan, tmpdir: str):
+        from pingoo_tpu import native_ring
+        from pingoo_tpu.native_ring import Ring
+
+        self.plan = plan
+        self.slots: list[dict] = []  # consumer appends decoded fields
+        self._stop = threading.Event()
+
+        # Raw-socket upstream: unlike http.server it DRAINS the proxied
+        # body (Content-Length and chunked) before answering and keeps
+        # the connection alive — an upstream that answers early and
+        # closes RSTs the native proxy mid-stream and poisons the
+        # differential with transport noise.
+        self._up_sock = socket.socket()
+        self._up_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._up_sock.bind(("127.0.0.1", 0))
+        self._up_sock.listen(64)
+        self.up_port = self._up_sock.getsockname()[1]
+        threading.Thread(target=self._upstream_accept, daemon=True).start()
+
+        ring_path = os.path.join(tmpdir, "fuzz_ring")
+        self.ring = Ring(ring_path, capacity=4096, create=True)
+        self.ring.sidecar_attach()
+        self._consumer = threading.Thread(target=self._consume,
+                                          daemon=True)
+        self._consumer.start()
+
+        httpd_bin = os.path.join(native_ring.NATIVE_DIR, "httpd")
+        port = _free_port()
+        self.proc = subprocess.Popen(
+            [httpd_bin, str(port), ring_path, "127.0.0.1",
+             str(self.up_port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        line = self.proc.stdout.readline()
+        if b"listening" not in line:
+            raise RuntimeError(f"native httpd failed to start: {line!r}")
+        self.port = port
+        time.sleep(0.2)
+
+    def _upstream_accept(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._up_sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._upstream_serve, args=(conn,),
+                             daemon=True).start()
+
+    def _upstream_serve(self, conn):
+        conn.settimeout(10)
+        buf = b""
+        try:
+            while True:
+                while b"\r\n\r\n" not in buf:
+                    data = conn.recv(65536)
+                    if not data:
+                        return
+                    buf += data
+                head, _, buf = buf.partition(b"\r\n\r\n")
+                path = head.split(b" ", 2)[1] if b" " in head else b"?"
+                low = head.lower()
+                if b"transfer-encoding:" in low:
+                    while b"\r\n0\r\n" not in b"\r\n" + buf and \
+                            not buf.startswith(b"0\r\n"):
+                        data = conn.recv(65536)
+                        if not data:
+                            return
+                        buf += data
+                    # swallow through the terminating CRLFCRLF
+                    while not buf.endswith(b"\r\n\r\n"):
+                        data = conn.recv(65536)
+                        if not data:
+                            return
+                        buf += data
+                    buf = b""
+                else:
+                    clen = 0
+                    for line in low.split(b"\r\n"):
+                        if line.startswith(b"content-length:"):
+                            try:
+                                clen = int(line.split(b":", 1)[1])
+                            except ValueError:
+                                clen = 0
+                    while len(buf) < clen:
+                        data = conn.recv(65536)
+                        if not data:
+                            return
+                        buf += data
+                    buf = buf[clen:]
+                body = b"upstream:" + path
+                conn.sendall(b"HTTP/1.1 200 OK\r\ncontent-length: " +
+                             b"%d" % len(body) + b"\r\n\r\n" + body)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    def _consume(self):
+        while not self._stop.is_set():
+            self.ring.heartbeat()
+            slots = self.ring.dequeue_batch(256)
+            if not len(slots):
+                time.sleep(0.0005)
+                continue
+            for slot in slots:
+                fields = _decode_slot(slot)
+                action = _interp_action(self.plan, fields)
+                # Record BEFORE posting: the client-visible response
+                # needs the verdict, so post-then-record would let
+                # roundtrip() read the list before the append lands.
+                self.slots.append(fields)
+                self.ring.post_verdict(int(slot["ticket"]), action)
+            self.ring.set_posted_floor(int(slots["ticket"].max()))
+
+    def roundtrip(self, mutant: Mutant, timeout=5.0) -> tuple:
+        """Send one mutant, -> (class, fields|None) mirroring
+        classify_python. Fields come from the ring slot the listener
+        enqueued (None when the request never reached the ring)."""
+        seen = len(self.slots)
+        s = socket.create_connection(("127.0.0.1", self.port),
+                                     timeout=timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        data = b""
+        try:
+            segments = mutant.segments()
+            for i, seg in enumerate(segments):
+                s.sendall(seg)
+                if i + 1 < len(segments):
+                    # The 1ms pause forces a distinct TCP segment; read
+                    # anything that already arrived so an early 403/400
+                    # is not lost to the RST a late segment triggers on
+                    # the listener's closed socket.
+                    readable, _, _ = select.select([s], [], [], 0.001)
+                    if readable:
+                        chunk = s.recv(65536)
+                        if not chunk:
+                            break
+                        data += chunk
+            s.shutdown(socket.SHUT_WR)
+            deadline = time.monotonic() + timeout
+            while time.monotonic() < deadline:
+                try:
+                    chunk = s.recv(65536)
+                except socket.timeout:
+                    break
+                if not chunk:
+                    break
+                data += chunk
+        except OSError:
+            # Reset mid-send/mid-read: the listener already refused and
+            # tore the connection down — keep whatever status arrived.
+            pass
+        finally:
+            s.close()
+        if not data:
+            return "drop", None
+        status = data.split(b"\r\n", 1)[0].split(b" ")
+        code = status[1].decode("latin-1") if len(status) > 1 else "???"
+        fields = None
+        if len(self.slots) > seen:
+            fields = self.slots[-1]
+        if code in ("400", "413", "431"):
+            return f"reject-{code}", fields
+        if code == "403":
+            return "block", fields
+        if code == "200":
+            return "allow", fields
+        return f"status-{code}", fields
+
+    def close(self):
+        self._stop.set()
+        self.proc.terminate()
+        self.proc.wait(timeout=5)
+        self._consumer.join(timeout=2)
+        self._up_sock.close()
+        self.ring.close()
+
+
+def _decode_slot(slot) -> dict:
+    def field(name, ln):
+        return bytes(slot[name])[:int(slot[ln])].decode("latin-1")
+
+    return {"method": field("method", "method_len"),
+            "host": field("host", "host_len"),
+            "path": field("path", "path_len"),
+            "url": field("url", "url_len"),
+            "user_agent": field("user_agent", "ua_len")}
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+# --------------------------------------------------------------------------
+# differential + corpus
+# --------------------------------------------------------------------------
+
+def _count_discrepancy(cls: str):
+    from pingoo_tpu.obs import REGISTRY
+    from pingoo_tpu.obs.schema import HOTSWAP_METRICS
+
+    REGISTRY.counter(
+        "pingoo_fuzz_discrepancy_total",
+        HOTSWAP_METRICS["pingoo_fuzz_discrepancy_total"],
+        labels={"class": cls}).inc()
+
+
+def diff_one(mutant: Mutant, plan, harness) -> list[str]:
+    """-> discrepancy descriptions for one mutant ([] = agreement)."""
+    python_cls, python_fields = classify_python(mutant.raw, plan)
+    if harness is None:
+        return []
+    native_cls, native_fields = harness.roundtrip(mutant)
+    problems = []
+    if _is_refusal(python_cls) != _is_refusal(native_cls) or (
+            _is_refusal(python_cls) and python_cls != native_cls):
+        if known_delta(mutant, native_cls, python_cls) is None:
+            problems.append(f"verdict-class native={native_cls} "
+                            f"python={python_cls}")
+    if python_fields is not None and native_fields is not None:
+        for key in ("method", "host", "path", "url", "user_agent"):
+            if python_fields[key] != native_fields[key]:
+                problems.append(
+                    f"field {key}: native={native_fields[key]!r} "
+                    f"python={python_fields[key]!r}")
+    for p in problems:
+        _count_discrepancy(mutant.cls)
+    return [f"[{mutant.cls}] {p}" for p in problems]
+
+
+def load_corpus() -> list[dict]:
+    cases = []
+    if not os.path.isdir(CORPUS_DIR):
+        return cases
+    for name in sorted(os.listdir(CORPUS_DIR)):
+        if name.endswith(".json"):
+            with open(os.path.join(CORPUS_DIR, name)) as f:
+                case = json.load(f)
+            case["_file"] = name
+            cases.append(case)
+    return cases
+
+
+def corpus_mutant(case: dict) -> Mutant:
+    return Mutant(case.get("cls", "corpus"),
+                  base64.b64decode(case["raw_b64"]),
+                  splits=case.get("splits") or (),
+                  note=case.get("desc", ""))
+
+
+def replay_corpus(plan, harness) -> list[str]:
+    """Pinned found-and-fixed cases: each expects an exact per-plane
+    class. -> failure descriptions."""
+    failures = []
+    for case in load_corpus():
+        mutant = corpus_mutant(case)
+        python_cls, _ = classify_python(mutant.raw, plan)
+        if python_cls != case["python"]:
+            failures.append(f"{case['_file']}: python={python_cls} "
+                            f"expected {case['python']}")
+        if harness is not None and case.get("native"):
+            native_cls, _ = harness.roundtrip(mutant)
+            if native_cls != case["native"]:
+                failures.append(f"{case['_file']}: native={native_cls} "
+                                f"expected {case['native']}")
+    return failures
+
+
+def run(mutants: int = DEFAULT_MUTANTS, seed: int = DEFAULT_SEED,
+        corpus_only: bool = False, no_native: bool = False) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from pingoo_tpu import native_ring
+
+    t0 = time.monotonic()
+    plan = _fuzz_plan()
+    harness = None
+    if not no_native and native_ring.ensure_built():
+        import tempfile
+
+        tmpdir = tempfile.mkdtemp(prefix="pingoo_fuzz_")
+        try:
+            harness = NativeHarness(plan, tmpdir)
+        except Exception as exc:  # noqa: BLE001 — downgrade, never block
+            print(f"fuzz: WARNING native harness unavailable ({exc}); "
+                  f"python/interp differential only")
+            harness = None
+    elif not no_native:
+        print("fuzz: WARNING native toolchain unavailable; "
+              "python/interp differential only")
+
+    try:
+        corpus_failures = replay_corpus(plan, harness)
+        for failure in corpus_failures:
+            print(f"fuzz: CORPUS REGRESSION {failure}")
+        n_corpus = len(load_corpus())
+        print(f"fuzz: corpus {n_corpus} case(s), "
+              f"{len(corpus_failures)} regression(s)")
+        if corpus_only:
+            return 1 if corpus_failures else 0
+
+        discrepancies: list[str] = []
+        per_class: dict[str, int] = {}
+        for mutant in generate(mutants, seed):
+            per_class[mutant.cls] = per_class.get(mutant.cls, 0) + 1
+            discrepancies += diff_one(mutant, plan, harness)
+            if len(discrepancies) >= 25:
+                print("fuzz: stopping early — 25+ discrepancies")
+                break
+        wall = time.monotonic() - t0
+        print(f"fuzz: {mutants} mutants over {len(MUTATORS)} classes, "
+              f"seed {seed}, {wall:.1f}s "
+              f"({'3-path' if harness else '2-path'})")
+        for cls in sorted(per_class):
+            print(f"  {per_class[cls]:>5}  {cls}")
+        for d in discrepancies:
+            print(f"fuzz: DISCREPANCY {d}")
+        if discrepancies or corpus_failures:
+            print(f"fuzz: FAIL — {len(discrepancies)} discrepancy(ies), "
+                  f"{len(corpus_failures)} corpus regression(s)")
+            return 1
+        print("fuzz: OK — all parse paths agree")
+        return 0
+    finally:
+        if harness is not None:
+            harness.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--mutants", type=int, default=DEFAULT_MUTANTS)
+    ap.add_argument("--seed", type=int, default=DEFAULT_SEED)
+    ap.add_argument("--corpus-only", action="store_true",
+                    help="replay the pinned corpus only")
+    ap.add_argument("--no-native", action="store_true",
+                    help="skip the native plane (python/interp only)")
+    args = ap.parse_args(argv)
+    return run(mutants=args.mutants, seed=args.seed,
+               corpus_only=args.corpus_only, no_native=args.no_native)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

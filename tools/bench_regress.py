@@ -26,6 +26,7 @@ error. Tracked metrics and their directions:
     dfa_auto_req_per_s   higher is better (ISSUE 8 bitsplit-DFA arm)
     pipeline_on_req_per_s  higher is better (ISSUE 9 pipelined executor)
     pipeline_on_p99_ms     lower  is better
+    swap_pause_p99_ms    lower  is better (ISSUE 11 hot-swap pause)
 
 Metrics missing from either run are skipped (partial/error lines are
 trajectory too, but only shared keys gate).
@@ -59,6 +60,9 @@ TRACKED = (
     # p99 enqueue->resolution during a sidecar outage must stay within
     # the degraded fail-open bound.
     ("degraded_failopen_p99_ms", False),
+    # Ruleset hot-swap storm (ISSUE 11, tools/chaos_smoke.py): the
+    # drain+flip admission pause a swap costs at a batch boundary.
+    ("swap_pause_p99_ms", False),
 )
 
 DEFAULT_THRESHOLD = 0.10
