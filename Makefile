@@ -3,7 +3,7 @@
 PY ?= python
 
 .PHONY: all native test check bench bench-regress audit asan \
-	metrics-smoke mesh-smoke chaos-smoke clean \
+	metrics-smoke mesh-smoke chaos-smoke megastep-smoke clean \
 	analyze analyze-abi analyze-lint analyze-tidy analyze-tsan fuzz
 
 all: native
@@ -20,6 +20,7 @@ check:
 	$(MAKE) analyze
 	$(MAKE) mesh-smoke
 	$(MAKE) chaos-smoke
+	$(MAKE) megastep-smoke
 
 # Static analysis suite (docs/STATIC_ANALYSIS.md) — offline-safe; each
 # pass skips with a warning when its toolchain is missing, and each is
@@ -99,6 +100,14 @@ mesh-smoke:
 # when jax or the native toolchain is unavailable.
 chaos-smoke:
 	$(PY) tools/chaos_smoke.py
+
+# Device-resident megastep smoke (ISSUE 12, docs/EXECUTOR.md): prove
+# PINGOO_MEGASTEP=force is bit-identical to the per-batch oracle on
+# BOTH planes with real K>1 windows dispatched. Offline-safe: skips
+# when jax is unavailable; the sidecar half skips without the native
+# toolchain.
+megastep-smoke:
+	$(PY) tools/megastep_smoke.py
 
 # Live observability smoke: boot the native plane + ring sidecar + a
 # Python listener, scrape both /__pingoo/metrics endpoints in both

@@ -489,6 +489,22 @@ def write_services_file(path: str, services: list) -> None:
     os.replace(tmp, path)
 
 
+class _MegaSlice:
+    """One staged megastep slice's resolve metadata (ISSUE 12): the
+    per-batch state `_dispatch` would have threaded through its
+    in-flight tuple, parked until the window's single device sync."""
+
+    __slots__ = ("parts", "slots", "raw", "n", "skip_masks", "slot_buf",
+                 "pipe_slot", "epoch", "oldest_enq_ms")
+
+
+class _MegaWindow:
+    """One in-flight K-slice megastep window (ISSUE 12): the deque
+    entry `_complete_inflight` routes to `_complete_megastep`."""
+
+    __slots__ = ("slices", "k", "k_ship", "dev_out", "t_launch")
+
+
 class RingSidecar:
     """Drain loop: ring batches -> jitted verdict -> verdict ring.
 
@@ -641,13 +657,47 @@ class RingSidecar:
         self._pipe = PipelineStats("sidecar", self.pipeline_depth)
         self._staging = None
         self._slot_pool: _deque = _deque()
+        caps = dict(FIELD_CAPS)
+        caps["country"] = 2
+        # Device-resident megastep (ISSUE 12, docs/EXECUTOR.md
+        # "Device-resident loop"): PINGOO_MEGASTEP=off|auto|force. In a
+        # megastep window the drain loop STAGES admitted batches into
+        # the DeviceInputQueue's double-buffered [K, B, ...] host
+        # stacks instead of dispatching each one, then runs ONE jitted
+        # lax.scan over all K slices — one dispatch wall amortized over
+        # K batches. `off` keeps the per-batch path (the bit-exact
+        # parity oracle), `auto` engages only with backlog queued
+        # behind the window, `force` megasteps every window (the bench
+        # arm). Short/stale slices are masked on device by their
+        # n_valid/epoch words, never re-shaped.
+        from .engine.batch import DeviceInputQueue
+        from .engine.verdict import (_resolve_megastep_mode,
+                                     megastep_k_cap, megastep_k_ladder)
+
+        self._mega_mode = _resolve_megastep_mode()
+        self._mega_k = megastep_k_cap()
+        self._mega_rungs = megastep_k_ladder(self._mega_k)
+        self._mega_queue = None
+        self._mega_staged: list = []
+        self._mega_buf_id = 0
+        self._mega_target = 1
+        self._mega_fn = None
+        self.mega_windows = 0
+        self.mega_echo_mismatch = 0  # device epoch echo != staged epoch
+        if self._mega_mode != "off":
+            self._mega_queue = DeviceInputQueue(
+                self._mega_k, max_batch, field_specs=caps, nbuf=2)
+        # Slot-buffer pool: one per in-flight batch plus the one being
+        # filled; a staged megastep window parks up to K slot buffers
+        # until its single resolve, so the pool covers whichever bound
+        # is larger.
+        pool_n = max(self.pipeline_depth,
+                     self._mega_k if self._mega_mode != "off" else 1) + 1
         if self._zero_copy:
-            caps = dict(FIELD_CAPS)
-            caps["country"] = 2
             self._staging = StagingEncoder(
                 max_batch, field_specs=caps,
                 nbuf=self.pipeline_depth + 1)
-            for _ in range(self.pipeline_depth + 1):
+            for _ in range(pool_n):
                 self._slot_pool.append(
                     np.zeros(max_batch, dtype=REQUEST_SLOT_DTYPE))
         self._stage = {
@@ -841,6 +891,18 @@ class RingSidecar:
                     pf.masked, plane="sidecar")
         state["dev_cols"] = np.asarray(plan.device_rule_indices,
                                        dtype=np.int64)
+        # Megastep program (ISSUE 12): same unjitted prefilter/lane
+        # bodies as the per-batch programs above, scanned over K
+        # slices — bit-identical by construction. Built only when the
+        # mode can engage (the jit trace is per plan, like lane_fn).
+        state["mega_fn"] = None
+        if self._mega_mode != "off":
+            from .engine.verdict import make_megastep_fn
+
+            state["mega_fn"] = make_megastep_fn(
+                plan, kind="lanes",
+                service_groups=self._groups or None,
+                with_rule_hits=self._provenance_on)
         return state
 
     def _adopt_plan_state(self, plan, lists, state: dict) -> None:
@@ -858,6 +920,7 @@ class RingSidecar:
         self._pf_gated_banks = state["pf_gated_banks"]
         self._pf_attr = state["pf_attr"]
         self._dev_cols = state["dev_cols"]
+        self._mega_fn = state.get("mega_fn")
         self._dfa_mode0 = getattr(plan, "dfa_default_mode", "auto")
         self._dfa_probe = False
         self._plan_state = state
@@ -920,14 +983,31 @@ class RingSidecar:
         t0 = time.monotonic()
         with self._hb_busy():
             if pend_parts:
-                inflight.append(self._dispatch(pend_parts, pend_n,
-                                               oldest_enq_ms,
-                                               slot_buf=pend_buf))
+                # Megastep boundary (ISSUE 12): pending slots join the
+                # OPEN window when one exists — launching them per-batch
+                # past staged (older) slices would post their tickets
+                # first and break the posted-floor prefix invariant.
+                if self._mega_staged \
+                        and len(self._mega_staged) < self._mega_k:
+                    self._stage_mega_slice(pend_parts, pend_n,
+                                           oldest_enq_ms,
+                                           slot_buf=pend_buf)
+                else:
+                    if self._mega_staged:
+                        inflight.append(self._launch_megastep())
+                    inflight.append(self._dispatch(pend_parts, pend_n,
+                                                   oldest_enq_ms,
+                                                   slot_buf=pend_buf))
                 pend_parts, pend_n, oldest_enq_ms = [], 0, None
                 pend_buf = self._take_slot_buf() if self._zero_copy \
                     else None
+            if self._mega_staged:
+                # The flip happens only at a megastep boundary: every
+                # slice staged under the old epoch computes and posts
+                # on the old plan before the new one is adopted.
+                inflight.append(self._launch_megastep())
             while inflight:
-                self._complete(*inflight.popleft())
+                self._complete_inflight(inflight.popleft())
             while True:
                 with self._swap_lock:
                     if not self._swap_queue:
@@ -1059,33 +1139,61 @@ class RingSidecar:
                     launch = sched.should_launch(
                         pend_n, oldest_enq_ms / 1e3, now_ms / 1e3)
             if launch:
-                inflight.append(self._dispatch(pend_parts, pend_n,
-                                               oldest_enq_ms,
-                                               slot_buf=pend_buf))
+                # Megastep drive (ISSUE 12): while a window is open
+                # every admitted batch STAGES into it (per-batch
+                # launches past staged slices would post younger
+                # tickets first and break the posted-floor prefix);
+                # _mega_begin decides whether a launch signal with no
+                # open window starts one.
+                if self._mega_staged or self._mega_begin(oldest_enq_ms):
+                    self._stage_mega_slice(pend_parts, pend_n,
+                                           oldest_enq_ms,
+                                           slot_buf=pend_buf)
+                else:
+                    inflight.append(self._dispatch(pend_parts, pend_n,
+                                                   oldest_enq_ms,
+                                                   slot_buf=pend_buf))
                 pend_parts, pend_n, oldest_enq_ms = [], 0, None
                 if pend_buf is not None:
                     pend_buf = self._take_slot_buf()
+            if self._mega_staged and (got == 0 or self._mega_due()):
+                # Window full (K target reached), the oldest staged
+                # slice's deadline slack no longer covers the window
+                # estimate, or the rings went quiet: ship it. A partial
+                # window launches with k_used < K — masked, not
+                # re-shaped.
+                inflight.append(self._launch_megastep())
             if inflight and (len(inflight) >= self.pipeline_depth
                              or not launch):
-                self._complete(*inflight.popleft())
-            if got == 0 and not launch and not inflight:
+                self._complete_inflight(inflight.popleft())
+            if got == 0 and not launch and not inflight \
+                    and not self._mega_staged:
                 if not pend_parts and max_requests is not None \
                         and self.processed >= max_requests:
                     break
                 time.sleep(self.idle_sleep_s)
             if max_requests is not None and self.processed >= max_requests \
-                    and not inflight and not pend_parts:
+                    and not inflight and not pend_parts \
+                    and not self._mega_staged:
                 break
         # Flush: accumulated-but-unlaunched slots still get verdicts
         # (the data plane would otherwise eat a fail-open timeout).
         if pend_parts:
-            inflight.append(self._dispatch(pend_parts, pend_n,
-                                           oldest_enq_ms,
-                                           slot_buf=pend_buf))
+            if self._mega_staged and len(self._mega_staged) < self._mega_k:
+                self._stage_mega_slice(pend_parts, pend_n,
+                                       oldest_enq_ms, slot_buf=pend_buf)
+            else:
+                if self._mega_staged:
+                    inflight.append(self._launch_megastep())
+                inflight.append(self._dispatch(pend_parts, pend_n,
+                                               oldest_enq_ms,
+                                               slot_buf=pend_buf))
         elif pend_buf is not None:
             self._slot_pool.append(pend_buf)
+        if self._mega_staged:
+            inflight.append(self._launch_megastep())
         while inflight:
-            self._complete(*inflight.popleft())
+            self._complete_inflight(inflight.popleft())
         # A swap that never reached a batch boundary before shutdown is
         # rejected, not leaked: wake its requester.
         with self._swap_lock:
@@ -1289,6 +1397,251 @@ class RingSidecar:
             masks.append(~late)
         return masks
 
+    # -- device-resident megastep (ISSUE 12, docs/EXECUTOR.md) ----------------
+
+    def _mega_begin(self, oldest_enq_ms: Optional[int] = None) -> bool:
+        """Open a new megastep window? Called at a launch signal with
+        no window staged. `force` always megasteps (a K=1 window is
+        legal — masked, not re-shaped); `auto` engages only when more
+        traffic is already queued behind this batch (a lone batch would
+        pay window-fill latency for zero amortization); a demoted
+        megastep rung opens only backoff-probe windows (per-batch
+        dispatch serves meanwhile). The K target is sized down the pow2
+        ladder against the oldest row's remaining deadline slack
+        (sched.size_megastep_k) so a window never out-waits its own
+        budget. The serving mesh shards per-batch programs only —
+        mesh-active planes keep the per-batch path."""
+        if self._mega_fn is None or self.mesh.active:
+            return False
+        if self._mega_mode == "auto" and self._queued_depth() <= 0:
+            return False
+        if not self.ladder.try_rung("megastep"):
+            return False
+        self._mega_target = self._mega_k
+        if self._mega_mode != "force":
+            # Deadline-sized K (auto only — force is the operator
+            # pinning the cap for an oracle/bench arm).
+            now_ms = int(self.ring.lib.pingoo_ring_now_ms())
+            oldest = now_ms if oldest_enq_ms is None else oldest_enq_ms
+            self._mega_target = min(
+                self._mega_k, self.sched.size_megastep_k(
+                    self._mega_rungs, self.max_batch,
+                    oldest / 1e3, now_ms / 1e3))
+        self._mega_buf_id = self._mega_queue.checkout()
+        return True
+
+    def _mega_due(self) -> bool:
+        """Ship the open window now? Full to its K target, or the
+        oldest staged slice's remaining deadline slack no longer covers
+        the window's own cost estimate (waiting for more slices would
+        trade amortization for misses)."""
+        staged = self._mega_staged
+        if len(staged) >= self._mega_target:
+            return True
+        if self._mega_mode == "force":
+            # force pins the cap: only window-full (above) or an idle
+            # drain pass in the run loop ships a short window.
+            return False
+        oldest = min((s.oldest_enq_ms for s in staged
+                      if s.oldest_enq_ms is not None), default=None)
+        if oldest is None:
+            return True
+        now_ms = int(self.ring.lib.pingoo_ring_now_ms())
+        slack_ms = self.sched.config.deadline_ms - (now_ms - oldest)
+        return slack_ms <= self.sched.cost.estimate_megastep(
+            len(staged), self.max_batch)
+
+    def _stage_mega_slice(self, parts, n: int,
+                          oldest_enq_ms: Optional[int],
+                          slot_buf=None) -> None:
+        """Encode one admitted batch into the open window's next
+        DeviceInputQueue slice row. Mirrors `_dispatch`'s encode stage
+        exactly — same staging encoder, same ladder rung, same legacy
+        fallback — then copies into the queue's own stacks, so the
+        staging views are free to rotate immediately; the slice's
+        resolve-path raw views read the queue's copy (stable until this
+        buffer set is checked out again, nbuf-1 windows later)."""
+        from .engine.batch import RequestBatch, bucket_arrays, pad_batch
+
+        pipe_slot = self._pipe.enter(self.pipeline_mode)
+        self.chaos.stage("encode")
+        t0 = time.monotonic()
+        batch = None
+        if slot_buf is not None:
+            slots = slot_buf[:n]
+            if self.ladder.try_rung("pipeline"):
+                try:
+                    batch = self._staging.encode_slots(
+                        slots, pad_to=self.max_batch)
+                    self.ladder.note_success("pipeline")
+                except Exception as exc:
+                    self.ladder.note_failure("pipeline", exc)
+                    batch = None
+        else:
+            slots = parts[0][1] if len(parts) == 1 else np.concatenate(
+                [s for _, s in parts])
+        if batch is None:
+            batch = pad_batch(RequestBatch(
+                size=n, arrays=bucket_arrays(slots_to_arrays(slots))),
+                self.max_batch)
+        j = len(self._mega_staged)
+        self._mega_queue.fill_slice(self._mega_buf_id, j, batch.arrays,
+                                    n, self.ruleset_epoch)
+        raw = RequestBatch(size=n, arrays=self._mega_queue.slice_view(
+            self._mega_buf_id, j, n))
+        t1 = time.monotonic()
+        self._stage["encode"].observe((t1 - t0) * 1e3)
+        self._pipe.note_stage(pipe_slot, "encode", t0, t1)
+        self.sched.observe_stage_cost("encode", self.max_batch,
+                                      (t1 - t0) * 1e3)
+        # Staging IS this batch's admission: scheduler launch
+        # accounting and the fail-open sweep happen here, charging late
+        # rows the REMAINING cost — the whole window's estimate, since
+        # their verdicts land at its single sync.
+        now_ms = int(self.ring.lib.pingoo_ring_now_ms())
+        self.sched.note_launch(n, self._queued_depth())
+        if oldest_enq_ms is not None:
+            self._stage["sched"].observe(
+                max(0.0, float(now_ms - oldest_enq_ms)))
+        rec = _MegaSlice()
+        rec.parts = parts
+        rec.slots = slots
+        rec.raw = raw
+        rec.n = n
+        rec.skip_masks = None
+        if self.sched.config.failopen == "allow":
+            rec.skip_masks = self._failopen_late_rows(
+                parts, now_ms,
+                est_ms=self.sched.cost.estimate_megastep(
+                    self._mega_target, self.max_batch))
+        rec.slot_buf = slot_buf
+        rec.pipe_slot = pipe_slot
+        rec.epoch = self.ruleset_epoch
+        rec.oldest_enq_ms = oldest_enq_ms
+        self._mega_staged.append(rec)
+
+    def _launch_megastep(self) -> _MegaWindow:
+        """Ship the staged window's host stacks (one async device_put)
+        and dispatch ONE jitted megastep over its K slices (async);
+        returns the in-flight window record. A launch failure demotes
+        the megastep rung only — `_complete_megastep` serves the
+        window's slices from the interpreter, and per-batch dispatch
+        (which probes device health itself) takes over."""
+        staged, self._mega_staged = self._mega_staged, []
+        k = len(staged)
+        # Quantize the shipped leading dim to the NEXT pow2 rung >= k:
+        # each distinct K is its own XLA compile of the scan, so
+        # arbitrary short idle-drain windows would pay a fresh
+        # multi-second compile each. Padded slices ride along masked by
+        # their zeroed n_valid words — but padding still costs their
+        # scan iterations, so a short window ships at its own rung
+        # rather than the full cap (in force mode too: the pinned K
+        # caps the rung set, it does not inflate quiet windows).
+        k_ship = next((r for r in self._mega_rungs if r >= k),
+                      self._mega_k)
+        k_ship = max(k, min(k_ship, self._mega_k))
+        self.chaos.stage("dispatch")
+        self._dfa_rung_tick()
+        t0 = time.monotonic()
+        dev_out = None
+        try:
+            self.chaos.maybe_xla_error(self.batches)
+            # Busy window: the first call per (K, widths) signature
+            # blocks in XLA for seconds; the watchdog heartbeats
+            # through it.
+            with self._hb_busy():
+                stacked, nv, ep = self._mega_queue.device_stack(
+                    self._mega_buf_id, k, pad_to=k_ship)
+                dev_out = self._mega_fn.fn(self._tables, stacked,
+                                           nv, ep)  # async
+        except Exception as exc:
+            self.ladder.note_failure("megastep", exc)
+            dev_out = None
+        t1 = time.monotonic()
+        self._stage["device_dispatch"].observe((t1 - t0) * 1e3)
+        self._pipe.note_stage(staged[0].pipe_slot, "dispatch", t0, t1)
+        self.sched.observe_stage_cost("dispatch", self.max_batch,
+                                      (t1 - t0) * 1e3)
+        self._pipe.note_megastep(k, self._mega_mode)
+        self.mega_windows += 1
+        win = _MegaWindow()
+        win.slices = staged
+        win.k = k
+        win.k_ship = k_ship
+        win.dev_out = dev_out
+        win.t_launch = t1
+        return win
+
+    def _complete_inflight(self, entry) -> None:
+        """Route one in-flight deque entry: a megastep window resolves
+        through its single-sync path, a per-batch tuple through
+        `_complete` as before."""
+        if isinstance(entry, _MegaWindow):
+            self._complete_megastep(entry)
+        else:
+            self._complete(*entry)
+
+    def _complete_megastep(self, win: _MegaWindow) -> None:
+        """Resolve one in-flight megastep window: host-rule lanes for
+        ALL K slices first (the device is still computing — same
+        overlap per-batch completion gets), then ONE device sync for
+        the whole window, then each slice resolves through `_complete`
+        handed its precomputed host+device lanes — every post/floor/
+        spill/route/provenance behavior is the shared code path, not a
+        clone. A sync failure demotes the megastep rung and serves the
+        window bit-identically from the interpreter."""
+        from .engine.verdict import host_rule_lanes
+
+        hosts = [host_rule_lanes(self.plan, s.raw, self.lists)
+                 for s in win.slices]
+        lanes = hits = aux = ep_out = None
+        t0 = time.time()
+        if win.dev_out is not None:
+            try:
+                with self._hb_busy():  # one sync per K slices
+                    lanes = np.asarray(win.dev_out[0])
+                    hits = np.asarray(win.dev_out[1])
+                    aux = np.asarray(win.dev_out[2])
+                    ep_out = np.asarray(win.dev_out[3])
+                self._note_device_success()
+                self.ladder.note_success("megastep")
+            except Exception as exc:
+                self.ladder.note_failure("megastep", exc)
+                lanes = None
+        wait_s = time.time() - t0
+        self.device_wait_s += wait_s
+        self._stage["device_compute"].observe(wait_s * 1e3)
+        t_sync = time.monotonic()
+        self._pipe.note_stage(win.slices[0].pipe_slot, "compute",
+                              win.t_launch, t_sync)
+        window_ms = (t_sync - win.t_launch) * 1e3
+        # Cost feed: the window wall teaches the megastep EWMA (K
+        # sizing) and, split per slice, the compute-stage EWMA
+        # (admission slack) — never K near-zero syncs.
+        self.sched.observe_stage_cost("compute", self.max_batch,
+                                      window_ms / max(1, win.k))
+        # EWMA keyed by the SHIPPED K (the compiled shape that set the
+        # window's cost), not the filled count.
+        self.sched.observe_megastep_cost(win.k_ship, self.max_batch,
+                                         window_ms)
+        for j, s in enumerate(win.slices):
+            if ep_out is not None and int(ep_out[j]) != s.epoch:
+                # The device program echoes each slice's staged epoch
+                # untouched; a mismatch would mean a slice crossed a
+                # swap boundary (tests assert this stays 0).
+                self.mega_echo_mismatch += 1
+            self._complete(
+                s.parts, s.slots, s.raw, None,
+                (hits[j] if lanes is not None and self._provenance_on
+                 else None),
+                (aux[j] if lanes is not None and self._pf_fn is not None
+                 else None),
+                s.n, skip_masks=s.skip_masks, t_disp=None,
+                slot_buf=s.slot_buf, pipe_slot=s.pipe_slot,
+                host=hosts[j],
+                dev_lanes=(lanes[j][:, :s.n] if lanes is not None
+                           else None))
+
     def _enrich_slots(self, slots: np.ndarray) -> None:
         """Fill asn/country in place for rows the producer enqueued with
         the unknown markers (asn 0 + country "XX"). GeoipDB caches both
@@ -1315,16 +1668,22 @@ class RingSidecar:
 
     def _complete(self, parts, slots, raw_batch, dev, rule_hits, pf_aux,
                   n: int, skip_masks=None, t_disp=None, slot_buf=None,
-                  pipe_slot=None) -> None:
+                  pipe_slot=None, host=None, dev_lanes=None) -> None:
         from .engine.verdict import host_rule_lanes, merge_lanes
 
+        # Megastep slices (ISSUE 12) arrive with host AND device lanes
+        # already resolved by _complete_megastep's single window sync —
+        # `pre` skips the per-batch sync and its compute-cost feeds
+        # (the window attributed them once; K near-zero observations
+        # would drag the compute EWMA toward zero).
+        pre = dev_lanes is not None
         # Host-interpreted rules run on the UNPADDED batch while the
         # device lanes are still in flight (jax dispatch is async).
-        host = host_rule_lanes(self.plan, raw_batch, self.lists)
+        if host is None:
+            host = host_rule_lanes(self.plan, raw_batch, self.lists)
         tc0 = time.monotonic()
         t0 = time.time()
-        dev_lanes = None
-        if dev is not None:
+        if not pre and dev is not None:
             try:
                 with self._hb_busy():  # device sync can block for ms-s
                     dev_lanes = np.asarray(dev)[:, :n]  # drop padding
@@ -1338,7 +1697,8 @@ class RingSidecar:
         wait_s = time.time() - t0
         tc1 = time.monotonic()
         self.device_wait_s += wait_s
-        self._stage["device_compute"].observe(wait_s * 1e3)
+        if not pre:
+            self._stage["device_compute"].observe(wait_s * 1e3)
         # The pipeline's compute window runs dispatch-end -> results
         # ready, NOT just the residual block at the sync (which shrinks
         # to ~0 precisely when overlap works): it is the window the
@@ -1347,10 +1707,11 @@ class RingSidecar:
         # row's deadline must still cover after launch (the compute
         # budget slice _dispatch charges in _failopen_late_rows).
         tcs = t_disp if t_disp is not None else tc0
-        if pipe_slot is not None:
-            self._pipe.note_stage(pipe_slot, "compute", tcs, tc1)
-        self.sched.observe_stage_cost("compute", self.max_batch,
-                                      (tc1 - tcs) * 1e3)
+        if not pre:
+            if pipe_slot is not None:
+                self._pipe.note_stage(pipe_slot, "compute", tcs, tc1)
+            self.sched.observe_stage_cost("compute", self.max_batch,
+                                          (tc1 - tcs) * 1e3)
         if t_disp is not None:
             # EWMA cost-model feedback: launch -> device result wall
             # for the padded size. With stage observations present the
@@ -1648,6 +2009,15 @@ class RingSidecar:
             self.plan, service_groups=self._groups or None,
             with_rule_hits=self._provenance_on,
             donate=donate_batch_buffers())
+        if self._mega_fn is not None:
+            # The megastep embeds the same lane body — keep its DFA
+            # dispatch in lockstep with the per-batch program.
+            from .engine.verdict import make_megastep_fn
+
+            self._mega_fn = make_megastep_fn(
+                self.plan, kind="lanes",
+                service_groups=self._groups or None,
+                with_rule_hits=self._provenance_on)
 
     def _dfa_rung_tick(self) -> None:
         """Demoted-dfa probe: when the backoff window opens, restore
@@ -1915,6 +2285,12 @@ class RingSidecar:
             "sched": self.sched.snapshot(),
             "mesh": self.mesh.describe(),
             "pipeline": self._pipe.snapshot(),
+            "megastep": {
+                "mode": self._mega_mode,
+                "k_cap": self._mega_k,
+                "windows": self.mega_windows,
+                "echo_mismatch": self.mega_echo_mismatch,
+            },
             "ladder": self.ladder.snapshot(),
             "supervision": {"epoch": self.epoch,
                             "reconciled": dict(self.reconciled)},

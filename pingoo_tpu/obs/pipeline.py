@@ -84,6 +84,21 @@ class PipelineStats:
                 schema.PIPELINE_METRICS["pingoo_pipeline_stage_occupancy"],
                 labels={"plane": plane, "stage": stage})
             for stage in PIPELINE_EXEC_STAGES}
+        # Device-resident megastep instruments (ISSUE 12): K of the
+        # latest window, slices served per PINGOO_MEGASTEP mode, and
+        # the EWMA dispatch-amortization factor (slices per device
+        # dispatch; 1.0 means the plane is back to per-batch dispatch).
+        self.megastep_k = registry.gauge(
+            "pingoo_megastep_k",
+            schema.PIPELINE_METRICS["pingoo_megastep_k"], labels=labels)
+        self.megastep_amortization = registry.gauge(
+            "pingoo_megastep_amortization",
+            schema.PIPELINE_METRICS["pingoo_megastep_amortization"],
+            labels=labels)
+        self._megastep_batches: dict[str, object] = {}
+        self._amort_ewma: float | None = None
+        self.megastep_windows = 0
+        self.megastep_slices = 0
         self._batches: dict[str, object] = {}
         self._slot_seq = 0
         self._t_boot = time.monotonic()
@@ -117,6 +132,29 @@ class PipelineStats:
 
     def exit(self) -> None:
         self.inflight.dec()
+
+    def note_megastep(self, k: int, mode: str) -> None:
+        """One K-slice megastep window launched under PINGOO_MEGASTEP
+        `mode` (hot; ISSUE 12): updates the K gauge, the per-mode slice
+        counter, and the EWMA dispatch-amortization factor."""
+        k = max(1, int(k))
+        self.megastep_k.set(k)
+        counter = self._megastep_batches.get(mode)
+        if counter is None:
+            from . import schema
+
+            counter = self._registry.counter(
+                "pingoo_megastep_batches_total",
+                schema.PIPELINE_METRICS["pingoo_megastep_batches_total"],
+                labels={"plane": self.plane, "mode": mode})
+            self._megastep_batches[mode] = counter
+        counter.inc(k)
+        self.megastep_windows += 1
+        self.megastep_slices += k
+        prev = self._amort_ewma
+        self._amort_ewma = (float(k) if prev is None
+                            else prev + _EWMA_ALPHA * (k - prev))
+        self.megastep_amortization.set(round(self._amort_ewma, 6))
 
     def note_stage(self, slot: int, stage: str, t_start: float,
                    t_end: float) -> None:
@@ -186,4 +224,15 @@ class PipelineStats:
             "stage_occupancy": {
                 stage: round(self._busy[stage] / wall, 4)
                 for stage in PIPELINE_EXEC_STAGES},
+            "megastep": {
+                "k": self.megastep_k.value,
+                "windows": self.megastep_windows,
+                "slices": self.megastep_slices,
+                "amortization": (round(self._amort_ewma, 4)
+                                 if self._amort_ewma is not None
+                                 else None),
+                "slices_by_mode": {
+                    mode: c.value for mode, c in sorted(
+                        self._megastep_batches.items())},
+            },
         }

@@ -141,6 +141,19 @@ PIPELINE_METRICS = {
     "pingoo_pipeline_batches_total":
         "batches served by the executor, split by mode (on = staged "
         "overlap, off = legacy lockstep)",
+    # Device-resident megastep (ISSUE 12, docs/EXECUTOR.md
+    # "Device-resident loop"): one jitted lax.scan dispatch covering K
+    # batch slices. `batches_total` carries a `mode` label over the
+    # PINGOO_MEGASTEP arms that actually launch (auto / force).
+    "pingoo_megastep_k":
+        "K of the most recently launched megastep window (batch "
+        "slices per device dispatch)",
+    "pingoo_megastep_batches_total":
+        "batch slices served device-resident, split by PINGOO_MEGASTEP "
+        "mode (auto = backlog-engaged, force = pinned)",
+    "pingoo_megastep_amortization":
+        "EWMA batch slices amortized per device dispatch (1.0 = "
+        "per-batch dispatch, K = fully amortized megastep windows)",
 }
 
 # Continuous-batching scheduler + serving-mesh metrics (ISSUE 6,

@@ -128,6 +128,54 @@ def seed_from_bench_history(path: Optional[str] = None) -> Optional[float]:
     return None
 
 
+def seed_stages_from_bench_history(
+        path: Optional[str] = None) -> Optional[dict]:
+    """Newest usable per-stage EWMA map from BENCH_history.jsonl
+    (ISSUE 12 satellite): bench.py --history flattens the pipelined
+    arm's cost snapshot as `pipeline_on_stage_ewma_ms` =
+    {stage: {"<bucket>": ms}}. Returns {stage: {bucket:int -> ms}} or
+    None. Best-effort like seed_from_bench_history — a missing/corrupt
+    history leaves the affine STAGE_SEED_SPLIT fallback in charge, but
+    when history exists the very first megastep K-sizing runs against
+    MEASURED dispatch/compute walls instead of the 1.5 ms seed."""
+    import json
+
+    path = path or os.environ.get("BENCH_HISTORY_FILE",
+                                  "BENCH_history.jsonl")
+    try:
+        with open(path) as f:
+            lines = f.readlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            entry = json.loads(line)
+        except ValueError:
+            continue
+        raw = entry.get("pipeline_on_stage_ewma_ms")
+        if not isinstance(raw, dict):
+            continue
+        out: dict = {}
+        for stage, buckets in raw.items():
+            if stage not in STAGE_SEED_SPLIT \
+                    or not isinstance(buckets, dict):
+                continue
+            per_bucket = {}
+            for b, ms in buckets.items():
+                try:
+                    bucket = int(b)
+                    val = float(ms)
+                except (TypeError, ValueError):
+                    continue
+                if bucket > 0 and val > 0:
+                    per_bucket[bucket] = val
+            if per_bucket:
+                out[stage] = per_bucket
+        if out:
+            return out
+    return None
+
+
 def _pow2_bucket(n: int, cap: int) -> int:
     b = 1
     while b < n:
@@ -153,6 +201,9 @@ class CostModel:
                  seed_ms: Optional[float] = None,
                  alpha: float = DEFAULT_ALPHA):
         self.max_batch = max(1, int(max_batch))
+        seeded_from_env_or_arg = (
+            seed_ms is not None
+            or bool(os.environ.get("PINGOO_SCHED_SEED_MS")))
         if seed_ms is None:
             env = os.environ.get("PINGOO_SCHED_SEED_MS")
             if env:
@@ -172,7 +223,21 @@ class CostModel:
         # data, estimate() is the SUM of stage estimates — the single
         # encode->result wall includes stage-token waits under overlap
         # and would inflate should_launch's slack math.
+        #
+        # Boot-seeded from bench history (ISSUE 12 satellite, gated the
+        # same way as the batch-cost seed: only when no explicit seed
+        # was pinned) so the first megastep K-sizing decisions run on
+        # measured dispatch/compute walls. Live observations EWMA-blend
+        # over the seed from the first batch.
         self._stage_ewma: dict[str, dict[int, float]] = {}
+        if seeded_from_env_or_arg is False:
+            hist = seed_stages_from_bench_history()
+            if hist:
+                self._stage_ewma = {s: dict(b) for s, b in hist.items()}
+        # Per-(K, bucket) megastep window EWMAs (ISSUE 12): the wall of
+        # ONE K-slice device-resident dispatch. Unobserved pairs fall
+        # back to the amortization model dispatch + K * compute.
+        self._mega_ewma: dict[tuple[int, int], float] = {}
 
     def _seed_for(self, bucket: int) -> float:
         cap = _pow2_bucket(self.max_batch, self.max_batch)
@@ -241,6 +306,34 @@ class CostModel:
         else:
             stages[bucket] = prev + self.alpha * (ms - prev)
 
+    def estimate_megastep(self, k: int, batch_size: int) -> float:
+        """Expected wall (ms) of ONE K-slice megastep window (hot;
+        ISSUE 12) — the admission loop sizes K down the pow2 ladder
+        against the oldest slice's deadline slack with this. Unobserved
+        (K, bucket) pairs fall back to the amortization model that is
+        the megastep's whole point: one dispatch + K compute walls."""
+        k = max(1, int(k))
+        bucket = _pow2_bucket(max(1, batch_size), self.max_batch)
+        est = self._mega_ewma.get((k, bucket))
+        if est is not None:
+            return est
+        return (self.estimate_stage("dispatch", batch_size)
+                + k * self.estimate_stage("compute", batch_size))
+
+    def observe_megastep(self, k: int, batch_size: int,
+                         ms: float) -> None:
+        """EWMA update from one completed K-slice megastep window's
+        measured dispatch->sync wall (hot)."""
+        if ms < 0:
+            return
+        k = max(1, int(k))
+        bucket = _pow2_bucket(max(1, batch_size), self.max_batch)
+        prev = self._mega_ewma.get((k, bucket))
+        if prev is None:
+            self._mega_ewma[(k, bucket)] = ms
+        else:
+            self._mega_ewma[(k, bucket)] = prev + self.alpha * (ms - prev)
+
     def snapshot(self) -> dict:
         return {"seed_ms": round(self.seed_ms, 4),
                 "ewma_ms": {b: round(v, 4)
@@ -249,7 +342,10 @@ class CostModel:
                     stage: {b: round(v, 4)
                             for b, v in sorted(buckets.items())}
                     for stage, buckets in sorted(
-                        self._stage_ewma.items())}}
+                        self._stage_ewma.items())},
+                "megastep_ewma_ms": {
+                    f"{k}x{b}": round(v, 4)
+                    for (k, b), v in sorted(self._mega_ewma.items())}}
 
 
 class SchedMetrics:
@@ -371,6 +467,29 @@ class Scheduler:
         (hot; ISSUE 9) — keeps should_launch's slack estimate honest
         once stages overlap across in-flight batches."""
         self.cost.observe_stage(stage, batch_size, ms)
+
+    def observe_megastep_cost(self, k: int, batch_size: int,
+                              ms: float) -> None:
+        """One completed K-slice megastep window's measured wall
+        (hot; ISSUE 12)."""
+        self.cost.observe_megastep(k, batch_size, ms)
+
+    def size_megastep_k(self, k_ladder, batch_size: int,
+                        oldest_admit_s: float, now_s: float) -> int:
+        """Largest K rung whose estimated megastep window still fits
+        the OLDEST pending slice's remaining deadline slack (ISSUE 12).
+        Never below 1 — a megastep with a blown budget still launches
+        immediately at K=1 rather than stalling (the miss is counted at
+        resolve like every other late batch)."""
+        slack_ms = (oldest_admit_s + self.config.deadline_ms / 1e3
+                    - now_s) * 1e3
+        k = 1
+        for rung in k_ladder:
+            if rung == 1:
+                continue
+            if self.cost.estimate_megastep(rung, batch_size) <= slack_ms:
+                k = rung
+        return k
 
     def snapshot(self) -> dict:
         return {

@@ -132,9 +132,17 @@ class TestHotPathLinter:
     def test_block_until_ready_allowlist(self):
         body = "def f(dev):\n    dev.block_until_ready()\n"
         assert [f.rule for f in _lint(body)] == ["sync-block"]
-        # The same call inside the blessed finish_batch is allowed.
-        blessed = "def finish_batch(dev):\n    dev.block_until_ready()\n"
+        # The same call inside the blessed _await_device (the one
+        # sanctioned sync primitive finish_batch / finish_megastep
+        # route through) is allowed.
+        blessed = "def _await_device(dev):\n    dev.block_until_ready()\n"
         assert _lint(blessed, "pingoo_tpu/engine/verdict.py") == []
+        # finish_batch itself is no longer blessed — a direct sync
+        # there must go through _await_device.
+        direct = "def finish_batch(dev):\n    dev.block_until_ready()\n"
+        assert [f.rule for f in
+                _lint(direct, "pingoo_tpu/engine/verdict.py")] \
+            == ["sync-block"]
         # getattr() spelling is caught too.
         indirect = ("def f(dev):\n"
                     "    b = getattr(dev, 'block_until_ready', None)\n")

@@ -77,6 +77,20 @@ HOT_FUNCTIONS = frozenset({
     "pingoo_tpu/sched/scheduler.py::CostModel.estimate_stage",
     "pingoo_tpu/sched/scheduler.py::Scheduler.observe_stage_cost",
     "pingoo_tpu/obs/pipeline.py::PipelineStats.note_stage",
+    # Device-resident megastep (ISSUE 12): the double-buffered input
+    # queue's fill runs per slice on the drain path (strided copies
+    # into REUSED host stacks, never fresh allocations), device_stack
+    # issues the ASYNC device_put copy for the next buffer while the
+    # current megastep computes (it must never sync), the per-slice
+    # resolve unpacks one already-synced numpy stack, and the megastep
+    # cost EWMAs are pure float math on the admission path.
+    "pingoo_tpu/engine/batch.py::DeviceInputQueue.fill_slice",
+    "pingoo_tpu/engine/batch.py::DeviceInputQueue.device_stack",
+    "pingoo_tpu/engine/verdict.py::finish_megastep",
+    "pingoo_tpu/engine/service.py::VerdictService._evaluate_megastep",
+    "pingoo_tpu/sched/scheduler.py::CostModel.observe_megastep",
+    "pingoo_tpu/sched/scheduler.py::CostModel.estimate_megastep",
+    "pingoo_tpu/obs/pipeline.py::PipelineStats.note_megastep",
 })
 
 # Functions traced by jax.jit that the AST cannot see are jitted (they
@@ -97,12 +111,20 @@ TRACED_FUNCTIONS = frozenset({
     # program's bank dispatch (engine/verdict run_packed_scans).
     "pingoo_tpu/ops/bitsplit_dfa.py::dfa_scan",
     "pingoo_tpu/ops/bitsplit_dfa.py::_fused_dfa",
+    # Device-resident megastep driver (ISSUE 12): the K-slice lax.scan
+    # body and its per-slice step execute at trace time from
+    # make_megastep_fn's jit — captured host constants there re-stage
+    # on every retrace.
+    "pingoo_tpu/engine/verdict.py::make_megastep_fn.slice_step",
+    "pingoo_tpu/engine/verdict.py::make_megastep_fn.megastep",
 })
 
 # The explicit blessing list for block_until_ready: the ONE deliberate
 # device sync point per plane. Everything else must go through these.
+# (_await_device is the shared wait primitive finish_batch /
+# finish_megastep route their single sanctioned sync through.)
 BLOCK_UNTIL_READY_ALLOW = frozenset({
-    "pingoo_tpu/engine/verdict.py::finish_batch",
+    "pingoo_tpu/engine/verdict.py::_await_device",
 })
 
 # Attribute/function names that hold jitted dispatch callables: casting
@@ -110,7 +132,7 @@ BLOCK_UNTIL_READY_ALLOW = frozenset({
 # blocking device round-trip per call (sync-scalar-cast).
 JITTED_DISPATCH_NAMES = frozenset({
     "_verdict_fn", "_score_fn", "_lane_fn", "_pf_fn", "verdict_fn",
-    "lane_fn",
+    "lane_fn", "_mega_fn", "mega_fn",
 })
 
 # numpy allocators flagged inside hot functions (hot-alloc).

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Device-resident megastep smoke (make megastep-smoke; ISSUE 12).
+
+Proves, offline and in ~a minute, that the jitted K-batch megastep
+(docs/EXECUTOR.md, "Device-resident loop") is a scheduling change and
+never a semantic one — on BOTH planes:
+
+  * python plane: VerdictService verdicts under PINGOO_MEGASTEP=force
+    are bit-identical to PINGOO_MEGASTEP=off (the per-batch oracle),
+    with at least one K>1 window actually dispatched and zero
+    ruleset-epoch echo mismatches;
+  * sidecar plane: RingSidecar over a real shm ring, the same
+    off-vs-force bit-identity with windows > 0 (this half skips with a
+    warning when the native toolchain is unavailable);
+  * the `pingoo_megastep_k` / `pingoo_megastep_batches_total` series
+    export through the shared registry and the exposition passes the
+    Prometheus lint.
+
+Offline-safe like mesh-smoke: when jax is unavailable the smoke SKIPS
+WITH A WARNING (exit 0) instead of failing the gate. The work happens
+in a re-exec'd child under a controlled environment so a parent shell
+pinning PINGOO_MEGASTEP cannot skew the A/B.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FAILURES: list = []
+
+N_PY = 80       # python-plane requests
+N_RING = 96     # sidecar-plane requests
+MAX_BATCH = 16  # sidecar batch rows -> K=4 windows of 64 tickets
+
+
+def check(ok, what):
+    print(("  ok  " if ok else "  FAIL") + f" {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def parent() -> int:
+    try:
+        import jax  # noqa: F401
+    except Exception as exc:
+        print(f"megastep smoke SKIPPED: jax unavailable ({exc!r})")
+        return 0
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    for k in ("PINGOO_MEGASTEP", "PINGOO_MEGASTEP_K", "PINGOO_PIPELINE",
+              "PINGOO_PIPELINE_DEPTH", "PINGOO_MESH", "PINGOO_DFA",
+              "PINGOO_DEADLINE_MS", "PINGOO_SCHED_MODE",
+              "PINGOO_SCHED_FAILOPEN", "PINGOO_CHAOS"):
+        env.pop(k, None)
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--child"],
+        env=env, cwd=REPO, timeout=900)
+    return proc.returncode
+
+
+def _python_plane() -> dict:
+    """VerdictService off-vs-force bit-identity with real K>1 windows."""
+    import asyncio
+    import random
+
+    import numpy as np
+
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from pingoo_tpu.compiler import compile_ruleset
+    from pingoo_tpu.engine.service import VerdictService
+    from test_parity import LISTS, RULE_SOURCES, make_rules, \
+        random_requests
+
+    reqs = random_requests(random.Random(1207), N_PY)
+
+    def serve(mode):
+        os.environ["PINGOO_MEGASTEP"] = mode
+        os.environ["PINGOO_MEGASTEP_K"] = "4"
+        try:
+            plan = compile_ruleset(make_rules(RULE_SOURCES), LISTS)
+            svc = VerdictService(plan, LISTS, use_device=True,
+                                 max_batch=32)
+
+            async def flow():
+                await svc.start()
+                try:
+                    return await asyncio.gather(
+                        *[svc.evaluate(r) for r in reqs])
+                finally:
+                    await svc.stop()
+
+            return svc, asyncio.run(flow())
+        finally:
+            del os.environ["PINGOO_MEGASTEP"]
+            del os.environ["PINGOO_MEGASTEP_K"]
+
+    _, want = serve("off")
+    svc, got = serve("force")
+    identical = all(
+        w.action == g.action and w.verified_block == g.verified_block
+        and np.array_equal(w.matched, g.matched)
+        for w, g in zip(want, got))
+    check(identical,
+          "python-plane verdicts bit-identical (force vs off oracle)")
+    mega = svc._pipe.snapshot().get("megastep") or {}
+    check(mega.get("windows", 0) >= 1 and mega.get("k", 0) >= 2,
+          f"force dispatched K>1 megastep windows ({mega})")
+    check(svc.mega_echo_mismatch == 0,
+          "zero ruleset-epoch echo mismatches (python plane)")
+    return {"python_windows": mega.get("windows"),
+            "python_k": mega.get("k")}
+
+
+def _sidecar_plane() -> dict:
+    """RingSidecar off-vs-force bit-identity over a real shm ring."""
+    import tempfile
+    import threading
+
+    from pingoo_tpu.compiler import compile_ruleset
+    from pingoo_tpu.config.schema import Action, RuleConfig
+    from pingoo_tpu.expr import compile_expression
+    from pingoo_tpu.native_ring import Ring, RingSidecar
+
+    rules = [
+        RuleConfig(name="blk", actions=(Action.BLOCK,),
+                   expression=compile_expression(
+                       'http_request.path.starts_with("/evil")')),
+        RuleConfig(name="ua", actions=(Action.BLOCK,),
+                   expression=compile_expression(
+                       'http_request.user_agent.contains("megabot")')),
+    ]
+    plan = compile_ruleset(rules, {})
+
+    def fields(i):
+        path = (f"/evil/{i}" if i % 3 == 0 else f"/fine/{i}").encode()
+        return {"method": b"GET", "host": b"mega.test", "path": path,
+                "url": path,
+                "user_agent": b"megabot" if i % 7 == 0 else b"ua",
+                "ip": b"\x00" * 15 + bytes([i % 251 + 1])}
+
+    def drive(tmp, mode):
+        os.environ["PINGOO_MEGASTEP"] = mode
+        os.environ["PINGOO_MEGASTEP_K"] = "4"
+        try:
+            ring = Ring(os.path.join(tmp, f"ring_{mode}"),
+                        capacity=256, create=True)
+            sidecar = RingSidecar(ring, plan, {}, max_batch=MAX_BATCH)
+        finally:
+            del os.environ["PINGOO_MEGASTEP"]
+            del os.environ["PINGOO_MEGASTEP_K"]
+        enq = {}
+        for i in range(N_RING):
+            enq[ring.enqueue(**fields(i))] = i
+        worker = threading.Thread(
+            target=sidecar.run, kwargs={"max_requests": N_RING},
+            daemon=True)
+        worker.start()
+        got: dict = {}
+        deadline = time.time() + 240
+        while time.time() < deadline and len(got) < N_RING:
+            v = ring.poll_verdict()
+            if v is None:
+                time.sleep(0.001)
+                continue
+            got.setdefault(v[0], []).append(v[1])
+        sidecar.stop()
+        worker.join(timeout=30)
+        stats = sidecar.stats()
+        ring.close()
+        check(len(got) == N_RING
+              and all(len(v) == 1 for v in got.values()),
+              f"{mode}: all verdicts exactly once ({len(got)}/{N_RING})")
+        return {enq[t]: v[0] & 3 for t, v in got.items()}, stats
+
+    with tempfile.TemporaryDirectory() as tmp:
+        off, _ = drive(tmp, "off")
+        force, st = drive(tmp, "force")
+    check(off == force,
+          "sidecar-plane verdicts bit-identical (force vs off oracle)")
+    mega = st.get("megastep", {})
+    check(mega.get("windows", 0) >= 1,
+          f"force dispatched megastep windows on the ring ({mega})")
+    check(mega.get("echo_mismatch") == 0,
+          "zero ruleset-epoch echo mismatches (sidecar plane)")
+    return {"sidecar_windows": mega.get("windows")}
+
+
+def child() -> int:
+    from pingoo_tpu import native_ring
+    from pingoo_tpu.obs import REGISTRY
+    from pingoo_tpu.obs.registry import lint_prometheus_text
+
+    summary = _python_plane()
+    if native_ring.ensure_built():
+        summary.update(_sidecar_plane())
+    else:
+        print("  note sidecar plane skipped: native toolchain "
+              "unavailable")
+
+    text = REGISTRY.prometheus_text()
+    problems = lint_prometheus_text(text)
+    check(not problems, f"prometheus lint clean {problems[:3]}")
+    for name in ("pingoo_megastep_k", "pingoo_megastep_batches_total"):
+        check(name in text, f"scrape exposes {name}")
+
+    if FAILURES:
+        print(f"\nmegastep smoke FAILED ({len(FAILURES)} problems)")
+        return 1
+    print(json.dumps(summary))
+    print("\nmegastep smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(child() if "--child" in sys.argv else parent())
