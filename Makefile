@@ -3,8 +3,9 @@
 PY ?= python
 
 .PHONY: all native test check bench bench-regress audit asan \
-	metrics-smoke mesh-smoke chaos-smoke megastep-smoke clean \
-	analyze analyze-abi analyze-lint analyze-tidy analyze-tsan fuzz
+	metrics-smoke mesh-smoke chaos-smoke megastep-smoke body-smoke \
+	clean analyze analyze-abi analyze-lint analyze-tidy analyze-tsan \
+	fuzz
 
 all: native
 
@@ -21,6 +22,7 @@ check:
 	$(MAKE) mesh-smoke
 	$(MAKE) chaos-smoke
 	$(MAKE) megastep-smoke
+	$(MAKE) body-smoke
 
 # Static analysis suite (docs/STATIC_ANALYSIS.md) — offline-safe; each
 # pass skips with a warning when its toolchain is missing, and each is
@@ -108,6 +110,15 @@ chaos-smoke:
 # toolchain.
 megastep-smoke:
 	$(PY) tools/megastep_smoke.py
+
+# Streaming body-inspection smoke (ISSUE 13, docs/BODY_STREAMING.md):
+# prove stream==contiguous==oracle scanner parity with seams inside
+# every match literal, the window-gap degrade lane, and the native
+# httpd under PINGOO_BODY_INSPECT=on blocking torn-literal bodies
+# (gate off = bit-exact status quo). Offline-safe: skips with a
+# warning when jax is unavailable; the native half skips without g++.
+body-smoke:
+	$(PY) tools/body_smoke.py
 
 # Live observability smoke: boot the native plane + ring sidecar + a
 # Python listener, scrape both /__pingoo/metrics endpoints in both

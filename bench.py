@@ -553,6 +553,102 @@ def _sched_bench_child() -> None:
     print(json.dumps(result), flush=True)
 
 
+def bench_body() -> dict:
+    """ISSUE 13 satellite: throughput of the streaming body scanner
+    (engine/bodyscan.py) over interleaved multi-flow window streams —
+    the shape the ring sidecar actually drains — A/B'd against the
+    contiguous one-shot scan of the same payloads. Verdict equality
+    across both framings and the interpreter oracle is enforced:
+    streaming is a framing change, never a semantic one. Writes
+    BENCH_body.json; tools/bench_regress.py tracks the streamed
+    throughput."""
+    import random as _random
+
+    from pingoo_tpu.engine import bodyscan
+
+    n_flows = int(os.environ.get("BENCH_BODY_FLOWS", "192"))
+    plan = bodyscan.compile_body_plan()
+    window = bodyscan.body_window_bytes()
+    rng = _random.Random(1306)
+    # Filler alphabet free of rule-literal bytes (space, quotes, <, .,
+    # /, parens) so only the planted literals can match.
+    alpha = b"abcdefghijklmnop0123456789=&"
+    lits = [r.pattern.encode() for r in bodyscan.DEFAULT_BODY_RULES]
+    payloads = []
+    for i in range(n_flows):
+        body = bytes(rng.choices(alpha, k=rng.randint(256, 3 * window)))
+        if i % 3 == 0:  # a third carry a literal at a random offset
+            lit = lits[i % len(lits)]
+            at = rng.randint(0, len(body))
+            body = body[:at] + lit + body[at:]
+        payloads.append(body)
+    total_bytes = sum(map(len, payloads))
+
+    def make_windows():
+        """Round-robin interleave the flows' windows, the arrival
+        order a busy listener actually produces."""
+        per_flow = []
+        for fid, payload in enumerate(payloads):
+            parts = bodyscan.split_payload(payload, window)
+            per_flow.append([bodyscan.BodyWindow(
+                flow_id=fid, win_seq=s, data=d,
+                final=(s == len(parts) - 1))
+                for s, d in enumerate(parts)])
+        rounds, depth = [], max(map(len, per_flow))
+        for r in range(depth):
+            rounds.append([w[r] for w in per_flow if len(w) > r])
+        return rounds
+
+    def stream_pass():
+        scanner = bodyscan.BodyScanner(plan)
+        out = {}
+        for batch in make_windows():
+            for v in scanner.scan_windows(batch):
+                out[v.flow_id] = v
+        return out
+
+    stream_pass()  # warm the chunk kernels off the clock
+    t0 = time.time()
+    streamed = stream_pass()
+    stream_s = time.time() - t0
+
+    scanner = bodyscan.BodyScanner(plan)
+    t0 = time.time()
+    contig = {fid: scanner.scan_buffered(p)
+              for fid, p in enumerate(payloads)}
+    contig_s = time.time() - t0
+
+    mismatches = 0
+    for fid, payload in enumerate(payloads):
+        unv, vb, _ = bodyscan.body_lanes_oracle(plan, payload)
+        sv, cv = streamed.get(fid), contig[fid]
+        if (sv is None or sv.degraded or cv.degraded
+                or sv.unverified != unv or cv.unverified != unv
+                or sv.verified_block != vb or cv.verified_block != vb):
+            mismatches += 1
+    child = {
+        "flows": n_flows,
+        "bytes_total": total_bytes,
+        "window_bytes": window,
+        "body_stream_mb_per_s": round(total_bytes / stream_s / 1e6, 2),
+        "body_contig_mb_per_s": round(total_bytes / contig_s / 1e6, 2),
+        "body_verdict_mismatches": mismatches,
+    }
+    if contig_s > 0 and stream_s > 0:
+        child["stream_vs_contig"] = round(contig_s / stream_s, 3)
+    try:
+        with open("BENCH_body.json", "w") as f:
+            json.dump({"metric": "body_streaming_scan", **child},
+                      f, indent=2)
+    except OSError:
+        pass
+    if mismatches:
+        raise RuntimeError(
+            f"body bench: {mismatches} verdict mismatch(es) between "
+            f"streamed / contiguous / oracle")
+    return child
+
+
 def bench_pipeline() -> dict:
     """ISSUE 9 satellite: A/B the zero-copy pipelined executor
     (PINGOO_PIPELINE=off vs on, docs/EXECUTOR.md) by driving the same
@@ -1436,6 +1532,14 @@ def _main_impl(result: dict, done=None) -> None:
             result.update(bench_pipeline())
         except Exception as exc:
             result["pipeline_error"] = repr(exc)[:200]
+    # Streaming body-scan arm (ISSUE 13): interleaved multi-flow window
+    # streams vs the contiguous one-shot over identical payloads, with
+    # verdict equality (and the interpreter oracle) enforced.
+    if "--body" in sys.argv or os.environ.get("BENCH_SKIP_BODY") != "1":
+        try:
+            result.update(bench_body())
+        except Exception as exc:
+            result["body_error"] = repr(exc)[:200]
     if os.environ.get("BENCH_SKIP_BLOCKLIST") != "1":
         try:
             result.update(bench_blocklist_1m())

@@ -19,13 +19,19 @@ Rung order (cheapest first — the order callers demote in):
   dfa         lowered bitsplit DFAs -> exact NFA scan
   mesh        sharded serving mesh -> single-device executor
   device      XLA device programs -> host interpreter
+  body        streaming body inspection -> metadata-only verdicts
   ==========  =====================================================
 
-Every rung serves bit-identical verdicts by construction: each
-fallback IS the oracle its fast path is tested against
-(tests/test_pipeline.py, tests/test_bitsplit_dfa.py,
+Every rung except ``body`` serves bit-identical verdicts by
+construction: each fallback IS the oracle its fast path is tested
+against (tests/test_pipeline.py, tests/test_bitsplit_dfa.py,
 tests/test_resilience.py), so a demotion changes latency, never
-answers.
+answers. The ``body`` rung is the one deliberate exception (ISSUE 13,
+docs/BODY_STREAMING.md): its fallback drops a whole inspection
+dimension — body verdicts fail open to action 0 and requests are
+judged on metadata alone — because there is no cheaper oracle for
+body bytes the sidecar cannot scan. The demotion counter is the
+audit trail for that coverage loss.
 
 Caller protocol, per batch::
 
@@ -52,7 +58,7 @@ from typing import Callable, Optional
 
 from ..logging_utils import get_logger
 
-RUNGS = ("pipeline", "megastep", "dfa", "mesh", "device")
+RUNGS = ("pipeline", "megastep", "dfa", "mesh", "device", "body")
 
 # What each rung falls back TO (log/snapshot surface only).
 FALLBACKS = {
@@ -64,6 +70,10 @@ FALLBACKS = {
     "dfa": "nfa-scan",
     "mesh": "single-device",
     "device": "host-interpreter",
+    # ISSUE 13: a broken body scanner demotes the plane to
+    # metadata-only verdicts — body windows fail open (action 0) so
+    # held requests never stall; backoff probes re-arm inspection.
+    "body": "metadata-only",
 }
 
 log = get_logger(__name__)

@@ -38,6 +38,7 @@ from typing import Optional
 
 import h11
 
+from ..engine import bodyscan
 from ..engine.batch import RequestTuple
 from ..engine.service import VerdictService
 from ..expr import Context
@@ -163,6 +164,7 @@ class ListenerStats:
     blocked: int = 0
     captcha_served: int = 0
     fail_open: int = 0  # degraded verdicts served (engine fail-open)
+    body_fail_open: int = 0  # body scans degraded to metadata-only
     started_at: float = field(default_factory=time.time)
 
 
@@ -354,6 +356,21 @@ class HttpListener:
         # listener, labels disambiguate), the access-log sampler emits
         # trace-id-carrying structured lines.
         self._access_log = AccessLogSampler(name)
+        # Streaming body inspection (ISSUE 13, docs/BODY_STREAMING.md):
+        # the listener buffers whole bodies, but the scan still runs
+        # the SAME windowed chunk-carry engine the native plane's
+        # sidecar uses (bodyscan.scan_buffered), so one payload yields
+        # one verdict on both planes. Unlike the native plane, this
+        # covers h2 streams too (their bodies buffer through the same
+        # Request). A broken scanner fails open to metadata-only.
+        self._body_scanner = None
+        if bodyscan.body_inspect_enabled():
+            try:
+                self._body_scanner = bodyscan.BodyScanner()
+                self._body_scanner.attach_metrics("python")
+            except Exception:
+                self._body_scanner = None
+                self.stats.body_fail_open += 1
         REGISTRY.register_collector(self._export_metrics)
 
     def _export_metrics(self) -> None:
@@ -369,6 +386,11 @@ class HttpListener:
                 ("pingoo_fail_open_total", self.stats.fail_open)):
             REGISTRY.counter(name, obs_schema.SHARED_METRICS[name],
                              labels=lab).set_total(value)
+        REGISTRY.counter(
+            "pingoo_body_degrade_total",
+            obs_schema.BODY_METRICS["pingoo_body_degrade_total"],
+            labels={**lab, "reason": "ladder"},
+        ).set_total(self.stats.body_fail_open)
         uptime = time.time() - self.stats.started_at
         REGISTRY.gauge("pingoo_uptime_seconds", "listener uptime",
                        labels=lab).set(round(uptime, 1))
@@ -392,6 +414,8 @@ class HttpListener:
             await self._server.serve_forever()
 
     async def close(self) -> None:
+        if self._body_scanner is not None:
+            self._body_scanner.detach_metrics()
         REGISTRY.unregister_collector(self._export_metrics)
         if self._server is not None:
             self._server.close()
@@ -824,6 +848,20 @@ class HttpListener:
         if verdict.degraded:
             self.stats.fail_open += 1
         action = verdict.action_for(captcha_verified)
+        # Body-verdict merge (ISSUE 13): skipped when metadata alone
+        # already decides — the native plane aborts inspection on the
+        # same condition, so both planes scan the same set of requests.
+        if (action == 0 and req.body and not verdict.degraded
+                and self._body_scanner is not None):
+            bv = self._scan_body(req.body)
+            if bv is not None and not bv.degraded:
+                meta_byte = ((verdict.action & 0x3)
+                             | (0x4 if verdict.verified_block else 0))
+                merged = bodyscan.merge_actions(
+                    meta_byte, bv.unverified, bv.verified_block)
+                verdict.action = merged & 0x3
+                verdict.verified_block = bool(merged & 0x4)
+                action = verdict.action_for(captcha_verified)
         if action == 1:
             self.stats.blocked += 1
             return blocked_response()
@@ -851,6 +889,17 @@ class HttpListener:
             if routed:
                 return await service.handle(req, request_ctx)
         return not_found_response()
+
+    def _scan_body(self, payload: bytes):
+        """Run the buffered body through the windowed chunk-carry scan;
+        None (metadata-only, counted) on any scanner fault — inspection
+        fails open, never closed."""
+        try:
+            return self._body_scanner.scan_buffered(payload)
+        except Exception:
+            self.stats.body_fail_open += 1
+            self._body_scanner.flows.clear()  # no half-scanned carry
+            return None
 
     def _serve_captcha(self) -> Response:
         from .captcha import CAPTCHA_PAGE

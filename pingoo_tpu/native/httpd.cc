@@ -137,6 +137,18 @@ inline long long parse_max_body_bytes() {
 }
 const long long kMaxBodyBytes = parse_max_body_bytes();
 
+// Streaming request-body inspection (ISSUE 13, docs/BODY_STREAMING.md):
+// PINGOO_BODY_INSPECT=on streams h1 request bodies through the ring's
+// body slots so the sidecar can scan payloads across chunk boundaries;
+// the request holds until the body verdict merges with the metadata
+// verdict. off (the default) is the bit-exact status quo. Every error
+// path fails OPEN to metadata-only, never closed.
+inline bool parse_body_inspect() {
+  const char* e = getenv("PINGOO_BODY_INSPECT");
+  return e != nullptr && (strcmp(e, "on") == 0 || strcmp(e, "1") == 0);
+}
+const bool kBodyInspect = parse_body_inspect();
+
 // Buffering cap, env-tunable (PINGOO_MAX_BUFFER) so tests can exercise
 // the backpressure/re-pump paths without multi-MB payloads. Resolved
 // once at process start; out-of-range values warn and fall back.
@@ -1191,6 +1203,25 @@ struct Conn {
   bool captcha_verified = false;
   int requests_served = 0;
 
+  // Streaming body inspection (ISSUE 13, docs/BODY_STREAMING.md) — h1
+  // cycles only. The body de-frames through a SEPARATE scan framer so
+  // inbuf keeps the raw bytes for the normal post-verdict forwarding
+  // path; the body verdict ticket is the request ticket with bit 63
+  // set (PINGOO_BODY_VERDICT_BIT).
+  bool body_inspect = false;       // this cycle streams body windows
+  uint64_t body_flow = UINT64_MAX; // ring ticket doubling as the flow id
+  BodyFramer body_scan;            // de-framing copy (req_body untouched)
+  std::string body_win;            // de-framed payload pending a window
+  uint32_t body_win_seq = 0;       // next window sequence number
+  uint64_t body_total = 0;         // de-framed payload bytes seen so far
+  size_t body_raw_seen = 0;        // inbuf prefix already scan-framed
+  bool body_final_sent = false;    // FINAL window enqueued
+  uint64_t body_fin_ms = 0;        // monotonic ms at FINAL enqueue
+  bool meta_pending = false;       // meta verdict stashed, awaiting body
+  uint8_t meta_action = 0;         // stashed metadata verdict byte
+  bool body_verdict_done = false;  // body verdict byte landed
+  uint8_t body_action = 0;         // body verdict byte
+
   // upstream response
   std::string resp_head_buf;
   bool resp_head_done = false;
@@ -1962,6 +1993,7 @@ class Server {
       for (auto& kv : c->h2_streams)
         h2_release_stream_resources(c, kv.second);
       if (c->ticket != UINT64_MAX) awaiting_.erase(c->ticket);
+      body_abort(c);  // frees the sidecar flow + the demux entry
       conns_.erase(c);
       delete c;
     }
@@ -2000,7 +2032,9 @@ class Server {
     }
   }
 
-  bool awaiting_verdicts() const { return !awaiting_.empty(); }
+  bool awaiting_verdicts() const {
+    return !awaiting_.empty() || !body_awaiting_.empty();
+  }
 
   // -- metrics ---------------------------------------------------------------
   // The serving path must be observable where the traffic actually is
@@ -2019,6 +2053,15 @@ class Server {
     uint64_t upstream_tls_fail = 0;  // client handshake/verify failures
     uint64_t verdicts = 0;        // verdict bytes applied
     uint64_t degraded_entered = 0;  // degraded-mode transitions (enter)
+    // Streaming body inspection (ISSUE 13, PINGOO_BODY_INSPECT=on).
+    uint64_t body_flows = 0;      // h1 cycles armed for inspection
+    uint64_t body_windows = 0;    // body windows enqueued to the ring
+    uint64_t body_bytes = 0;      // de-framed payload bytes enqueued
+    uint64_t body_verdicts = 0;   // body verdict bytes consumed
+    uint64_t body_fail_open = 0;  // flows degraded to metadata-only
+                                  // (ring full / hold cap / deadline /
+                                  // degraded mode / bad framing)
+    uint64_t body_h2_skipped = 0; // h2 streams left metadata-only
     // log-scale verdict wait histogram (enqueue -> apply), upper bounds
     // in ms: 1, 2, 5, 10, 50, 100, 1000, +inf — the SHARED bucket set
     // (pingoo_tpu/obs/schema.py SHARED_WAIT_BUCKETS_MS); the JSON
@@ -2097,6 +2140,15 @@ class Server {
     kv_u64("degraded_entered", stats_.degraded_entered);
     kv_u64("sidecar_up", (sidecar_seen_ && !degraded_) ? 1 : 0);
     kv_u64("sidecar_epoch", sidecar_epoch_);
+    out += ", \"body\": {";
+    kv_u64("flows", stats_.body_flows, true);
+    kv_u64("windows", stats_.body_windows);
+    kv_u64("bytes", stats_.body_bytes);
+    kv_u64("verdicts", stats_.body_verdicts);
+    kv_u64("fail_open", stats_.body_fail_open);
+    kv_u64("h2_skipped", stats_.body_h2_skipped);
+    kv_u64("awaiting", body_awaiting_.size());
+    out += "}";
     out += ", \"ring\": {";
     kv_u64("enqueued", tel[0], true);
     kv_u64("enqueue_full", tel[1]);
@@ -2151,6 +2203,17 @@ class Server {
     metric("gauge", "pingoo_sidecar_epoch", sidecar_epoch_);
     metric("counter", "pingoo_degraded_entered_total",
            stats_.degraded_entered);
+    // Streaming body inspection (ISSUE 13, obs/schema.py BODY_METRICS;
+    // the carry-depth histogram is scanner-side and lives on the
+    // sidecar's exposition). Degrades carry the caller-side reasons.
+    metric("counter", "pingoo_body_windows_total", stats_.body_windows);
+    metric("counter", "pingoo_body_bytes_total", stats_.body_bytes);
+    metric("gauge", "pingoo_body_flows_active", body_awaiting_.size());
+    out += "# TYPE pingoo_body_degrade_total counter\n";
+    out += "pingoo_body_degrade_total{plane=\"native\",reason=\"fail_open\"} " +
+           std::to_string(stats_.body_fail_open) + "\n";
+    out += "pingoo_body_degrade_total{plane=\"native\",reason=\"h2\"} " +
+           std::to_string(stats_.body_h2_skipped) + "\n";
     metric("counter", "pingoo_ring_enqueued_total", tel[0]);
     metric("counter", "pingoo_ring_enqueue_full_total", tel[1]);
     metric("counter", "pingoo_ring_dequeued_total", tel[2]);
@@ -2431,7 +2494,12 @@ class Server {
         ev = EPOLLIN;
         break;
       case ConnState::kAwaitingVerdict:
-        ev = 0;
+        // Verdict quiesce — except under streaming body inspection
+        // (ISSUE 13), which keeps pulling body bytes (bounded by the
+        // hold cap) while the verdicts compute.
+        if (c->body_inspect && !c->body_final_sent && !c->client_eof &&
+            c->inbuf.size() < kMaxBuffered)
+          ev = EPOLLIN;
         break;
       case ConnState::kProxying:
         // Level-triggered epoll: a half-closed or backpressured client
@@ -3070,6 +3138,202 @@ class Server {
     if (c->req_body.done) c->req_body_forwarded = true;
   }
 
+  // -- streaming body inspection (ISSUE 13, docs/BODY_STREAMING.md) ----------
+  //
+  // With PINGOO_BODY_INSPECT=on, an h1 request whose head enqueued a
+  // ring ticket ALSO streams its de-framed body to the ring's body
+  // slots as bounded windows while the connection holds in
+  // kAwaitingVerdict (the verdict quiesce normally disarms client
+  // reads; inspection re-arms them under the kMaxBuffered hold cap).
+  // The raw bytes stay in inbuf untouched — the post-dispatch
+  // pump_request_body path forwards them exactly as before — so a
+  // failed inspection degrades coverage, never framing. The sidecar
+  // posts the flow's verdict on the SHARED verdict ring with bit 63
+  // set; apply_verdict holds a proxy-decided metadata verdict until it
+  // lands, then merges (engine/bodyscan.py merge_actions semantics).
+  // Every error path — body ring full, hold cap overflow, degraded
+  // mode, verdict deadline, malformed framing — fails OPEN to
+  // metadata-only verdicts. h2 client streams are not inspected this
+  // iteration (counted: body_h2_skipped).
+
+  // Twin of engine/bodyscan.py merge_actions: the metadata plane's
+  // nonzero unverified lane (bits 0-1) wins, verified-block (bit 2)
+  // ORs, route bits (3-7) ride the metadata verdict unchanged.
+  static uint8_t merge_body_action(uint8_t meta, uint8_t body) {
+    uint8_t unverified = (meta & 3) ? (meta & 3) : (body & 3);
+    return static_cast<uint8_t>((meta & 0xf8) | ((meta | body) & 4) |
+                                unverified);
+  }
+
+  // Reset inspection state and drop the verdict-demux entry.
+  void body_clear(Conn* c) {
+    if (c->body_flow != UINT64_MAX) body_awaiting_.erase(c->body_flow);
+    c->body_inspect = false;
+    c->body_flow = UINT64_MAX;
+    c->body_scan = BodyFramer();
+    c->body_win.clear();
+    c->body_win_seq = 0;
+    c->body_total = 0;
+    c->body_raw_seen = 0;
+    c->body_final_sent = false;
+    c->body_fin_ms = 0;
+    c->meta_pending = false;
+    c->meta_action = 0;
+    c->body_verdict_done = false;
+    c->body_action = 0;
+  }
+
+  // Tear down inspection; a best-effort ABORT window lets the sidecar
+  // free its per-flow carry state immediately instead of waiting out
+  // the flow TTL. Safe on conns that were never armed.
+  void body_abort(Conn* c) {
+    if (!c->body_inspect) return;
+    if (!c->body_final_sent)
+      pingoo_ring_enqueue_body(ring_, c->body_flow, c->body_win_seq,
+                               c->body_total, nullptr, 0,
+                               PINGOO_BODY_FLAG_ABORT);
+    body_clear(c);
+  }
+
+  // Stop inspecting this flow and unblock the request: the stashed
+  // metadata verdict (if any) applies alone — fail open, never stall.
+  void body_fail_open(Conn* c) {
+    stats_.body_fail_open++;
+    uint64_t ticket = c->body_flow;
+    bool meta = c->meta_pending;
+    uint8_t action = c->meta_action;
+    body_abort(c);
+    if (meta && !c->dead) apply_verdict(c, action, ticket);
+  }
+
+  // Degraded-mode entry: no sidecar is alive to answer FINAL windows.
+  void body_fail_open_all() {
+    if (body_awaiting_.empty()) return;
+    std::vector<Conn*> flows;
+    flows.reserve(body_awaiting_.size());
+    for (const auto& kv : body_awaiting_) flows.push_back(kv.second);
+    for (Conn* c : flows)
+      if (!c->dead && c->body_inspect) body_fail_open(c);
+  }
+
+  // Feed raw inbuf bytes past body_raw_seen through the scan framer,
+  // window the de-framed payload, and enqueue full windows. The framer
+  // stops at the message boundary, so pipelined next-request bytes are
+  // never scanned.
+  void body_scan_pump(Conn* c) {
+    if (!c->body_inspect || c->body_final_sent) return;
+    if (c->body_raw_seen < c->inbuf.size() && !c->body_scan.done) {
+      std::string payload;
+      size_t take = c->body_scan.consume(c->inbuf.data() + c->body_raw_seen,
+                                         c->inbuf.size() - c->body_raw_seen,
+                                         &payload);
+      c->body_raw_seen += take;
+      if (!payload.empty()) {
+        c->body_win.append(payload);
+        c->body_total += payload.size();
+      }
+    }
+    if (c->body_scan.bad) {
+      // Malformed framing: the real framer hits the same bytes after
+      // dispatch and closes the connection — just stop inspecting.
+      body_fail_open(c);
+      return;
+    }
+    while (c->body_inspect &&
+           (c->body_win.size() >= PINGOO_BODY_WINDOW_CAP ||
+            (c->body_scan.done && !c->body_final_sent))) {
+      uint32_t n = static_cast<uint32_t>(
+          std::min<size_t>(c->body_win.size(), PINGOO_BODY_WINDOW_CAP));
+      bool fin = c->body_scan.done && n == c->body_win.size();
+      int rc = pingoo_ring_enqueue_body(
+          ring_, c->body_flow, c->body_win_seq, c->body_total,
+          c->body_win.data(), n, fin ? PINGOO_BODY_FLAG_FINAL : 0);
+      if (rc != 0) {  // body ring full: degrade this flow
+        body_fail_open(c);
+        return;
+      }
+      c->body_win_seq++;
+      stats_.body_windows++;
+      stats_.body_bytes += n;
+      c->body_win.erase(0, n);
+      if (fin) {
+        c->body_final_sent = true;
+        c->body_fin_ms = now_ms();
+      }
+    }
+  }
+
+  // Arm inspection for this h1 cycle: the head already enqueued the
+  // ring ticket (flow id), pipelined body bytes may already sit in
+  // inbuf. Called only under kBodyInspect && !degraded_.
+  void body_arm(Conn* c) {
+    c->body_inspect = true;
+    c->body_flow = c->ticket;
+    if (c->req.chunked) c->body_scan.reset_chunked();
+    else c->body_scan.reset_cl(c->req.content_length);
+    body_awaiting_[c->body_flow] = c;
+    stats_.body_flows++;
+    body_scan_pump(c);
+    // EOF already seen with the body incomplete: it can never finish.
+    if (c->body_inspect && c->client_eof && !c->body_scan.done)
+      body_fail_open(c);
+  }
+
+  // Client readable while kAwaitingVerdict with inspection armed: pull
+  // body bytes into inbuf (they stay there for the post-verdict pump)
+  // and stream windows. Distinct from on_client_readable: no head
+  // parsing, and the hold cap fails inspection open instead of closing
+  // the connection.
+  void on_body_readable(Conn* c) {
+    c->last_active = now_;
+    char buf[16384];
+    while (c->body_inspect && !c->body_final_sent &&
+           c->inbuf.size() < kMaxBuffered) {
+      ssize_t r = t_read(c, buf, sizeof(buf));
+      if (r > 0) {
+        c->inbuf.append(buf, static_cast<size_t>(r));
+        body_scan_pump(c);
+      } else if (r == 0) {
+        c->client_eof = true;
+        if (c->body_inspect && !c->body_scan.done) body_fail_open(c);
+        break;
+      } else if (r == -1) {
+        break;
+      } else {
+        mark_close(c);
+        return;
+      }
+    }
+    // Hold cap reached with the body still incomplete: the remainder
+    // cannot buffer pre-verdict — degrade and let the proxy
+    // backpressure gates stream it after dispatch.
+    if (c->body_inspect && !c->body_scan.done &&
+        c->inbuf.size() >= kMaxBuffered)
+      body_fail_open(c);
+    if (!c->dead) update_client_events(c);
+  }
+
+  // A bit-63 verdict from the shared ring: record it; if the metadata
+  // verdict is already stashed, merge and finish the request.
+  void on_body_verdict(uint64_t flow, uint8_t action) {
+    auto it = body_awaiting_.find(flow);
+    if (it == body_awaiting_.end()) return;  // died / degraded meanwhile
+    Conn* c = it->second;
+    body_awaiting_.erase(it);
+    if (c->dead || !c->body_inspect) return;
+    stats_.body_verdicts++;
+    c->body_verdict_done = true;
+    c->body_action = action;
+    c->body_flow = UINT64_MAX;  // demux entry gone
+    if (c->meta_pending) {
+      uint8_t meta = c->meta_action;
+      c->meta_pending = false;
+      apply_verdict(c, meta, flow);  // merges via body_verdict_done
+    }
+    // else: the metadata verdict is still in flight; apply_verdict
+    // merges when it lands.
+  }
+
   // -- verdict flow ---------------------------------------------------------
 
   void drain_verdicts() {
@@ -3077,6 +3341,10 @@ class Server {
     uint8_t action;
     float score;
     while (pingoo_ring_poll_verdict(ring_, &ticket, &action, &score) == 0) {
+      if (ticket & PINGOO_BODY_VERDICT_BIT) {
+        on_body_verdict(ticket & ~PINGOO_BODY_VERDICT_BIT, action);
+        continue;
+      }
       auto it = awaiting_.find(ticket);
       if (it == awaiting_.end()) continue;  // connection died meanwhile
       Conn* c = it->second.conn;
@@ -3122,12 +3390,14 @@ class Server {
       h2_flush(c);
     } else {
       c->ticket = UINT64_MAX;
+      body_abort(c);  // dispatching without a verdict: stop inspecting
       flight_record(c->req, ticket, c->enq_ms, 0, 3);
       fail_open_proxy(c);
     }
   }
 
   void sweep_verdict_deadlines() {
+    sweep_body_deadlines();
     if (awaiting_.empty()) return;
     uint64_t now = now_ms();
     if (now == last_deadline_sweep_ms_) return;  // at most one pass per ms
@@ -3155,6 +3425,23 @@ class Server {
       if (aw.conn->dead) continue;
       fail_open_ticket(aw.conn, aw.sid, ticket);
     }
+  }
+
+  // A request whose metadata verdict already said "proxy" is blocked
+  // solely on the body verdict once its FINAL window is enqueued; the
+  // same kVerdictTimeoutMs budget bounds that wait (ISSUE 13).
+  void sweep_body_deadlines() {
+    if (body_awaiting_.empty()) return;
+    uint64_t now = now_ms();
+    body_expired_.clear();
+    for (const auto& kv : body_awaiting_) {
+      Conn* c = kv.second;
+      if (c->meta_pending && c->body_fin_ms != 0 &&
+          now - c->body_fin_ms > kVerdictTimeoutMs)
+        body_expired_.push_back(c);
+    }
+    for (Conn* c : body_expired_)
+      if (!c->dead && c->body_inspect) body_fail_open(c);
   }
 
   void fail_open_all_awaiting() {
@@ -3193,6 +3480,7 @@ class Server {
                    awaiting_.size());
       flight_record_transition("degraded-enter");
       fail_open_all_awaiting();
+      body_fail_open_all();  // no sidecar will answer FINAL windows
     } else if (!stale && degraded_) {
       degraded_ = false;
       std::fprintf(stderr,
@@ -3219,6 +3507,24 @@ class Server {
   // (http_listener.rs:251-264). Applies to the h1 cycle or the h2
   // connection's active stream.
   void apply_verdict(Conn* c, uint8_t action, uint64_t ticket = UINT64_MAX) {
+    if (c->body_inspect) {
+      if (c->body_verdict_done) {
+        action = merge_body_action(action, c->body_action);
+        body_clear(c);
+      } else {
+        uint8_t meta_decided =
+            c->captcha_verified ? ((action & 4) ? 1 : 0) : (action & 3);
+        if (meta_decided == 0) {
+          // Metadata says proxy: hold the request until the body
+          // verdict (or its fail-open) completes the picture; body
+          // windows keep streaming meanwhile.
+          c->meta_pending = true;
+          c->meta_action = action;
+          return;
+        }
+        body_abort(c);  // metadata alone decides: cancel inspection
+      }
+    }
     stats_.verdicts++;
     if (c->enq_ms) record_wait(now_ms() - c->enq_ms);
     uint8_t decided;  // 0 proxy, 1 block, 2 captcha
@@ -3260,6 +3566,7 @@ class Server {
   // -- request cycle --------------------------------------------------------
 
   void begin_request_cycle(Conn* c) {
+    body_abort(c);  // stray inspection state never crosses cycles
     c->state = ConnState::kReadingHead;
     c->req = Parsed();
     c->req_body = BodyFramer();
@@ -3421,6 +3728,9 @@ class Server {
         return;
       case Policy::kAwaitVerdict:
         c->state = ConnState::kAwaitingVerdict;
+        // Streaming body inspection (ISSUE 13): a body-bearing request
+        // also streams windows to the sidecar while it holds here.
+        if (kBodyInspect && !degraded_ && !c->req_body.done) body_arm(c);
         update_client_events(c);  // quiesce until the verdict arrives
         return;
     }
@@ -3655,6 +3965,13 @@ class Server {
                   flightrecorder_json());
         continue;
       }
+      // h2 client streams are not body-inspected this iteration
+      // (ISSUE 13, docs/BODY_STREAMING.md): DATA can arrive after the
+      // stream dispatches, so a held-verdict design needs per-stream
+      // flow accounting first. Counted, metadata-only.
+      if (kBodyInspect &&
+          (!it->second.body.empty() || !it->second.complete))
+        stats_.body_h2_skipped++;
       Policy outcome = run_policy(c, sid);
       switch (outcome) {
         case Policy::kBlock:
@@ -5076,7 +5393,8 @@ class Server {
         }
         break;
       case ConnState::kAwaitingVerdict:
-        if (events & (EPOLLHUP | EPOLLERR)) mark_close(c);
+        if ((events & EPOLLIN) && c->body_inspect) on_body_readable(c);
+        if (!c->dead && (events & (EPOLLHUP | EPOLLERR))) mark_close(c);
         break;
       case ConnState::kProxying:
         if (events & (EPOLLHUP | EPOLLERR)) {
@@ -5152,6 +5470,10 @@ class Server {
     int32_t sid;  // 0 = the h1 request cycle, else an h2 stream
   };
   std::unordered_map<uint64_t, Awaiting> awaiting_;
+  // Streaming body inspection (ISSUE 13): flow id (= the plain ring
+  // ticket) -> inspecting conn, for bit-63 verdict demux.
+  std::unordered_map<uint64_t, Conn*> body_awaiting_;
+  std::vector<Conn*> body_expired_;  // sweep_body_deadlines scratch
   // Sidecar supervision state (ISSUE 10, docs/RESILIENCE.md).
   bool degraded_ = false;        // heartbeat stale: bypass the ring
   bool sidecar_seen_ = false;    // a sidecar heartbeat has ever landed

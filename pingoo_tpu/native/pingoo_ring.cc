@@ -45,6 +45,7 @@ struct Layout {
   PingooRequestSlot* req;
   PingooVerdictSlot* ver;
   PingooSpillSlot* spill;
+  PingooBodySlot* body;
 };
 
 Layout layout(void* mem, uint32_t capacity) {
@@ -56,6 +57,9 @@ Layout layout(void* mem, uint32_t capacity) {
       reinterpret_cast<char*>(l.req) + sizeof(PingooRequestSlot) * capacity);
   l.spill = reinterpret_cast<PingooSpillSlot*>(
       reinterpret_cast<char*>(l.ver) + sizeof(PingooVerdictSlot) * capacity);
+  l.body = reinterpret_cast<PingooBodySlot*>(
+      reinterpret_cast<char*>(l.spill) +
+      sizeof(PingooSpillSlot) * PINGOO_SPILL_SLOTS);
   return l;
 }
 
@@ -89,7 +93,8 @@ extern "C" {
 size_t pingoo_ring_bytes(uint32_t capacity) {
   return sizeof(PingooRingHeader) +
          capacity * (sizeof(PingooRequestSlot) + sizeof(PingooVerdictSlot)) +
-         PINGOO_SPILL_SLOTS * sizeof(PingooSpillSlot);
+         PINGOO_SPILL_SLOTS * sizeof(PingooSpillSlot) +
+         PINGOO_BODY_SLOTS * sizeof(PingooBodySlot);
 }
 
 void pingoo_ring_init(void* mem, uint32_t capacity) {
@@ -100,10 +105,14 @@ void pingoo_ring_init(void* mem, uint32_t capacity) {
   l.header->capacity = capacity;
   l.header->request_slot_size = sizeof(PingooRequestSlot);
   l.header->verdict_slot_size = sizeof(PingooVerdictSlot);
+  l.header->body_slot_size = sizeof(PingooBodySlot);
+  l.header->body_capacity = PINGOO_BODY_SLOTS;
   for (uint32_t i = 0; i < capacity; ++i) {
     as_atomic(&l.req[i].seq)->store(i, std::memory_order_relaxed);
     as_atomic(&l.ver[i].seq)->store(i, std::memory_order_relaxed);
   }
+  for (uint32_t i = 0; i < PINGOO_BODY_SLOTS; ++i)
+    as_atomic(&l.body[i].seq)->store(i, std::memory_order_relaxed);
   std::atomic_thread_fence(std::memory_order_seq_cst);
 }
 
@@ -112,7 +121,9 @@ int pingoo_ring_attach(void* mem, uint32_t* capacity_out) {
   if (header->magic != PINGOO_RING_MAGIC ||
       header->version != PINGOO_RING_VERSION ||
       header->request_slot_size != sizeof(PingooRequestSlot) ||
-      header->verdict_slot_size != sizeof(PingooVerdictSlot)) {
+      header->verdict_slot_size != sizeof(PingooVerdictSlot) ||
+      header->body_slot_size != sizeof(PingooBodySlot) ||
+      header->body_capacity != PINGOO_BODY_SLOTS) {
     return -1;
   }
   if (capacity_out) *capacity_out = header->capacity;
@@ -434,6 +445,70 @@ int pingoo_ring_poll_verdict(void* mem, uint64_t* ticket_out,
       return -1;  // empty
     }
   }
+}
+
+// -- Body-window ring (v6, ISSUE 13) -----------------------------------------
+
+int pingoo_ring_enqueue_body(void* mem, uint64_t flow, uint32_t win_seq,
+                             uint64_t total_len, const char* data,
+                             uint32_t len, uint8_t flags) {
+  if (len > PINGOO_BODY_WINDOW_CAP) return -2;
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  Layout l = layout(mem, header->capacity);
+  auto* head = as_atomic(&header->body_head);
+  const uint32_t bcap = PINGOO_BODY_SLOTS;
+
+  uint64_t pos = head->load(std::memory_order_relaxed);
+  for (;;) {
+    PingooBodySlot* slot = &l.body[pos & (bcap - 1)];
+    uint64_t seq = as_atomic(&slot->seq)->load(std::memory_order_acquire);
+    intptr_t diff = static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos);
+    if (diff == 0) {
+      if (head->compare_exchange_weak(pos, pos + 1,
+                                      std::memory_order_relaxed)) {
+        slot->flow = flow;
+        slot->win_seq = win_seq;
+        slot->win_len = len;
+        slot->total_len = total_len;
+        slot->flags = flags;
+        if (len) std::memcpy(slot->data, data, len);
+        as_atomic(&slot->seq)->store(pos + 1, std::memory_order_release);
+        return 0;
+      }
+    } else if (diff < 0) {
+      return -1;  // full: producer fails the flow open to metadata-only
+    } else {
+      pos = head->load(std::memory_order_relaxed);
+    }
+  }
+}
+
+uint32_t pingoo_ring_dequeue_bodies(void* mem, PingooBodySlot* out,
+                                    uint32_t max) {
+  auto* header = static_cast<PingooRingHeader*>(mem);
+  Layout l = layout(mem, header->capacity);
+  auto* tail = as_atomic(&header->body_tail);
+  const uint32_t bcap = PINGOO_BODY_SLOTS;
+
+  uint32_t count = 0;
+  while (count < max) {
+    uint64_t pos = tail->load(std::memory_order_relaxed);
+    PingooBodySlot* slot = &l.body[pos & (bcap - 1)];
+    uint64_t seq = as_atomic(&slot->seq)->load(std::memory_order_acquire);
+    intptr_t diff =
+        static_cast<intptr_t>(seq) - static_cast<intptr_t>(pos + 1);
+    if (diff == 0) {
+      if (tail->compare_exchange_weak(pos, pos + 1,
+                                      std::memory_order_relaxed)) {
+        std::memcpy(&out[count], slot, sizeof(PingooBodySlot));
+        as_atomic(&slot->seq)->store(pos + bcap, std::memory_order_release);
+        ++count;
+      }
+    } else {
+      break;  // empty
+    }
+  }
+  return count;
 }
 
 }  // extern "C"

@@ -50,7 +50,14 @@ extern "C" {
 // PINGOO_SIDECAR_TIMEOUT_MS), and posted_floor (all tickets below it
 // have verdicts posted — the crash-reattach reconciliation scans
 // [posted_floor, req_tail) for orphans).
-#define PINGOO_RING_VERSION 5u
+// v6: body-window ring (ISSUE 13 streaming body inspection). A third
+// Vyukov ring of fixed-count bounded slots carries de-framed request
+// body bytes as (flow ticket, win_seq, FINAL/ABORT flags) windows from
+// the data plane to the sidecar; body verdicts ride the EXISTING
+// verdict ring with PINGOO_BODY_VERDICT_BIT set in the ticket. The
+// header gains body_slot_size/body_capacity up front and a
+// body_head/body_tail cache-line pair at the end.
+#define PINGOO_RING_VERSION 6u
 
 #define PINGOO_METHOD_CAP 16
 #define PINGOO_HOST_CAP 256
@@ -78,6 +85,34 @@ typedef struct {
   uint32_t path_len;
   char data[PINGOO_SPILL_DATA_CAP];  // url bytes then path bytes
 } PingooSpillSlot;
+
+// Body-window ring (v6, ISSUE 13): the data plane streams each request
+// body as bounded windows of DE-FRAMED payload bytes (chunked TE
+// already decoded) tagged with the owning request ticket and a per-flow
+// sequence number, so the sidecar threads NFA/DFA carry state across
+// windows (engine/bodyscan.py) and a payload split across DATA frames
+// matches bit-identically to the contiguous scan. Fixed slot count —
+// independent of the request-ring capacity — bounds the in-flight body
+// bytes at PINGOO_BODY_SLOTS * PINGOO_BODY_WINDOW_CAP = 1 MiB.
+#define PINGOO_BODY_SLOTS 256u
+#define PINGOO_BODY_WINDOW_CAP 4096u
+#define PINGOO_BODY_FLAG_FINAL 0x1u  // last window of the flow
+#define PINGOO_BODY_FLAG_ABORT 0x2u  // flow died (client reset): drop state
+// Body verdicts share the verdict ring: the sidecar posts them with
+// this bit set in the ticket so the data plane demuxes meta vs body
+// verdicts without a second return ring.
+#define PINGOO_BODY_VERDICT_BIT 0x8000000000000000ull
+
+typedef struct {
+  PINGOO_ALIGN8 uint64_t seq;  // Vyukov slot sequence
+  uint64_t flow;               // request ticket that owns this body
+  uint32_t win_seq;            // 0-based window index within the flow
+  uint32_t win_len;            // payload bytes in data[]
+  uint64_t total_len;          // body bytes up to + including this window
+  uint8_t flags;               // PINGOO_BODY_FLAG_*
+  uint8_t _pad[7];
+  char data[PINGOO_BODY_WINDOW_CAP];
+} PingooBodySlot;
 
 typedef struct {
   // Vyukov slot sequence: slot is writable when seq == pos, readable
@@ -145,10 +180,11 @@ typedef struct {
 typedef struct {
   uint32_t magic;
   uint32_t version;
-  uint32_t capacity;  // power of two, same for both rings
+  uint32_t capacity;  // power of two, same for request+verdict rings
   uint32_t request_slot_size;
   uint32_t verdict_slot_size;
-  uint32_t _pad;
+  uint32_t body_slot_size;  // sizeof(PingooBodySlot) (v6)
+  uint32_t body_capacity;   // PINGOO_BODY_SLOTS (v6)
   PINGOO_ALIGN64 uint64_t req_head;  // producer ticket counter
   PINGOO_ALIGN64 uint64_t req_tail;  // consumer counter
   PINGOO_ALIGN64 uint64_t ver_head;
@@ -159,6 +195,10 @@ typedef struct {
   PINGOO_ALIGN64 uint64_t sidecar_epoch;   // bumped on sidecar attach
   uint64_t sidecar_heartbeat_ms;           // pingoo_ring_now_ms stamp
   uint64_t posted_floor;                   // tickets < floor have verdicts
+  // Body-window ring counters (v6): their own cache lines, same
+  // single-producer/single-consumer contention split as req/ver.
+  PINGOO_ALIGN64 uint64_t body_head;
+  PINGOO_ALIGN64 uint64_t body_tail;
 } PingooRingHeader;
 
 // Size of the full mapping for a given capacity.
@@ -195,6 +235,18 @@ uint32_t pingoo_ring_post_verdicts(void* mem, const uint64_t* tickets,
 // Poll one verdict; returns 0 on success, -1 if empty.
 int pingoo_ring_poll_verdict(void* mem, uint64_t* ticket_out,
                              uint8_t* action_out, float* score_out);
+
+// Enqueue one body window (v6). `len` must be <= PINGOO_BODY_WINDOW_CAP
+// (-2 otherwise); returns 0 on success, -1 when the body ring is full —
+// the producer then fails the flow open to metadata-only verdicts
+// rather than stalling the event loop.
+int pingoo_ring_enqueue_body(void* mem, uint64_t flow, uint32_t win_seq,
+                             uint64_t total_len, const char* data,
+                             uint32_t len, uint8_t flags);
+
+// Dequeue up to `max` body windows into `out`; returns the count.
+uint32_t pingoo_ring_dequeue_bodies(void* mem, PingooBodySlot* out,
+                                    uint32_t max);
 
 // Read a claimed spill slot's full strings. Returns 0 on success and
 // fills the pointers/lengths (data stays valid until release).

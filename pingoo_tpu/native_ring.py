@@ -44,13 +44,21 @@ SPILL_NONE = 0xFF  # PINGOO_SPILL_NONE
 # table (tools/analyze/abi_golden.json). Change the header, the dtypes,
 # and the golden together or the check fails.
 
-RING_FORMAT_VERSION = 5  # PINGOO_RING_VERSION
+RING_FORMAT_VERSION = 6  # PINGOO_RING_VERSION
 REQUEST_SLOT_SIZE = 4688  # sizeof(PingooRequestSlot)
 VERDICT_SLOT_SIZE = 24  # sizeof(PingooVerdictSlot)
-RING_HEADER_SIZE = 512  # sizeof(PingooRingHeader)
+RING_HEADER_SIZE = 640  # sizeof(PingooRingHeader)
 TELEMETRY_BLOCK_SIZE = 128  # sizeof(PingooRingTelemetry)
 SPILL_SLOT_SIZE = 65552  # sizeof(PingooSpillSlot)
 WAIT_BUCKETS = 8  # PINGOO_WAIT_BUCKETS
+BODY_SLOTS = 256  # PINGOO_BODY_SLOTS (v6 body-window ring)
+BODY_WINDOW_CAP = 4096  # PINGOO_BODY_WINDOW_CAP
+BODY_SLOT_SIZE = 4136  # sizeof(PingooBodySlot)
+BODY_FLAG_FINAL = 0x1  # PINGOO_BODY_FLAG_FINAL
+BODY_FLAG_ABORT = 0x2  # PINGOO_BODY_FLAG_ABORT
+# Body verdicts ride the shared verdict ring with this bit set in the
+# ticket (PINGOO_BODY_VERDICT_BIT) so the data plane demuxes them.
+BODY_VERDICT_BIT = 1 << 63
 
 # numpy mirror of PingooRequestSlot. The explicit itemsize carries the
 # C struct's 8-byte tail padding (4684 -> 4688) so a whole dequeued
@@ -99,16 +107,20 @@ TELEMETRY_DTYPE = np.dtype({
 
 # numpy mirror of PingooRingHeader (cache-line-aligned counters; the
 # v5 liveness block — sidecar_epoch / sidecar_heartbeat_ms /
-# posted_floor — rides its own cache line after the telemetry block).
+# posted_floor — rides its own cache line after the telemetry block;
+# the v6 body-window ring adds body_slot_size/body_capacity up front
+# and a body_head/body_tail cache-line pair at the end).
 RING_HEADER_DTYPE = np.dtype({
     "names": ["magic", "version", "capacity", "request_slot_size",
-              "verdict_slot_size", "_pad", "req_head", "req_tail",
-              "ver_head", "ver_tail", "telemetry", "sidecar_epoch",
-              "sidecar_heartbeat_ms", "posted_floor"],
-    "formats": ["<u4", "<u4", "<u4", "<u4", "<u4", "<u4", "<u8", "<u8",
-                "<u8", "<u8", TELEMETRY_DTYPE, "<u8", "<u8", "<u8"],
-    "offsets": [0, 4, 8, 12, 16, 20, 64, 128, 192, 256, 320, 448, 456,
-                464],
+              "verdict_slot_size", "body_slot_size", "body_capacity",
+              "req_head", "req_tail", "ver_head", "ver_tail",
+              "telemetry", "sidecar_epoch", "sidecar_heartbeat_ms",
+              "posted_floor", "body_head", "body_tail"],
+    "formats": ["<u4", "<u4", "<u4", "<u4", "<u4", "<u4", "<u4", "<u8",
+                "<u8", "<u8", "<u8", TELEMETRY_DTYPE, "<u8", "<u8",
+                "<u8", "<u8", "<u8"],
+    "offsets": [0, 4, 8, 12, 16, 20, 24, 64, 128, 192, 256, 320, 448,
+                456, 464, 512, 576],
     "itemsize": RING_HEADER_SIZE,
 })
 
@@ -120,11 +132,24 @@ SPILL_SLOT_DTYPE = np.dtype({
     "itemsize": SPILL_SLOT_SIZE,
 })
 
+# numpy mirror of PingooBodySlot (v6 body-window ring): a whole
+# dequeued window batch decodes with one structured view, same as the
+# request slots.
+BODY_SLOT_DTYPE = np.dtype({
+    "names": ["seq", "flow", "win_seq", "win_len", "total_len", "flags",
+              "_pad", "data"],
+    "formats": ["<u8", "<u8", "<u4", "<u4", "<u8", "u1", ("u1", 7),
+                ("u1", BODY_WINDOW_CAP)],
+    "offsets": [0, 8, 16, 20, 24, 32, 33, 40],
+    "itemsize": BODY_SLOT_SIZE,
+})
+
 for _dt, _size in ((REQUEST_SLOT_DTYPE, REQUEST_SLOT_SIZE),
                    (VERDICT_SLOT_DTYPE, VERDICT_SLOT_SIZE),
                    (TELEMETRY_DTYPE, TELEMETRY_BLOCK_SIZE),
                    (RING_HEADER_DTYPE, RING_HEADER_SIZE),
-                   (SPILL_SLOT_DTYPE, SPILL_SLOT_SIZE)):
+                   (SPILL_SLOT_DTYPE, SPILL_SLOT_SIZE),
+                   (BODY_SLOT_DTYPE, BODY_SLOT_SIZE)):
     assert _dt.itemsize == _size, (_dt, _dt.itemsize, _size)
 del _dt, _size
 
@@ -181,6 +206,15 @@ def _load_lib():
     lib.pingoo_ring_poll_verdict.argtypes = [
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
         ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_float)]
+    # Body-window ring (v6, ISSUE 13).
+    lib.pingoo_ring_enqueue_body.restype = ctypes.c_int
+    lib.pingoo_ring_enqueue_body.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32,
+        ctypes.c_uint8]
+    lib.pingoo_ring_dequeue_bodies.restype = ctypes.c_uint32
+    lib.pingoo_ring_dequeue_bodies.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint32]
     lib.pingoo_ring_spill_read.restype = ctypes.c_int
     lib.pingoo_ring_spill_read.argtypes = [
         ctypes.c_void_p, ctypes.c_uint8,
@@ -235,9 +269,11 @@ class Ring:
             raise RuntimeError("ring attach failed (layout mismatch?)")
         self.capacity = int(cap_out.value)
         self._scratch = np.zeros(self.capacity, dtype=REQUEST_SLOT_DTYPE)
+        self._body_scratch = None  # allocated on first dequeue_bodies
 
     def close(self) -> None:
         self._scratch = None
+        self._body_scratch = None
         self.map.close()
         os.close(self.fd)
 
@@ -339,6 +375,32 @@ class Ring:
                 ctypes.byref(score)) != 0:
             return None
         return int(ticket.value), int(action.value), float(score.value)
+
+    # -- body-window ring (v6, docs/BODY_STREAMING.md) ------------------------
+
+    def enqueue_body(self, flow: int, win_seq: int, data: bytes,
+                     total_len: int, flags: int = 0) -> bool:
+        """Enqueue one de-framed body window for `flow` (the request
+        ticket). False when the body ring is full — the producer then
+        fails the flow open to metadata-only rather than stalling."""
+        rc = self.lib.pingoo_ring_enqueue_body(
+            self.addr, flow, win_seq, total_len, data, len(data), flags)
+        if rc == -2:
+            raise ValueError(
+                f"body window of {len(data)} bytes exceeds the "
+                f"{BODY_WINDOW_CAP}-byte slot cap")
+        return rc == 0
+
+    def dequeue_bodies(self, max_batch: int = BODY_SLOTS) -> np.ndarray:
+        """-> structured BODY_SLOT_DTYPE array of dequeued windows."""
+        if self._body_scratch is None:
+            self._body_scratch = np.zeros(BODY_SLOTS,
+                                          dtype=BODY_SLOT_DTYPE)
+        n = self.lib.pingoo_ring_dequeue_bodies(
+            self.addr,
+            self._body_scratch.ctypes.data_as(ctypes.c_void_p),
+            min(max_batch, BODY_SLOTS))
+        return self._body_scratch[:n].copy()
 
     # -- liveness / supervision protocol (ring v5, docs/RESILIENCE.md) -------
 
@@ -619,6 +681,25 @@ class RingSidecar:
         from .engine.ladder import DegradationLadder
 
         self.ladder = DegradationLadder("sidecar")
+        # Streaming body inspection (ISSUE 13, docs/BODY_STREAMING.md):
+        # when PINGOO_BODY_INSPECT=on the sidecar drains the v6
+        # body-window ring each cycle, threads NFA/DFA carry state
+        # across windows (engine/bodyscan.py), and posts body verdicts
+        # on the SAME verdict ring tagged BODY_VERDICT_BIT. Off (the
+        # default) the drain is skipped entirely — bit-exact status
+        # quo. A scanner fault demotes the ladder's "body" rung:
+        # windows fail open to metadata-only until a probe recovers.
+        from .engine import bodyscan as _bodyscan
+
+        self._bodyscan_mod = _bodyscan
+        self._body_scan = None
+        self.body_verdicts = 0
+        if _bodyscan.body_inspect_enabled():
+            try:
+                self._body_scan = _bodyscan.BodyScanner()
+                self._body_scan.attach_metrics("sidecar")
+            except Exception as exc:
+                self.ladder.note_failure("body", exc)
         # The C++ plane has no mmdb decoder: it enqueues slots with
         # asn=0 / country="XX" (its unknown markers). The reference
         # resolves geoip per request in the listener
@@ -1082,6 +1163,11 @@ class RingSidecar:
             if not self.chaos.heartbeat_frozen():
                 for r in self.rings:
                     r.heartbeat()
+            # Body-window drain (ISSUE 13): before the request drain so
+            # a flow's body verdict never waits a full cycle behind the
+            # metadata batch that admitted it.
+            if self._body_scan is not None:
+                self._drain_bodies()
             # Ruleset hot-swap boundary (ISSUE 11). The swap-storm
             # chaos rung re-requests the CURRENT plan so any verdict
             # drift it produces is a swap-protocol bug by construction
@@ -1194,6 +1280,10 @@ class RingSidecar:
             inflight.append(self._launch_megastep())
         while inflight:
             self._complete_inflight(inflight.popleft())
+        # Final body drain: FINAL windows already in the ring still get
+        # verdicts (else their held requests eat the fail-open timeout).
+        if self._body_scan is not None:
+            self._drain_bodies()
         # A swap that never reached a batch boundary before shutdown is
         # rejected, not leaked: wake its requester.
         with self._swap_lock:
@@ -1207,6 +1297,52 @@ class RingSidecar:
                                result="rejected",
                                error=RuntimeError("sidecar stopped"))
         return self.processed
+
+    def _drain_bodies(self) -> None:
+        """Drain each ring's body-window ring through the streaming
+        scanner and post per-flow body verdicts back on that ring's
+        verdict ring, ticket-tagged with BODY_VERDICT_BIT. On the
+        ladder's demoted "body" rung (or a scanner fault) every FINAL
+        window fails open (action 0, metadata-only) so the data plane's
+        held requests never stall on a broken scanner."""
+        bs = self._bodyscan_mod
+        for r in self.rings:
+            slots = r.dequeue_bodies()
+            if not len(slots):
+                continue
+            windows = [bs.BodyWindow(
+                flow_id=int(s["flow"]), win_seq=int(s["win_seq"]),
+                data=s["data"][:int(s["win_len"])].tobytes(),
+                final=bool(s["flags"] & BODY_FLAG_FINAL),
+                abort=bool(s["flags"] & BODY_FLAG_ABORT))
+                for s in slots]
+            verdicts = None
+            if self.ladder.try_rung("body"):
+                try:
+                    # Busy window: the first scan per pow2 row bucket
+                    # compiles the chunk kernels.
+                    with self._hb_busy():
+                        verdicts = self._body_scan.scan_windows(windows)
+                    self.ladder.note_success("body")
+                except Exception as exc:
+                    self.ladder.note_failure("body", exc)
+                    # Carry state is suspect after a mid-scan fault:
+                    # drop every live flow (their FINAL windows fail
+                    # open below or at the data plane's body sweep).
+                    self._body_scan.flows.clear()
+                    verdicts = None
+            if verdicts is None:
+                verdicts = [bs.BodyVerdict(w.flow_id, degraded=True)
+                            for w in windows if w.final]
+            for v in verdicts:
+                ticket = v.flow_id | BODY_VERDICT_BIT
+                action = 0 if v.degraded else v.action_byte()
+                while not r.post_verdict(ticket, action):
+                    if self._stop:
+                        return
+                    time.sleep(self.idle_sleep_s)
+                self.body_verdicts += 1
+        self._body_scan.evict_stale()
 
     def _take_slot_buf(self) -> np.ndarray:
         """One pooled REQUEST_SLOT_DTYPE accumulation buffer (pipeline

@@ -220,8 +220,8 @@ RESILIENCE_METRICS = {
         "by action (reeval = slot bytes intact, re-evaluated; "
         "failopen = bytes recycled, allow posted)",
     "pingoo_degrade_total":
-        "degradation-ladder demotions by rung (pipeline|dfa|mesh|"
-        "device; engine/ladder.py)",
+        "degradation-ladder demotions by rung (pipeline|megastep|dfa|"
+        "mesh|device|body; engine/ladder.py)",
     "pingoo_chaos_injected_total":
         "faults injected by the PINGOO_CHAOS harness, by fault "
         "(obs/chaos.py; absent in production)",
@@ -247,6 +247,30 @@ HOTSWAP_METRICS = {
     "pingoo_fuzz_discrepancy_total":
         "differential-fuzzer parse discrepancies by class (not a "
         "documented known-delta; tools/analyze/fuzz.py)",
+}
+
+# Streaming body-inspection metrics (ISSUE 13, docs/BODY_STREAMING.md
+# / docs/OBSERVABILITY.md). Exported by BOTH planes when
+# PINGOO_BODY_INSPECT=on: the sidecar (plane="sidecar") runs the
+# windowed scanner over ring body slots, the Python listener
+# (plane="python") over its buffered bodies, and the native httpd
+# (plane="native") counts the producer side — windows enqueued, flows
+# failed open, h2 streams skipped.
+BODY_METRICS = {
+    "pingoo_body_windows_total":
+        "body windows scanned (sidecar/python) or enqueued (native)",
+    "pingoo_body_flows_active":
+        "flows with live carry-over state in the scanner table",
+    "pingoo_body_carry_depth":
+        "windows a finished flow's verdict waited for, i.e. carry-over "
+        "chain length (histogram)",
+    "pingoo_body_bytes_total": "body payload bytes scanned",
+    "pingoo_body_degrade_total":
+        "flows degraded to metadata-only verdicts, by reason (evict = "
+        "state-table pressure, ttl = stalled flow reaped, gap = window "
+        "sequence gap, abort = client reset, ring_full = body ring "
+        "back-pressure, ladder = body rung demoted, h2 = native h2 "
+        "stream not inspected this PR)",
 }
 
 # Native-plane-only counters (httpd.cc Stats), exported with
@@ -284,5 +308,5 @@ def all_metric_names() -> set[str]:
             | set(PROVENANCE_METRICS)
             | set(PARITY_METRICS) | set(SCHED_METRICS)
             | set(PIPELINE_METRICS) | set(RESILIENCE_METRICS)
-            | set(HOTSWAP_METRICS)
+            | set(HOTSWAP_METRICS) | set(BODY_METRICS)
             | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

@@ -147,36 +147,71 @@ def _extract_np(bank: DfaBank, H: np.ndarray,
 # -- lax.scan ladder ---------------------------------------------------------
 
 
-def dfa_scan(tables: DfaTables, data: jax.Array, lengths: jax.Array,
-             backend: str | None = None) -> jax.Array:
-    """Scan one field's [B, L] bytes -> per-slot hits [B, P] bool."""
-    if backend == "pallas" and PALLAS_AVAILABLE:
-        return _fused_dfa(tables, data, lengths)
-    B, L = data.shape
+def dfa_init_state(B: int,
+                   num_words: int) -> tuple[jax.Array, jax.Array]:
+    """Fresh per-row carry for a chunked scan: (state [B] int32,
+    H [B, Wh] uint32)."""
+    return (jnp.zeros((B,), dtype=jnp.int32),
+            jnp.zeros((B, num_words), dtype=jnp.uint32))
+
+
+def dfa_scan_chunk(tables: DfaTables, data: jax.Array, lengths: jax.Array,
+                   state: jax.Array, H: jax.Array,
+                   t_offset) -> tuple[jax.Array, jax.Array]:
+    """Advance the (state, H) carry over one [B, Lc] byte chunk whose
+    first column sits at global position `t_offset` (scalar or per-row
+    [B] int32). Chunks compose: the streaming body scanner
+    (engine/bodyscan.py) threads the carry across ring windows, and
+    `dfa_scan` below is literally one chunk plus `dfa_finalize` — so a
+    payload split at any byte boundary walks the identical state
+    sequence as the contiguous scan. `lengths` is each row's TOTAL live
+    byte count at global positions (columns with t_offset + i >=
+    lengths are padding and leave the carry untouched); `end_accept` is
+    deliberately NOT applied here — it reads the final state, which
+    only `dfa_finalize` knows."""
+    B, Lc = data.shape
+    if Lc == 0:
+        return state, H
     C = tables.num_classes
     lens = lengths.astype(jnp.int32)
-    state = jnp.zeros((B,), dtype=jnp.int32)
-    H = jnp.zeros((B, tables.num_words), dtype=jnp.uint32)
-    if L == 0:
-        return dfa_extract(tables, H, lens)
+    t_off = jnp.asarray(t_offset, dtype=jnp.int32)
     # Byte -> class ids ONCE, outside the loop (byte_cls is [256]).
-    cls = jnp.take(tables.byte_cls, data.astype(jnp.int32))  # [B, L]
+    cls = jnp.take(tables.byte_cls, data.astype(jnp.int32))  # [B, Lc]
 
     def step(carry, xs):
         state, H = carry
-        c, t = xs
-        live = t < lens
+        c, i = xs
+        live = (t_off + i) < lens  # t_off broadcasts: scalar or [B]
         fire = jnp.take(tables.step_accept, state, axis=0)  # [B, Wh]
         H = jnp.where(live[:, None], H | fire, H)
         nxt = jnp.take(tables.trans_flat, state * C + c)
         state = jnp.where(live, nxt, state)
         return (state, H), None
 
-    xs = (cls.T, jnp.arange(L, dtype=jnp.int32))
+    xs = (cls.T, jnp.arange(Lc, dtype=jnp.int32))
     (state, H), _ = jax.lax.scan(step, (state, H), xs,
-                                 unroll=8 if L >= 8 else 1)
+                                 unroll=8 if Lc >= 8 else 1)
+    return state, H
+
+
+def dfa_finalize(tables: DfaTables, state: jax.Array, H: jax.Array,
+                 lengths: jax.Array) -> jax.Array:
+    """Apply absolute-end accepts at the final carried state and extract
+    per-slot hits — the closing half of a chunked scan."""
     H = H | jnp.take(tables.end_accept, state, axis=0)
-    return dfa_extract(tables, H, lens)
+    return dfa_extract(tables, H, lengths.astype(jnp.int32))
+
+
+def dfa_scan(tables: DfaTables, data: jax.Array, lengths: jax.Array,
+             backend: str | None = None) -> jax.Array:
+    """Scan one field's [B, L] bytes -> per-slot hits [B, P] bool."""
+    if backend == "pallas" and PALLAS_AVAILABLE:
+        return _fused_dfa(tables, data, lengths)
+    B, L = data.shape
+    lens = lengths.astype(jnp.int32)
+    state, H = dfa_init_state(B, tables.num_words)
+    state, H = dfa_scan_chunk(tables, data, lens, state, H, 0)
+    return dfa_finalize(tables, state, H, lens)
 
 
 def dfa_extract(tables: DfaTables, H: jax.Array,

@@ -165,6 +165,54 @@ def scan_numpy(bank: PrefilterBank, data: np.ndarray,
     return (lanes & bank.accept_mask[None, :]) != 0
 
 
+def prefilter_init_state(
+        B: int, num_words: int) -> tuple[jax.Array, jax.Array]:
+    """Fresh (S, H) carry pair for a chunked scan, both [B, Wp]."""
+    zero = jnp.zeros((B, num_words), dtype=jnp.uint32)
+    return zero, zero
+
+
+def prefilter_scan_chunk(tables: PrefilterTables, data: jax.Array,
+                         lengths: jax.Array, S: jax.Array, H: jax.Array,
+                         t_offset) -> tuple[jax.Array, jax.Array]:
+    """Advance the (S, H) shift-AND carry over one [B, Lc] chunk whose
+    first column sits at global position `t_offset` (scalar or per-row
+    [B] int32). S holds every factor's in-progress positions, so a
+    literal straddling the chunk boundary completes exactly on the
+    carry-in — no overlap-tail re-scan needed for the prefilter itself
+    (engine/bodyscan.py relies on this to decide lazy NFA starts).
+    `lengths` is each row's TOTAL live byte count in global positions;
+    `prefilter_scan` below is one chunk at offset 0."""
+    B, Lc = data.shape
+    if Lc == 0:
+        return S, H
+    lens = lengths.astype(jnp.int32)
+    t_off = jnp.asarray(t_offset, dtype=jnp.int32)
+    init = tables.init
+    one = jnp.uint32(1)
+
+    def step(carry, xs):
+        S, H = carry
+        c, i = xs
+        bc = jnp.take(tables.byte_table, c.astype(jnp.int32), axis=0)
+        S_new = ((S << one) | init[None, :]) & bc
+        # Rows past their length keep S unchanged, so H | S adds
+        # nothing for them — no second gate needed.
+        S = jnp.where((t_off + i < lens)[:, None], S_new, S)
+        return (S, H | S), None
+
+    (S, H), _ = jax.lax.scan(
+        step, (S, H), (data.T, jnp.arange(Lc, dtype=jnp.int32)),
+        unroll=8 if Lc >= 8 else 1)
+    return S, H
+
+
+def prefilter_extract(tables: PrefilterTables, H: jax.Array) -> jax.Array:
+    """[B, Wp] sticky accumulator -> [B, F] factor hits."""
+    lanes = jnp.take(H, tables.accept_word, axis=1)
+    return (lanes & tables.accept_mask[None, :]) != 0
+
+
 def prefilter_scan(tables: PrefilterTables, data: jax.Array,
                    lengths: jax.Array,
                    backend: str | None = None) -> jax.Array:
@@ -175,29 +223,11 @@ def prefilter_scan(tables: PrefilterTables, data: jax.Array,
     """
     if backend == "pallas":
         H = _fused_prefilter(tables, data, lengths)
-        lanes = jnp.take(H, tables.accept_word, axis=1)
-        return (lanes & tables.accept_mask[None, :]) != 0
+        return prefilter_extract(tables, H)
     B, L = data.shape
-    lengths = lengths.astype(jnp.int32)
-    init = tables.init
-    one = jnp.uint32(1)
-    zero = jnp.zeros((B, tables.init.shape[0]), dtype=jnp.uint32)
-
-    def step(carry, xs):
-        S, H = carry
-        c, t = xs
-        bc = jnp.take(tables.byte_table, c.astype(jnp.int32), axis=0)
-        S_new = ((S << one) | init[None, :]) & bc
-        # Rows past their length keep S unchanged, so H | S adds
-        # nothing for them — no second gate needed.
-        S = jnp.where((t < lengths)[:, None], S_new, S)
-        return (S, H | S), None
-
-    (_, H), _ = jax.lax.scan(
-        step, (zero, zero), (data.T, jnp.arange(L, dtype=jnp.int32)),
-        unroll=8 if L >= 8 else 1)
-    lanes = jnp.take(H, tables.accept_word, axis=1)
-    return (lanes & tables.accept_mask[None, :]) != 0
+    S, H = prefilter_init_state(B, tables.init.shape[0])
+    S, H = prefilter_scan_chunk(tables, data, lengths, S, H, 0)
+    return prefilter_extract(tables, H)
 
 
 # -- fused Pallas variant -----------------------------------------------------

@@ -43,6 +43,7 @@ STRUCT_OF_DTYPE = {
     "TELEMETRY_DTYPE": "PingooRingTelemetry",
     "RING_HEADER_DTYPE": "PingooRingHeader",
     "SPILL_SLOT_DTYPE": "PingooSpillSlot",
+    "BODY_SLOT_DTYPE": "PingooBodySlot",
 }
 
 
@@ -77,6 +78,11 @@ def python_table() -> dict:
             "PINGOO_SPILL_NONE": nr.SPILL_NONE,
             "PINGOO_WAIT_BUCKETS": nr.WAIT_BUCKETS,
             "PINGOO_TELEMETRY_WORDS": nr.TELEMETRY_WORDS,
+            "PINGOO_BODY_SLOTS": nr.BODY_SLOTS,
+            "PINGOO_BODY_WINDOW_CAP": nr.BODY_WINDOW_CAP,
+            "PINGOO_BODY_FLAG_FINAL": nr.BODY_FLAG_FINAL,
+            "PINGOO_BODY_FLAG_ABORT": nr.BODY_FLAG_ABORT,
+            "PINGOO_BODY_VERDICT_BIT": nr.BODY_VERDICT_BIT,
         },
         "structs": structs,
     }

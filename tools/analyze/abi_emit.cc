@@ -74,6 +74,11 @@ int main() {
   CONSTANT(PINGOO_SPILL_NONE);
   CONSTANT(PINGOO_WAIT_BUCKETS);
   CONSTANT(PINGOO_TELEMETRY_WORDS);
+  CONSTANT(PINGOO_BODY_SLOTS);
+  CONSTANT(PINGOO_BODY_WINDOW_CAP);
+  CONSTANT(PINGOO_BODY_FLAG_FINAL);
+  CONSTANT(PINGOO_BODY_FLAG_ABORT);
+  CONSTANT(PINGOO_BODY_VERDICT_BIT);
   std::printf("\n  },\n");
   first_item = false;
 
@@ -127,7 +132,8 @@ int main() {
   FIELD(PingooRingHeader, capacity);
   FIELD(PingooRingHeader, request_slot_size);
   FIELD(PingooRingHeader, verdict_slot_size);
-  FIELD(PingooRingHeader, _pad);
+  FIELD(PingooRingHeader, body_slot_size);
+  FIELD(PingooRingHeader, body_capacity);
   FIELD(PingooRingHeader, req_head);
   FIELD(PingooRingHeader, req_tail);
   FIELD(PingooRingHeader, ver_head);
@@ -136,6 +142,8 @@ int main() {
   FIELD(PingooRingHeader, sidecar_epoch);
   FIELD(PingooRingHeader, sidecar_heartbeat_ms);
   FIELD(PingooRingHeader, posted_floor);
+  FIELD(PingooRingHeader, body_head);
+  FIELD(PingooRingHeader, body_tail);
   STRUCT_CLOSE();
 
   STRUCT_OPEN(PingooSpillSlot);
@@ -143,6 +151,17 @@ int main() {
   FIELD(PingooSpillSlot, url_len);
   FIELD(PingooSpillSlot, path_len);
   FIELD(PingooSpillSlot, data);
+  STRUCT_CLOSE();
+
+  STRUCT_OPEN(PingooBodySlot);
+  FIELD(PingooBodySlot, seq);
+  FIELD(PingooBodySlot, flow);
+  FIELD(PingooBodySlot, win_seq);
+  FIELD(PingooBodySlot, win_len);
+  FIELD(PingooBodySlot, total_len);
+  FIELD(PingooBodySlot, flags);
+  FIELD(PingooBodySlot, _pad);
+  FIELD(PingooBodySlot, data);
   STRUCT_CLOSE();
 
   std::printf("\n  }\n}\n");

@@ -21,6 +21,13 @@ framing: chunk-size extensions and hex casing, chunk/TCP boundary
 splits mid-token, header folding/duplication/whitespace, percent- and
 double-URL-encoding, path normalization shapes (`..`, `//`, `;`),
 Content-Length vs Transfer-Encoding conflicts, bare-LF line endings.
+Body-bearing classes (ISSUE 13) carry DEFAULT_BODY_RULES match
+literals torn across TCP segments, chunk seams and the 4096-byte ring
+window, driving the native streaming scanner (the harness runs the
+listener with PINGOO_BODY_INSPECT=on and answers body windows with
+the real scanner) against the python plane's contiguous scan; a
+scanner-level h2 DATA fragmentation differential covers the frame
+boundaries the h1 harness cannot express.
 A DISCREPANCY is any mutant where (a) one plane evaluates a request
 the other refuses, (b) both evaluate but the extracted RequestTuple
 fields differ, or (c) the verdict bits differ — modulo the documented
@@ -305,10 +312,118 @@ def mut_ua_edge(rng) -> Mutant:
     return Mutant("ua-edge", head)
 
 
+# -- body-bearing mutants (ISSUE 13: streaming body inspection) ------------
+#
+# The block-action literals from bodyscan.DEFAULT_BODY_RULES. The
+# captcha-lane rule ("eval(") is deliberately absent: the fuzz
+# differential classifies by status line and a captcha challenge is
+# not a refusal, so it has no stable class on the python oracle side.
+
+BODY_LITERALS = [b"union select", b"' or '1'='1", b"<script",
+                 b"../../", b"/etc/passwd"]
+
+#: Filler alphabet with NO space, quote, angle bracket, dot, slash or
+#: paren — no run of filler (or filler touching a near-miss) can ever
+#: complete a DEFAULT_BODY_RULES literal by accident.
+_FILL = b"abcdefghijklmnop0123456789=&"
+
+
+def _body_fill(rng, n: int) -> bytes:
+    return bytes(rng.choices(_FILL, k=n))
+
+
+def mut_body_literal_split(rng) -> Mutant:
+    """Content-Length body carrying a match literal with the TCP
+    segment boundaries placed INSIDE the literal: the native scanner
+    sees the literal torn across reads and must still match via
+    cross-window NFA/DFA carry, exactly like the python contiguous
+    scan of the reassembled body."""
+    lit = rng.choice(BODY_LITERALS)
+    pre = _body_fill(rng, rng.randint(0, 40))
+    body = pre + lit + _body_fill(rng, rng.randint(0, 40))
+    head, _ = _head(rng, method=b"POST",
+                    extra=[b"content-length: %d" % len(body)])
+    lit_at = len(head) + len(pre)
+    cuts = sorted(rng.sample(range(lit_at + 1, lit_at + len(lit)),
+                             rng.randint(1, min(3, len(lit) - 1))))
+    return Mutant("body-literal-split", head + body, splits=cuts,
+                  note=f"literal {lit!r} torn at {cuts}")
+
+
+def mut_body_chunk_carry(rng) -> Mutant:
+    """Chunked body with the CHUNK boundary inside a match literal —
+    after de-framing, the literal straddles ring windows and only the
+    carried scanner state can complete the match."""
+    lit = rng.choice(BODY_LITERALS)
+    cuts = sorted(rng.sample(range(1, len(lit)),
+                             rng.randint(1, min(3, len(lit) - 1))))
+    parts = [lit[a:b] for a, b in zip((0, *cuts), (*cuts, len(lit)))]
+    parts[0] = _body_fill(rng, rng.randint(0, 20)) + parts[0]
+    parts[-1] = parts[-1] + _body_fill(rng, rng.randint(0, 20))
+    head, _ = _head(rng, method=b"POST",
+                    extra=[b"transfer-encoding: chunked"])
+    raw = head + _chunked(parts)
+    splits = ()
+    if rng.random() < 0.5:
+        # Additionally split the TCP send at a chunk seam, so the
+        # framer resumes mid-message as well as mid-literal.
+        off, seams = len(head), []
+        for p in parts:
+            off += len(b"%x" % len(p)) + 2 + len(p) + 2
+            seams.append(off)
+        splits = (rng.choice(seams),)
+    return Mutant("body-chunk-carry", raw, splits=splits,
+                  note=f"literal {lit!r} chunk-cut at {cuts}")
+
+
+def mut_body_benign(rng) -> Mutant:
+    """TE/CL bodies with NO matching literal — including near-miss
+    shapes one byte away from a rule — must stay `allow` on both
+    planes: the merge lane must not invent verdict bits."""
+    near = [b"union  select", b"unionselect", b"<scr1pt", b"113'='1",
+            b"=etc=passwd"]
+    body = _body_fill(rng, rng.randint(1, 120))
+    if rng.random() < 0.5:
+        body += rng.choice(near) + _body_fill(rng, rng.randint(0, 20))
+    if rng.random() < 0.5:
+        head, _ = _head(rng, method=b"POST",
+                        extra=[b"content-length: %d" % len(body)])
+        raw = head + body
+    else:
+        k = min(rng.randint(0, 3), len(body) - 1)
+        cuts = sorted(rng.sample(range(1, len(body)), k)) if k else []
+        parts = [body[a:b]
+                 for a, b in zip((0, *cuts), (*cuts, len(body)))]
+        head, _ = _head(rng, method=b"POST",
+                        extra=[b"transfer-encoding: chunked"])
+        raw = head + _chunked(parts)
+    splits = ()
+    if rng.random() < 0.5 and len(raw) > 2:
+        splits = tuple(sorted(rng.sample(range(1, len(raw)),
+                                         rng.randint(1, 3))))
+    return Mutant("body-benign", raw, splits=splits)
+
+
+def mut_body_window_straddle(rng) -> Mutant:
+    """Body larger than the 4096-byte ring window with the literal
+    straddling the window-flush boundary: carry across FLUSHED ring
+    windows (not just chunk seams) must match the contiguous scan."""
+    lit = rng.choice(BODY_LITERALS)
+    k = rng.randint(1, len(lit) - 1)  # literal bytes before the flush
+    body = _body_fill(rng, 4096 - k) + lit \
+        + _body_fill(rng, rng.randint(0, 64))
+    head, _ = _head(rng, method=b"POST",
+                    extra=[b"content-length: %d" % len(body)])
+    return Mutant("body-window-straddle", head + body,
+                  note=f"literal {lit!r} straddles byte 4096 at -{k}")
+
+
 MUTATORS = [mut_chunk_ext, mut_chunk_bad, mut_chunk_split, mut_trailer,
             mut_header_fold, mut_header_dup, mut_header_ws,
             mut_pct_encode, mut_path_norm, mut_cl_te, mut_bare_lf,
-            mut_reqline, mut_head_split, mut_ua_edge]
+            mut_reqline, mut_head_split, mut_ua_edge,
+            mut_body_literal_split, mut_body_chunk_carry,
+            mut_body_benign, mut_body_window_straddle]
 
 
 def generate(n: int, seed: int):
@@ -430,9 +545,24 @@ def _interp_action(plan, fields: dict) -> int:
     return int(lanes[0][0])
 
 
+_BODY_SCAN = None  # lazy (bodyscan module, BodyScanner) singleton
+
+
+def _body_scan():
+    global _BODY_SCAN
+    if _BODY_SCAN is None:
+        from pingoo_tpu.engine import bodyscan
+        _BODY_SCAN = (bodyscan, bodyscan.BodyScanner())
+    return _BODY_SCAN
+
+
 def classify_python(raw: bytes, plan) -> tuple:
     """-> (class, fields|None). Class is reject-400/413/431, drop,
-    block, or allow — the python listener's observable behavior."""
+    block, or allow — the python listener's observable behavior.
+    Bodies ride the same DEFAULT_BODY_RULES merge as the listener:
+    a metadata `allow` with a body is scanned contiguously and the
+    body verdict merges in (ISSUE 13) — mirroring the native plane's
+    streamed scan of the identical request set."""
     from pingoo_tpu.host.httpd import extract_request_fields, \
         parse_request_bytes
 
@@ -448,22 +578,90 @@ def classify_python(raw: bytes, plan) -> tuple:
     fields = {"method": req.method, "host": host, "path": req.path,
               "url": req.target, "user_agent": user_agent}
     action = _interp_action(plan, fields)
+    if action == 0 and req.body:
+        bs, scanner = _body_scan()
+        verdict = scanner.scan_buffered(bytes(req.body))
+        if not verdict.degraded:
+            action = bs.merge_actions(0, verdict.unverified,
+                                      verdict.verified_block) & 0x3
     return ("block" if action == 1 else "allow"), fields
+
+
+def diff_h2_frag(rng, rounds: int) -> list[str]:
+    """h2 DATA fragmentation differential. h2 client bodies never ride
+    the h1 byte-stream differential (the native listener skips them by
+    design — metadata-only, counted in body_h2_skipped), so fragment
+    at the DATA-frame layer directly: a payload sliced at arbitrary
+    frame boundaries — 1-byte frames, empty frames, whole-tail frames
+    — fed to the streaming scanner as windows must earn exactly the
+    verdict the contiguous interpreter oracle earns. This is the same
+    window stream the python listener's h2 path produces after
+    buffering, so scanner-level agreement IS plane-level agreement."""
+    from pingoo_tpu.engine import bodyscan
+
+    plan = bodyscan.compile_body_plan()
+    scanner = bodyscan.BodyScanner(plan)
+    problems = []
+    for i in range(rounds):
+        lit = rng.choice(BODY_LITERALS + [b""])  # sometimes benign
+        payload = (_body_fill(rng, rng.randint(0, 64)) + lit
+                   + _body_fill(rng, rng.randint(0, 64)))
+        frames, off = [], 0
+        while off < len(payload):
+            n = rng.choice((1, 2, 3, 7, 16, len(payload) - off))
+            frames.append(payload[off:off + n])
+            off += n
+        if not frames or rng.random() < 0.3:
+            frames.insert(rng.randrange(len(frames) + 1), b"")
+        windows = [bodyscan.BodyWindow(flow_id=i, win_seq=s, data=d,
+                                       final=(s == len(frames) - 1))
+                   for s, d in enumerate(frames)]
+        got = [v for v in scanner.scan_windows(windows)
+               if v.flow_id == i]
+        want_unv, want_vb, _ = bodyscan.body_lanes_oracle(plan, payload)
+        if (len(got) != 1 or got[0].degraded
+                or got[0].unverified != want_unv
+                or got[0].verified_block != want_vb):
+            problems.append(
+                f"[h2-data-frag] round {i} ({len(frames)} frames, "
+                f"{len(payload)}B): streamed={got!r} "
+                f"oracle=({want_unv}, {want_vb})")
+            _count_discrepancy("h2-data-frag")
+    return problems
 
 
 class NativeHarness:
     """Loopback stack: httpd + upstream + a ring consumer that records
     the natively-parsed fields per ticket and answers with interpreter
     verdicts over exactly those fields (so the ONLY free variable is
-    the parse, never the rules)."""
+    the parse, never the rules).
 
-    def __init__(self, plan, tmpdir: str):
+    Body inspection runs ON by default (ISSUE 13): the listener is
+    spawned with PINGOO_BODY_INSPECT=on, so it streams de-framed body
+    windows through the ring and the consumer answers them with the
+    real streaming scanner (flow carry and all) tagged with
+    BODY_VERDICT_BIT — the same sidecar loop production runs. The
+    differential then covers the whole body path: native BodyFramer
+    windows + cross-window carry + in-C merge versus the python
+    plane's contiguous scan + merge of the reassembled bytes."""
+
+    def __init__(self, plan, tmpdir: str, body_inspect: bool = True):
         from pingoo_tpu import native_ring
         from pingoo_tpu.native_ring import Ring
 
         self.plan = plan
         self.slots: list[dict] = []  # consumer appends decoded fields
         self._stop = threading.Event()
+        self._sync = 0  # sentinel counter for _sync_barrier
+
+        self._bodyscan = None
+        if body_inspect:
+            from pingoo_tpu.engine import bodyscan
+            self._bodyscan = bodyscan
+            self._body_scanner = bodyscan.BodyScanner()
+            # Warm the chunk kernels off the clock: the first scan per
+            # row bucket compiles, and roundtrip() timeouts are short.
+            self._body_scanner.scan_buffered(b"warmup")
 
         # Raw-socket upstream: unlike http.server it DRAINS the proxied
         # body (Content-Length and chunked) before answering and keeps
@@ -486,10 +684,14 @@ class NativeHarness:
 
         httpd_bin = os.path.join(native_ring.NATIVE_DIR, "httpd")
         port = _free_port()
+        env = dict(os.environ)
+        env.pop("PINGOO_BODY_INSPECT", None)
+        if body_inspect:
+            env["PINGOO_BODY_INSPECT"] = "on"
         self.proc = subprocess.Popen(
             [httpd_bin, str(port), ring_path, "127.0.0.1",
              str(self.up_port)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=env)
         line = self.proc.stdout.readline()
         if b"listening" not in line:
             raise RuntimeError(f"native httpd failed to start: {line!r}")
@@ -554,9 +756,41 @@ class NativeHarness:
         finally:
             conn.close()
 
+    def _drain_bodies(self):
+        """The sidecar's body loop in miniature: dequeue de-framed
+        windows, run the streaming scanner (per-flow carry), post each
+        FINAL flow's verdict back tagged BODY_VERDICT_BIT. A scanner
+        fault fails every live flow open (action 0) so the listener's
+        held requests never stall the differential."""
+        from pingoo_tpu.native_ring import (BODY_FLAG_ABORT,
+                                            BODY_FLAG_FINAL,
+                                            BODY_VERDICT_BIT)
+
+        bs = self._bodyscan
+        slots = self.ring.dequeue_bodies()
+        if not len(slots):
+            return
+        windows = [bs.BodyWindow(
+            flow_id=int(s["flow"]), win_seq=int(s["win_seq"]),
+            data=s["data"][:int(s["win_len"])].tobytes(),
+            final=bool(s["flags"] & BODY_FLAG_FINAL),
+            abort=bool(s["flags"] & BODY_FLAG_ABORT)) for s in slots]
+        try:
+            verdicts = self._body_scanner.scan_windows(windows)
+        except Exception:  # noqa: BLE001 — fail open, never stall
+            self._body_scanner.flows.clear()
+            verdicts = [bs.BodyVerdict(w.flow_id, degraded=True)
+                        for w in windows if w.final]
+        for v in verdicts:
+            self.ring.post_verdict(
+                v.flow_id | BODY_VERDICT_BIT,
+                0 if v.degraded else v.action_byte())
+
     def _consume(self):
         while not self._stop.is_set():
             self.ring.heartbeat()
+            if self._bodyscan is not None:
+                self._drain_bodies()
             slots = self.ring.dequeue_batch(256)
             if not len(slots):
                 time.sleep(0.0005)
@@ -570,6 +804,42 @@ class NativeHarness:
                 self.slots.append(fields)
                 self.ring.post_verdict(int(slot["ticket"]), action)
             self.ring.set_posted_floor(int(slots["ticket"].max()))
+
+    def _sync_barrier(self, seen: int, timeout: float) -> int:
+        """Serial-attribution barrier. The listener can answer an
+        early 400/403 BEFORE the consumer's poll dequeues the head
+        slot it already enqueued (wider still while a body scan or a
+        chunk-kernel compile holds the consumer loop), so "latest
+        slot" attribution can smear one mutant's fields onto the
+        next. A uniquely-pathed sentinel GET pins it down: the ring
+        is FIFO, so once the sentinel's slot lands, every slot the
+        mutant enqueued has landed too. -> sentinel slot index, or
+        len(self.slots) on timeout (fields then read as None)."""
+        self._sync += 1
+        tag = "/__fuzz_sync_%d" % self._sync
+        try:
+            s = socket.create_connection(("127.0.0.1", self.port),
+                                         timeout=timeout)
+            s.sendall(b"GET " + tag.encode() + b" HTTP/1.1\r\n"
+                      b"host: sync.test\r\nuser-agent: fuzz-sync\r\n"
+                      b"connection: close\r\n\r\n")
+            while s.recv(65536):
+                pass
+        except OSError:
+            pass
+        finally:
+            try:
+                s.close()
+            except (OSError, UnboundLocalError):
+                pass
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            n = len(self.slots)
+            for j in range(seen, n):
+                if self.slots[j]["path"] == tag:
+                    return j
+            time.sleep(0.001)
+        return len(self.slots)
 
     def roundtrip(self, mutant: Mutant, timeout=5.0) -> tuple:
         """Send one mutant, -> (class, fields|None) mirroring
@@ -611,13 +881,17 @@ class NativeHarness:
             pass
         finally:
             s.close()
+        fence = self._sync_barrier(seen, timeout)
         if not data:
             return "drop", None
         status = data.split(b"\r\n", 1)[0].split(b" ")
         code = status[1].decode("latin-1") if len(status) > 1 else "???"
         fields = None
-        if len(self.slots) > seen:
-            fields = self.slots[-1]
+        if fence > seen:
+            # Last slot the mutant enqueued before the sentinel fence
+            # (a smuggling mutant can enqueue more than one; "last"
+            # matches the python oracle, which parses one message).
+            fields = self.slots[fence - 1]
         if code in ("400", "413", "431"):
             return f"reject-{code}", fields
         if code == "403":
@@ -769,6 +1043,10 @@ def run(mutants: int = DEFAULT_MUTANTS, seed: int = DEFAULT_SEED,
             if len(discrepancies) >= 25:
                 print("fuzz: stopping early — 25+ discrepancies")
                 break
+        h2_rounds = max(25, mutants // 50)
+        discrepancies += diff_h2_frag(random.Random(seed ^ 0x6832),
+                                      h2_rounds)
+        per_class["h2-data-frag"] = h2_rounds
         wall = time.monotonic() - t0
         print(f"fuzz: {mutants} mutants over {len(MUTATORS)} classes, "
               f"seed {seed}, {wall:.1f}s "

@@ -28,6 +28,7 @@ error. Tracked metrics and their directions:
     pipeline_on_p99_ms     lower  is better
     megastep_req_per_s   higher is better (ISSUE 12 megastep arm)
     swap_pause_p99_ms    lower  is better (ISSUE 11 hot-swap pause)
+    body_stream_mb_per_s higher is better (ISSUE 13 streaming body scan)
 
 Metrics missing from either run are skipped (partial/error lines are
 trajectory too, but only shared keys gate).
@@ -66,6 +67,10 @@ TRACKED = (
     # Ruleset hot-swap storm (ISSUE 11, tools/chaos_smoke.py): the
     # drain+flip admission pause a swap costs at a batch boundary.
     ("swap_pause_p99_ms", False),
+    # Streaming body-scan arm (ISSUE 13, bench.py --body): interleaved
+    # multi-flow windowed scan throughput, verdict-identical to the
+    # contiguous scan by construction.
+    ("body_stream_mb_per_s", True),
 )
 
 DEFAULT_THRESHOLD = 0.10

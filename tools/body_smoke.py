@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Streaming body-inspection smoke (make body-smoke; ISSUE 13).
+
+Proves, offline and in well under a minute, that streaming request
+bodies through the ring (docs/BODY_STREAMING.md) is a framing change
+and never a semantic one:
+
+  * scanner parity: for a deterministic mini-corpus covering every
+    DEFAULT_BODY_RULES literal, streaming the payload as windows with
+    the seam INSIDE the literal — including one straddling the
+    4096-byte ring-window flush — yields verdicts bit-identical to
+    the contiguous one-shot scan AND the interpreter oracle;
+  * degrade lane: a window-sequence gap degrades that flow to
+    metadata-only (degraded FINAL verdict, action 0) instead of
+    wedging the flow table;
+  * native plane (skips with a warning when the toolchain is
+    unavailable): the real httpd under PINGOO_BODY_INSPECT=on blocks
+    a torn-literal POST (TCP segment boundaries inside the literal),
+    allows its benign twin, exports nonzero pingoo_body_* telemetry
+    at /__pingoo/metrics — and with the gate OFF the same malicious
+    body is allowed, bit-exact status quo.
+
+Offline-safe like mesh-smoke: when jax is unavailable the smoke SKIPS
+WITH A WARNING (exit 0) instead of failing the gate.
+"""
+
+import json
+import os
+import socket
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+FAILURES: list = []
+
+
+def check(ok, what):
+    print(("  ok  " if ok else "  FAIL") + f" {what}")
+    if not ok:
+        FAILURES.append(what)
+
+
+def scanner_parity() -> None:
+    from pingoo_tpu.engine import bodyscan
+
+    plan = bodyscan.compile_body_plan()
+    scanner = bodyscan.BodyScanner(plan)
+    window = bodyscan.body_window_bytes()
+    flow = 1
+    for rule in bodyscan.DEFAULT_BODY_RULES:
+        lit = rule.pattern.encode()
+        for pre_n in (0, 17, window - len(lit) // 2):
+            payload = (b"k=v&q=" + b"z" * pre_n + lit
+                       + b"&tail=" + b"y" * 23)
+            cut = len(b"k=v&q=") + pre_n + len(lit) // 2  # mid-literal
+            pieces = [payload[:cut], payload[cut:]]
+            windows, seq = [], 0
+            for piece in pieces:
+                for part in bodyscan.split_payload(piece, window):
+                    windows.append(bodyscan.BodyWindow(
+                        flow_id=flow, win_seq=seq, data=part))
+                    seq += 1
+            windows[-1].final = True
+            streamed = [v for v in scanner.scan_windows(windows)
+                        if v.flow_id == flow]
+            contig = scanner.scan_buffered(payload)
+            unv, vb, _names = bodyscan.body_lanes_oracle(plan, payload)
+            ok = (len(streamed) == 1 and not streamed[0].degraded
+                  and streamed[0].unverified == contig.unverified == unv
+                  and streamed[0].verified_block
+                  == contig.verified_block == vb)
+            check(ok, f"stream==contig==oracle {rule.name} pre={pre_n}")
+            flow += 1
+    check(scanner.flows_active == 0, "all smoke flows finished")
+
+
+def degrade_lane() -> None:
+    from pingoo_tpu.engine import bodyscan
+
+    scanner = bodyscan.BodyScanner()
+    flow = 9001
+    first = bodyscan.BodyWindow(flow_id=flow, win_seq=0, data=b"abc")
+    # win_seq jumps 0 -> 2: the carry is broken, the flow must fail
+    # open (degraded FINAL, action 0), never block or wedge.
+    gap = bodyscan.BodyWindow(flow_id=flow, win_seq=2,
+                              data=b"union select", final=True)
+    out = [v for v in scanner.scan_windows([first, gap])
+           if v.flow_id == flow]
+    check(len(out) == 1 and out[0].degraded and out[0].action_byte() == 0,
+          "win_seq gap degrades to metadata-only (action 0)")
+    check(scanner.flows_active == 0, "degraded flow evicted")
+
+
+def _metrics_json(port: int) -> dict:
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    try:
+        s.sendall(b"GET /__pingoo/metrics HTTP/1.1\r\n"
+                  b"host: smoke\r\nuser-agent: body-smoke\r\n"
+                  b"accept: application/json\r\n"
+                  b"connection: close\r\n\r\n")
+        data = b""
+        while True:
+            chunk = s.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    finally:
+        s.close()
+    return json.loads(data.split(b"\r\n\r\n", 1)[1])
+
+
+def _post(cls, body_literal: bool, chunked: bool, splits_in_literal: bool):
+    """Build one POST Mutant for the fuzz harness."""
+    from tools.analyze.fuzz import Mutant
+
+    lit = b"union select" if body_literal else b"unionselect"
+    body = b"q=1&msg=" + lit + b"&tail=9"
+    if chunked:
+        cut = len(b"q=1&msg=") + len(lit) // 2
+        payload = b""
+        for c in (body[:cut], body[cut:]):
+            payload += b"%x\r\n" % len(c) + c + b"\r\n"
+        payload += b"0\r\n\r\n"
+        head = (b"POST /search HTTP/1.1\r\nhost: smoke.test\r\n"
+                b"user-agent: body-smoke\r\n"
+                b"transfer-encoding: chunked\r\n"
+                b"connection: close\r\n\r\n")
+        return Mutant(cls, head + payload)
+    head = (b"POST /search HTTP/1.1\r\nhost: smoke.test\r\n"
+            b"user-agent: body-smoke\r\n"
+            b"content-length: %d\r\nconnection: close\r\n\r\n" % len(body))
+    raw = head + body
+    splits = ()
+    if splits_in_literal:
+        at = len(head) + len(b"q=1&msg=")
+        splits = (at + 3, at + 8)
+    return Mutant(cls, raw, splits=splits)
+
+
+def native_plane() -> None:
+    import tempfile
+
+    from pingoo_tpu import native_ring
+    from tools.analyze import fuzz
+
+    if not native_ring.ensure_built():
+        print("  skip native plane: toolchain unavailable")
+        return
+    plan = fuzz._fuzz_plan()
+
+    tmp = tempfile.mkdtemp(prefix="pingoo_body_smoke_on_")
+    h = fuzz.NativeHarness(plan, tmp, body_inspect=True)
+    try:
+        cls, _ = h.roundtrip(_post("benign", False, False, False))
+        check(cls == "allow", f"gate on: benign body allowed ({cls})")
+        cls, _ = h.roundtrip(_post("torn", True, False, True))
+        check(cls == "block",
+              f"gate on: literal torn across TCP segments blocked ({cls})")
+        cls, _ = h.roundtrip(_post("seam", True, True, False))
+        check(cls == "block",
+              f"gate on: literal across chunk seam blocked ({cls})")
+        m = _metrics_json(h.port)
+        body = m.get("body", {})
+        check(body.get("windows", 0) > 0 and body.get("flows", 0) > 0,
+              f"gate on: pingoo_body_* telemetry nonzero ({body})")
+        check(body.get("fail_open", 0) == 0,
+              f"gate on: no fail-opens in clean run ({body})")
+    finally:
+        h.close()
+
+    tmp = tempfile.mkdtemp(prefix="pingoo_body_smoke_off_")
+    h = fuzz.NativeHarness(plan, tmp, body_inspect=False)
+    try:
+        cls, _ = h.roundtrip(_post("off-status-quo", True, False, True))
+        check(cls == "allow",
+              f"gate off: same malicious body rides status quo ({cls})")
+        body = _metrics_json(h.port).get("body", {})
+        check(body.get("windows", -1) == 0 and body.get("flows", -1) == 0,
+              f"gate off: zero body windows/flows ({body})")
+    finally:
+        h.close()
+
+
+def main() -> int:
+    try:
+        import jax  # noqa: F401
+    except Exception as exc:
+        print(f"body smoke SKIPPED: jax unavailable ({exc!r})")
+        return 0
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    print("-- scanner parity (stream == contiguous == oracle) --")
+    scanner_parity()
+    print("-- degrade lane --")
+    degrade_lane()
+    print("-- native plane (PINGOO_BODY_INSPECT on/off) --")
+    native_plane()
+    if FAILURES:
+        print(f"body smoke: {len(FAILURES)} FAILURE(S)")
+        return 1
+    print("body smoke OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

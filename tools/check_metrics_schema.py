@@ -93,6 +93,31 @@ def main() -> int:
         if name not in sidecar_src:
             problems.append(f"native_ring.py: missing metric {name}")
 
+    # Streaming body inspection (ISSUE 13, docs/BODY_STREAMING.md): the
+    # scanner-side metric-name literals live in engine/bodyscan.py
+    # (attach_metrics, shared by both scanning planes); the native
+    # plane exports the producer-side subset as C++ string literals
+    # (the carry-depth histogram is scanner-only); both consuming
+    # planes must wire a BodyScanner — the sidecar drains ring body
+    # slots, the Python listener scans its buffered bodies through
+    # scan_buffered.
+    body_src = _read("pingoo_tpu/engine/bodyscan.py")
+    for name in schema.BODY_METRICS:
+        if name not in body_src:
+            problems.append(f"engine/bodyscan.py: missing metric {name}")
+    for name in ("pingoo_body_windows_total", "pingoo_body_bytes_total",
+                 "pingoo_body_flows_active", "pingoo_body_degrade_total"):
+        if name not in native_src:
+            problems.append(f"native/httpd.cc: missing metric {name}")
+    for plane_src, label in ((py_listener, "host/httpd.py"),
+                             (sidecar_src, "native_ring.py")):
+        if "BodyScanner" not in plane_src:
+            problems.append(f"{label}: body wiring missing BodyScanner")
+    if "scan_buffered" not in py_listener:
+        problems.append("host/httpd.py: body wiring missing scan_buffered")
+    if "PINGOO_BODY_INSPECT" not in native_src:
+        problems.append("native/httpd.cc: missing PINGOO_BODY_INSPECT gate")
+
     # Verdict provenance (ISSUE 5): the metric-name literals live in
     # obs/provenance.py + obs/flightrecorder.py (shared by both engine
     # planes), so check those sources for the names and both plane
@@ -207,8 +232,15 @@ def main() -> int:
                             **schema.PARITY_METRICS,
                             **schema.SCHED_METRICS,
                             **schema.PIPELINE_METRICS,
-                            **schema.RESILIENCE_METRICS}.items():
-        if name == "pingoo_sched_batch_size":
+                            **schema.RESILIENCE_METRICS,
+                            **schema.BODY_METRICS}.items():
+        if name == "pingoo_body_carry_depth":
+            hb = reg.histogram(name, help_text,
+                               buckets=(1, 2, 4, 8, 16, 64, 256),
+                               labels={"plane": "audit"})
+            for v in (1, 3, 500):
+                hb.observe(v)
+        elif name == "pingoo_sched_batch_size":
             # The one histogram in the sched family: lint it with its
             # real pow2 bucket ladder.
             from pingoo_tpu.sched import BATCH_SIZE_BUCKETS
@@ -241,6 +273,8 @@ def main() -> int:
         "plane": "audit", "rung": "device"}).inc()
     reg.counter("pingoo_chaos_injected_total", "", labels={
         "plane": "audit", "fault": "verdict_full"}).inc()
+    reg.counter("pingoo_body_degrade_total", "", labels={
+        "plane": "audit", "reason": "ring_full"}).inc()
     h = reg.histogram(schema.SHARED_WAIT_HISTOGRAM, "wait",
                       buckets=WAIT_BUCKETS_MS, labels={"plane": "audit"})
     for v in (0.5, 3, 70, 2000):
