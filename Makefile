@@ -4,6 +4,7 @@ PY ?= python
 
 .PHONY: all native test check bench bench-regress audit asan \
 	metrics-smoke mesh-smoke chaos-smoke megastep-smoke body-smoke \
+	staging-smoke \
 	clean analyze analyze-abi analyze-lint analyze-tidy analyze-tsan \
 	fuzz
 
@@ -23,6 +24,7 @@ check:
 	$(MAKE) chaos-smoke
 	$(MAKE) megastep-smoke
 	$(MAKE) body-smoke
+	$(MAKE) staging-smoke
 
 # Static analysis suite (docs/STATIC_ANALYSIS.md) — offline-safe; each
 # pass skips with a warning when its toolchain is missing, and each is
@@ -110,6 +112,15 @@ chaos-smoke:
 # toolchain.
 megastep-smoke:
 	$(PY) tools/megastep_smoke.py
+
+# Compact-staging smoke (ISSUE 15, docs/EXECUTOR.md "Compact
+# staging"): prove PINGOO_STAGING=compact is bit-identical to the
+# full-mode oracle on BOTH planes, with the ParityAuditor clean over
+# the compact path and a nonzero staged-bytes saving on a long-URL
+# stream. Offline-safe: skips when jax is unavailable; the sidecar
+# half skips without the native toolchain.
+staging-smoke:
+	$(PY) tools/staging_smoke.py
 
 # Streaming body-inspection smoke (ISSUE 13, docs/BODY_STREAMING.md):
 # prove stream==contiguous==oracle scanner parity with seams inside

@@ -871,6 +871,212 @@ def _pipeline_bench_child() -> None:
     print(json.dumps(result), flush=True)
 
 
+def bench_staging() -> dict:
+    """ISSUE 15 satellite: A/B compact staging (PINGOO_STAGING=full vs
+    compact, docs/EXECUTOR.md) by driving the same seeded traffic —
+    with a long-URL tail, the regime that makes full-mode per-batch
+    width bucketing balloon to the field spec — through a live ring +
+    RingSidecar per mode in a SUBPROCESS. Both arms run under the
+    PINGOO_STAGING_DEPTH=256 operator clamp (a no-op for `full`, which
+    ignores caps); verdict checksums must be identical — compact
+    staging is a transport change, never a semantic one (depth-overflow
+    rows re-serve from full slot bytes). Writes BENCH_staging.json;
+    tools/bench_regress.py tracks compact throughput (higher-better)
+    and staged bytes/request (lower-better)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = _run_tracked(
+        [sys.executable, "-c", "import bench; bench._staging_bench_child()"],
+        capture_output=True, text=True, timeout=1800, env=env, cwd=repo)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"staging bench child rc={out.returncode}: "
+            f"{(out.stderr or '')[-300:]}")
+    child = json.loads(out.stdout.strip().splitlines()[-1])
+    if "note" in child:
+        return {"staging_note": child["note"]}
+    full = child["modes"].get("full", {})
+    compact = child["modes"].get("compact", {})
+    child["checksum_match"] = (
+        full.get("checksum") == compact.get("checksum")
+        and full.get("checksum") is not None)
+    if full.get("staged_bytes_per_req") and compact.get(
+            "staged_bytes_per_req"):
+        child["bytes_reduction"] = round(
+            full["staged_bytes_per_req"] / compact["staged_bytes_per_req"],
+            2)
+    if full.get("req_per_s") and compact.get("req_per_s"):
+        child["speedup"] = round(
+            compact["req_per_s"] / full["req_per_s"], 3)
+    try:
+        with open("BENCH_staging.json", "w") as f:
+            json.dump({"metric": "compact_staging_modes", **child},
+                      f, indent=2)
+    except OSError:
+        pass
+    if not child["checksum_match"]:
+        raise RuntimeError(
+            f"staging checksum mismatch: full={full.get('checksum')} "
+            f"compact={compact.get('checksum')}")
+    res = {"staging_checksum_match": child["checksum_match"],
+           "staging_speedup": child.get("speedup"),
+           "staging_bytes_reduction": child.get("bytes_reduction")}
+    for mode, row in child["modes"].items():
+        for key, val in row.items():
+            if key != "checksum":
+                res[f"staging_{mode}_{key}"] = val
+    # The regress-tracked aliases (direction-aware, bench_regress.py).
+    res["staging_compact_req_per_s"] = compact.get("req_per_s")
+    res["staged_bytes_per_req"] = compact.get("staged_bytes_per_req")
+    return res
+
+
+def _staging_bench_child() -> None:
+    """Child body of bench_staging: per PINGOO_STAGING mode, boot a
+    fresh shm ring + RingSidecar, drive the same seeded long-URL-tail
+    traffic with interleaved polling, and emit one JSON line with
+    per-mode throughput / p99 / staged bytes per request / dispatch
+    EWMA / verdict checksum."""
+    import dataclasses
+    import socket as _socket
+    import tempfile
+    import time as _time
+    import zlib
+
+    from pingoo_tpu import native_ring
+    from pingoo_tpu.compiler import compile_ruleset
+    from pingoo_tpu.native_ring import Ring, RingSidecar
+    from pingoo_tpu.utils.crs import generate_ruleset, generate_traffic
+
+    if not native_ring.ensure_built():
+        print(json.dumps({"note": "native toolchain unavailable"}),
+              flush=True)
+        return
+    n_rules = int(os.environ.get("BENCH_STAGING_RULES", "500"))
+    n_reqs = int(os.environ.get("BENCH_STAGING_REQUESTS", "8192"))
+    max_batch = int(os.environ.get("BENCH_STAGING_BATCH", "2048"))
+    depth = int(os.environ.get("BENCH_STAGING_PIPE_DEPTH", "3"))
+    # Both arms share the operator clamp: `full` ignores caps entirely
+    # (the bit-exact oracle), `compact` caps url/path at 256 and
+    # re-serves the rare deeper-dependent row from full slot bytes.
+    os.environ.setdefault("PINGOO_STAGING_DEPTH", "256")
+    rules, lists = generate_ruleset(n_rules, with_lists=True,
+                                    list_sizes=(4096, 512))
+    plan = compile_ruleset(rules, lists)
+
+    def _tail(reqs, rng_seed):
+        """Give ~0.5% of the stream near-spec-width url/path values:
+        the long-tail shape (search queries, encoded payloads) under
+        which full-mode content bucketing stages the whole batch at
+        the 2048 field spec while compact stays at the clamped cap."""
+        import random as _random
+        rng = _random.Random(rng_seed)
+        out = list(reqs)
+        for i in range(0, len(out), 200):
+            j = min(len(out) - 1, i + rng.randrange(200))
+            r = out[j]
+            pad = "".join(rng.choice("abcdefgh") for _ in range(1800))
+            out[j] = dataclasses.replace(
+                r, url=(r.path + "?q=" + pad)[:2040],
+                path=(r.path + "/" + pad)[:2040])
+        return out
+
+    def _pack(reqs):
+        packed = []
+        for r in reqs:
+            try:
+                ip = (b"\x00" * 10 + b"\xff\xff"
+                      + _socket.inet_aton(r.ip))  # v6-mapped, ABI order
+            except OSError:
+                ip = b"\x00" * 16
+            packed.append((r.method.encode(), r.host.encode(),
+                           r.path.encode(), r.url.encode(),
+                           r.user_agent.encode(), ip, r.remote_port,
+                           r.asn, r.country.encode()))
+        return packed
+
+    warm = _pack(_tail(generate_traffic(n_reqs, lists=lists, seed=22), 2))
+    traffic = _pack(_tail(generate_traffic(n_reqs, lists=lists, seed=21), 1))
+    result: dict = {"modes": {}, "max_batch": max_batch, "rules": n_rules,
+                    "requests": n_reqs,
+                    "staging_depth": os.environ["PINGOO_STAGING_DEPTH"]}
+
+    def drive(ring, stream, record=None):
+        t_enq: dict[int, float] = {}
+        idx_of: dict[int, int] = {}
+        actions: dict[int, int] = {}
+        waits: list[float] = []
+        done = 0
+        i = 0
+        t0 = _time.monotonic()
+        while done < len(stream):
+            burst = 0
+            while i < len(stream) and burst < 64:
+                m, h, p, u, ua, ip, port, asn, cc = stream[i]
+                t = ring.enqueue(method=m, host=h, path=p, url=u,
+                                 user_agent=ua, ip=ip, port=port,
+                                 asn=asn, country=cc)
+                if t is None:
+                    break
+                idx_of[t] = i
+                t_enq[t] = _time.monotonic()
+                i += 1
+                burst += 1
+            v = ring.poll_verdict()
+            while v is not None:
+                ticket, action, _score = v
+                now = _time.monotonic()
+                waits.append((now - t_enq.pop(ticket, now)) * 1e3)
+                actions[idx_of.pop(ticket, -1)] = action
+                done += 1
+                v = ring.poll_verdict()
+        elapsed = _time.monotonic() - t0
+        if record is not None:
+            record["waits"] = waits
+            record["checksum"] = zlib.crc32(
+                bytes(actions[j] & 0xFF for j in sorted(actions)))
+        return elapsed
+
+    for mode in ("full", "compact"):
+        os.environ["PINGOO_STAGING"] = mode
+        tmp = tempfile.mkdtemp(prefix="pingoo-staging-bench-")
+        ring = Ring(os.path.join(tmp, "ring"), capacity=16384,
+                    create=True)
+        sidecar = RingSidecar(ring, plan, lists, max_batch=max_batch,
+                              pipeline_depth=depth)
+        th = threading.Thread(target=sidecar.run, daemon=True)
+        th.start()
+        drive(ring, warm)  # compile the hot shapes off the clock
+        counter = sidecar._staged_bytes_counter[mode]
+        bytes0 = float(counter._value)
+        rec: dict = {}
+        elapsed = drive(ring, traffic, record=rec)
+        rec2: dict = {}
+        elapsed2 = drive(ring, traffic, record=rec2)
+        staged = float(counter._value) - bytes0
+        if elapsed2 < elapsed:
+            elapsed, rec = elapsed2, rec2
+        cost = sidecar.sched.cost.snapshot()
+        overflow_rows = sidecar.depth_overflow_rows
+        sidecar.stop()
+        ring.close()
+        waits = sorted(rec["waits"])
+        result["modes"][mode] = {
+            "req_per_s": round(n_reqs / elapsed, 1),
+            "p50_wait_ms": round(waits[len(waits) // 2], 3),
+            "p99_wait_ms": round(
+                waits[min(len(waits) - 1, int(0.99 * len(waits)))], 3),
+            "checksum": rec["checksum"],
+            "staged_bytes_per_req": round(staged / (2 * n_reqs), 1),
+            "dispatch_ewma_ms": (cost.get("stage_ewma_ms") or {}).get(
+                "dispatch"),
+            "dispatch_bytes_ewma_ms": cost.get("dispatch_bytes_ewma_ms"),
+            "depth_overflow_rows": overflow_rows,
+        }
+    print(json.dumps(result), flush=True)
+
+
 def bench_e2e(plan, lists, n_requests: int = 100_000) -> dict:
     """Committed end-to-end drive: loadgen_http -> httpd -> ring ->
     sidecar (device lane verdict) -> 403 / proxy -> pong."""
@@ -1532,6 +1738,15 @@ def _main_impl(result: dict, done=None) -> None:
             result.update(bench_pipeline())
         except Exception as exc:
             result["pipeline_error"] = repr(exc)[:200]
+    # Compact staging A/B (ISSUE 15): PINGOO_STAGING full vs compact
+    # over the same long-URL-tail ring traffic, identical-verdict-
+    # checksum asserted. Subprocess-isolated like the pipeline bench.
+    if ("--staging" in sys.argv
+            or os.environ.get("BENCH_SKIP_STAGING") != "1"):
+        try:
+            result.update(bench_staging())
+        except Exception as exc:
+            result["staging_error"] = repr(exc)[:200]
     # Streaming body-scan arm (ISSUE 13): interleaved multi-flow window
     # streams vs the contiguous one-shot over identical payloads, with
     # verdict equality (and the interpreter oracle) enforced.

@@ -25,11 +25,12 @@ from ..config.schema import RuleConfig
 from ..expr.values import Ip
 from .plan import RulesetPlan, compile_ruleset, split_config_token
 
-FORMAT_VERSION = 10  # bump when plan/table layout changes
+FORMAT_VERSION = 11  # bump when plan/table layout changes
 # v8: scan_plans (per-bank strategy selection, halo partition sub-banks)
 # v9: PrefilterPlan + pf_<field> factor tables (literal-prefilter cascade)
 # v10: bitsplit-DFA lowering — dfa_<field> DfaTables, NfaScanPlan
 #      dfa_key/dfa_strategy/dfa_auto, RulesetPlan.dfa_default_mode
+# v11: compact staging — RulesetPlan.staging_required/staging_caps
 
 
 def ruleset_fingerprint(rules: list[RuleConfig], lists: dict,

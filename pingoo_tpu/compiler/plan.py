@@ -38,7 +38,10 @@ from .lowering import (
     LeafRegistry,
     Lowerer,
     LowerError,
+    NBin,
     NfaPred,
+    NLen,
+    NNeg,
     NumCmp,
     StrListPred,
     StrPred,
@@ -80,6 +83,27 @@ DEFAULT_STEP_COSTS = {
 }
 
 DFA_KIND = "dfa"
+
+# -- Compact staging (ISSUE 15, docs/EXECUTOR.md "Compact staging") ----------
+#
+# The dispatch wall is bytes-proportional host staging (BENCH_pipeline:
+# ~39.6 ms/batch at B=2048 is the staging copy, not launches). Most
+# rulesets only inspect a small prefix of each string field, so the
+# compile pass below derives, per field, the maximum byte position any
+# compiled scanner can depend on, and `PINGOO_STAGING=compact` stages
+# only that capped prefix. The cap is quantized to this pow2 rung
+# ladder (à la megastep K) so hot-swapping between tenants whose caps
+# land on the same rung reuses the XLA compile.
+STAGING_RUNGS = (16, 32, 64, 128, 256, 512, 1024, 2048)
+
+
+def quantize_stage_cap(depth: int, spec: int) -> int:
+    """Smallest rung >= depth, clamped to the field's full spec (a
+    2-byte country code never pads out to rung 16)."""
+    for rung in STAGING_RUNGS:
+        if rung >= depth:
+            return min(rung, spec)
+    return spec
 
 
 def _kind_cost(c: dict, kind: str, default: float = 1.0) -> float:
@@ -459,6 +483,12 @@ class RulesetPlan:
     # (CPU diagnostic backend under auto, any backend under force) —
     # engine/verdict._dfa_win_active.
     win_dfa: dict[str, str] = dc_field(default_factory=dict)
+    # Compact staging (ISSUE 15): per-field raw dependent byte depth
+    # and the quantized staged cap PINGOO_STAGING=compact copies.
+    # Empty on plans cached before FORMAT_VERSION 11 — consumers fall
+    # back to field_specs (full staging) via getattr.
+    staging_required: dict[str, int] = dc_field(default_factory=dict)
+    staging_caps: dict[str, int] = dc_field(default_factory=dict)
 
     def device_tables(self) -> dict[str, Any]:
         """Materialize all tables as device arrays (a pytree)."""
@@ -585,7 +615,105 @@ def compile_ruleset(
         + sum(plan.np_tables[k].num_states
               for k in plan.win_dfa.values()),
     }
+    derive_staging_caps(plan)
     return plan
+
+
+def _num_ir_len_fields(ir) -> set[str]:
+    """Fields whose length() an arithmetic IR reads (NLen nodes)."""
+    out: set[str] = set()
+    stack = [ir]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, NLen):
+            out.add(node.field)
+        elif isinstance(node, NBin):
+            stack.append(node.left)
+            stack.append(node.right)
+        elif isinstance(node, NNeg):
+            stack.append(node.x)
+    return out
+
+
+def derive_staging_caps(plan: RulesetPlan) -> None:
+    """Per-field maximum dependent byte depth across every compiled
+    scanner, -> plan.staging_required (raw) and plan.staging_caps
+    (quantized to STAGING_RUNGS, clamped to the spec).
+
+    Soundness is structural — the staged view must only ever PRUNE
+    bytes no scanner reads, never change a verdict:
+
+      * eq needs |pattern|+1 bytes: the device compares exact `lens`
+        (full true values ride regardless of the staged width), and the
+        +1 guard keeps interpreter contexts built from staged bytes
+        exact too — a string truncated at cap >= |pat|+1 still has
+        length > |pat|, so equality stays False either way.
+      * prefix needs exactly |pattern| bytes.
+      * suffix anchors at the END of the true string -> full spec.
+      * contains/regex (NFA, bitsplit-DFA, window/MXU banks) and the
+        Stage-A prefilter scan the whole field -> full spec.
+      * length() inside device arithmetic (NLen) pins the field so the
+        interpreter fallback/parity contexts — whose length() comes
+        from the staged bytes — agree with the device's exact lens.
+      * host rules and host route predicates re-evaluate on contexts
+        built from the staged bytes, so every string field their AST
+        references is pinned to full spec.
+
+    Rows whose TRUE length exceeds a below-spec cap are flagged
+    overflow by the encoder and re-interpreted from the untruncated
+    source (the existing over-long backstop), which is what makes the
+    caps verdict-preserving without per-rule reasoning at eval time."""
+    specs = plan.field_specs
+    required: dict[str, int] = {f: 0 for f in specs}
+
+    def need(field: str, depth: int) -> None:
+        if field in required:
+            required[field] = max(required[field], int(depth))
+
+    def pin(field: str) -> None:
+        if field in required:
+            required[field] = int(specs[field])
+
+    for leaf in plan.leaves:
+        if isinstance(leaf, StrPred):
+            if leaf.kind == "eq":
+                need(leaf.field, len(leaf.pattern) + 1)
+            elif leaf.kind == "prefix":
+                need(leaf.field, len(leaf.pattern))
+            else:  # suffix: anchored at the true end of the string
+                pin(leaf.field)
+        elif isinstance(leaf, StrListPred):
+            need(leaf.field, max(
+                (len(e) for e in leaf.entries), default=0) + 1)
+        elif isinstance(leaf, NfaPred):
+            pin(leaf.field)
+        elif isinstance(leaf, NumCmp):
+            for f in _num_ir_len_fields(leaf.left):
+                pin(f)
+            for f in _num_ir_len_fields(leaf.right):
+                pin(f)
+        elif isinstance(leaf, IntListPred):
+            for f in _num_ir_len_fields(leaf.probe):
+                pin(f)
+    from ..expr import ast as _east
+
+    for rule in plan.rules:
+        if rule.host and rule.program is not None:
+            for node in _east.walk(rule.program.root):
+                if not isinstance(node, _east.Member) \
+                        or not isinstance(node.obj, _east.Ident):
+                    continue
+                if node.obj.name == "http_request" \
+                        and node.attr in specs:
+                    pin(node.attr)
+                elif node.obj.name == "client" \
+                        and node.attr == "country":
+                    pin("country")
+    plan.staging_required = dict(required)
+    plan.staging_caps = {
+        f: quantize_stage_cap(required[f], spec)
+        for f, spec in specs.items()
+    }
 
 
 def _assemble_tables(plan: RulesetPlan) -> None:

@@ -19,12 +19,14 @@ non-overflowing rows (the parity oracle view).
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
-from typing import Iterable, Mapping, Optional
+from typing import Iterable, Mapping, NamedTuple, Optional
 
 import numpy as np
 
 from ..compiler.lowering import DEFAULT_FIELD_SPECS
+from ..compiler.plan import quantize_stage_cap
 from ..expr import Context, Ip
 from ..ops.cidr import ip_to_words
 
@@ -62,6 +64,15 @@ class RequestBatch:
     size: int
     arrays: dict  # field -> np/jnp arrays
     overflow: Optional[np.ndarray] = None  # [size] bool or None
+    # Compact staging (ISSUE 15): the [size, layout.width] uint8 packed
+    # buffer shipped to the device as ONE async copy, and its static
+    # layout. None under PINGOO_STAGING=full — `arrays` is then the
+    # only device view. `arrays` stays populated either way (its byte
+    # matrices are strided views into `packed` when compact) for the
+    # host-side consumers: host-rule lanes, parity contexts, scorer.
+    packed: Optional[np.ndarray] = None
+    layout: Optional["PackedLayout"] = None
+    staged_bytes: int = 0  # host->device bytes this batch stages
 
     def __getitem__(self, key: str):
         return self.arrays[key]
@@ -195,6 +206,113 @@ def bucket_len(longest: int, cap: int, min_len: int = 16) -> int:
     return min(L, cap)
 
 
+# -- Compact staging (ISSUE 15, docs/EXECUTOR.md "Compact staging") ----------
+
+
+def resolve_staging_mode() -> str:
+    """PINGOO_STAGING: `full` (default; the bit-exact oracle — every
+    field stages its full spec width as separate arrays) or `compact`
+    (plan-derived capped widths in ONE packed buffer per batch)."""
+    mode = os.environ.get("PINGOO_STAGING", "full").strip().lower()
+    return "compact" if mode == "compact" else "full"
+
+
+def resolve_stage_caps(plan) -> Optional[dict[str, int]]:
+    """The per-field staged widths this plan serves under, or None in
+    full mode. Starts from the compile pass's quantized caps
+    (plan.staging_caps; full spec on plans cached before v11), then
+    applies the PINGOO_STAGING_DEPTH operator clamp (0 = off) —
+    re-quantized to the rung ladder so clamped tenants still share
+    XLA compiles."""
+    if resolve_staging_mode() != "compact":
+        return None
+    specs = dict(getattr(plan, "field_specs", None)
+                 or DEFAULT_FIELD_SPECS)
+    caps = dict(getattr(plan, "staging_caps", None) or {})
+    try:
+        depth = int(os.environ.get("PINGOO_STAGING_DEPTH", "0"))
+    except ValueError:
+        depth = 0
+    eff: dict[str, int] = {}
+    for field in STRING_FIELDS:
+        spec = int(specs.get(field, 256))
+        cap = min(int(caps.get(field, spec)), spec)
+        if depth > 0:
+            cap = min(cap, quantize_stage_cap(min(depth, spec), spec))
+        eff[field] = max(1, cap)
+    return eff
+
+
+def stage_overflow_thresholds(plan,
+                              eff: Mapping[str, int]) -> dict[str, int]:
+    """Per-field TRUE-length threshold beyond which a row must be
+    re-interpreted from its untruncated source. With caps at or above
+    the plan's required depth the threshold is the full spec (exactly
+    full mode's over-capacity rule); a cap clamped BELOW the required
+    depth (PINGOO_STAGING_DEPTH) drops bytes some scanner depends on,
+    so any row longer than the cap reroutes through the interpreter
+    backstop — which is what keeps clamped serving verdict-identical."""
+    specs = dict(getattr(plan, "field_specs", None)
+                 or DEFAULT_FIELD_SPECS)
+    required = getattr(plan, "staging_required", None) or {}
+    out: dict[str, int] = {}
+    for field in STRING_FIELDS:
+        spec = int(specs.get(field, 256))
+        need = min(int(required.get(field, spec)), spec)
+        cap = int(eff.get(field, spec))
+        out[field] = cap if cap < need else spec
+    return out
+
+
+class PackedLayout(NamedTuple):
+    """Static byte layout of one packed staging row (hashable — rides
+    the jitted packed fns as a static argument, so one XLA compile per
+    distinct caps rung-tuple). Per row: the capped byte region of each
+    string field, then a metadata tail — u16-LE true lens, the 16
+    big-endian IP bytes, and the i64-LE asn / remote_port words (full
+    width: numeric predicates must stay exact)."""
+
+    fields: tuple  # ((field, offset, width), ...) capped byte regions
+    lens: tuple    # ((field, offset), ...) u16 LE true lengths
+    ip_off: int    # 16 bytes, big-endian v6-mapped words
+    asn_off: int   # 8 bytes, i64 LE
+    port_off: int  # 8 bytes, i64 LE
+    width: int     # total row stride
+
+
+_LAYOUT_CACHE: dict[tuple, PackedLayout] = {}
+
+
+def build_packed_layout(stage_caps: Mapping[str, int]) -> PackedLayout:
+    """PackedLayout for a caps assignment; cached per widths-tuple so
+    hot-swaps between plans on the same rungs return the SAME (hash-
+    equal) layout and reuse the packed fns' XLA compile."""
+    widths = tuple(int(stage_caps[f]) for f in STRING_FIELDS)
+    cached = _LAYOUT_CACHE.get(widths)
+    if cached is not None:
+        return cached
+    fields = []
+    off = 0
+    for field, w in zip(STRING_FIELDS, widths):
+        fields.append((field, off, w))
+        off += w
+    lens = []
+    for field in STRING_FIELDS:
+        lens.append((field, off))
+        off += 2
+    ip_off = off
+    off += 16
+    asn_off = off
+    off += 8
+    port_off = off
+    off += 8
+    layout = PackedLayout(fields=tuple(fields), lens=tuple(lens),
+                          ip_off=ip_off, asn_off=asn_off,
+                          port_off=port_off, width=off)
+    _LAYOUT_CACHE[widths] = layout
+    return layout
+
+
 class StagingEncoder:
     """Pre-allocated, reused staging buffers for the zero-copy encode
     path (ISSUE 9, docs/EXECUTOR.md).
@@ -225,7 +343,9 @@ class StagingEncoder:
 
     def __init__(self, max_batch: int,
                  field_specs: Optional[Mapping[str, int]] = None,
-                 nbuf: int = 2):
+                 nbuf: int = 2,
+                 stage_caps: Optional[Mapping[str, int]] = None,
+                 overflow_thresholds: Optional[Mapping[str, int]] = None):
         specs = dict(field_specs or DEFAULT_FIELD_SPECS)
         self.max_batch = int(max_batch)
         self.specs = specs
@@ -245,6 +365,42 @@ class StagingEncoder:
             bufs["remote_port"] = np.zeros(self.max_batch, dtype=np.int64)
             bufs["overflow"] = np.zeros(self.max_batch, dtype=bool)
             self._bufs.append(bufs)
+        # Compact staging (ISSUE 15): flat packed rows, FULL-spec-sized
+        # once at boot so a hot-swap that widens caps never reallocates
+        # — per batch only the current layout's [P, width] prefix is
+        # touched and shipped.
+        self.stage_caps: Optional[dict[str, int]] = None
+        self._thresholds: dict[str, int] = dict(specs)
+        self._layout: Optional[PackedLayout] = None
+        if stage_caps is not None:
+            full_w = build_packed_layout(
+                {f: specs.get(f, 256) for f in STRING_FIELDS}).width
+            for bufs in self._bufs:
+                bufs["packed"] = np.zeros(
+                    self.max_batch * full_w, dtype=np.uint8)
+            self.set_stage_caps(stage_caps, overflow_thresholds)
+
+    def set_stage_caps(
+            self, stage_caps: Mapping[str, int],
+            overflow_thresholds: Optional[Mapping[str, int]] = None
+    ) -> None:
+        """Install a plan's staged widths (hot-swap flip point: called
+        only between batches, like _adopt_*_state). The packed buffers
+        are spec-sized, so widening is just a new layout."""
+        if "packed" not in self._bufs[0]:
+            raise ValueError(
+                "encoder was built without packed staging buffers")
+        self.stage_caps = {f: min(int(stage_caps.get(
+            f, self.specs.get(f, 256))), self.specs.get(f, 256))
+            for f in STRING_FIELDS}
+        self._layout = build_packed_layout(self.stage_caps)
+        self._thresholds = dict(self.specs)
+        if overflow_thresholds is not None:
+            for f in STRING_FIELDS:
+                self._thresholds[f] = min(
+                    int(overflow_thresholds.get(
+                        f, self.specs.get(f, 256))),
+                    self.specs.get(f, 256))
 
     def _checkout(self) -> dict:
         buf = self._bufs[self._cursor]
@@ -267,6 +423,8 @@ class StagingEncoder:
             raise ValueError(f"bad staging shape: B={B} pad_to={pad_to} "
                              f"max_batch={self.max_batch}")
         buf = self._checkout()
+        if self._layout is not None:
+            return self._encode_requests_packed(requests, B, P, buf)
         arrays: dict = {}
         overflow = buf["overflow"][:P]
         overflow[:] = False
@@ -309,7 +467,79 @@ class StagingEncoder:
             port[i] = _clamp_i64(req.remote_port)
         arrays["asn"] = asn
         arrays["remote_port"] = port
-        return RequestBatch(size=P, arrays=arrays, overflow=overflow)
+        staged = sum(a.nbytes for a in arrays.values())
+        return RequestBatch(size=P, arrays=arrays, overflow=overflow,
+                            staged_bytes=staged)
+
+    def _encode_requests_packed(self, requests, B: int, P: int,
+                                buf: dict) -> RequestBatch:
+        """Compact-mode tuple encode (hot): capped field prefixes +
+        metadata tail into ONE flat [P, width] packed buffer; the
+        returned arrays' byte matrices are strided views into it, so
+        host consumers (host-rule lanes, parity contexts, the scorer)
+        read the exact bytes the device decodes."""
+        layout = self._layout
+        W = layout.width
+        pk = buf["packed"][: P * W].reshape(P, W)
+        pk[:] = 0
+        arrays: dict = {}
+        overflow = buf["overflow"][:P]
+        overflow[:] = False
+        for field, off, w in layout.fields:
+            spec = self.specs.get(field, 256)
+            limit = self._thresholds.get(field, spec)
+            data = pk[:, off:off + w]
+            lens = buf[f"{field}_len"][:P]
+            lens[B:] = 0
+            for i, req in enumerate(requests):
+                full = _to_bytes(getattr(req, field))
+                if len(full) > limit:
+                    overflow[i] = True
+                raw = full[:w]
+                data[i, : len(raw)] = np.frombuffer(raw, dtype=np.uint8)
+                # TRUE length (up to spec) regardless of the staged
+                # width: device length predicates must stay exact.
+                lens[i] = min(len(full), spec)
+            arrays[f"{field}_bytes"] = data
+            arrays[f"{field}_len"] = lens
+        ip = buf["ip"][:P]
+        ip[B:] = 0
+        for i, req in enumerate(requests):
+            try:
+                ip[i], _ = ip_to_words(Ip(req.ip))
+            except Exception:
+                ip[i] = 0  # unparseable -> never matches any predicate
+        arrays["ip"] = ip
+        asn = buf["asn"][:P]
+        port = buf["remote_port"][:P]
+        asn[B:] = 0
+        port[B:] = 0
+        for i, req in enumerate(requests):
+            asn[i] = _clamp_i64(req.asn)
+            port[i] = _clamp_i64(req.remote_port)
+        arrays["asn"] = asn
+        arrays["remote_port"] = port
+        self._pack_meta(pk, P, buf, layout)
+        return RequestBatch(size=P, arrays=arrays, overflow=overflow,
+                            packed=pk, layout=layout,
+                            staged_bytes=P * W)
+
+    def _pack_meta(self, pk: np.ndarray, P: int, buf: dict,
+                   layout: PackedLayout) -> None:
+        """Write the metadata tail of every packed row from the side
+        arrays (hot): u16-LE lens columns, big-endian IP bytes, i64-LE
+        asn/port bytes. The side arrays stay authoritative for host
+        consumers; the tail is what the device decodes."""
+        for field, off in layout.lens:
+            lens = buf[f"{field}_len"][:P]
+            pk[:, off] = lens & 0xFF
+            pk[:, off + 1] = (lens >> 8) & 0xFF
+        pk[:, layout.ip_off:layout.ip_off + 16] = \
+            buf["ip"][:P].astype(">u4").view(np.uint8)
+        pk[:, layout.asn_off:layout.asn_off + 8] = \
+            buf["asn"][:P].view(np.uint8).reshape(P, 8)
+        pk[:, layout.port_off:layout.port_off + 8] = \
+            buf["remote_port"][:P].view(np.uint8).reshape(P, 8)
 
     def encode_slots(self, slots: np.ndarray,
                      pad_to: Optional[int] = None) -> RequestBatch:
@@ -327,6 +557,8 @@ class StagingEncoder:
             raise ValueError(f"bad staging shape: n={n} pad_to={pad_to} "
                              f"max_batch={self.max_batch}")
         buf = self._checkout()
+        if self._layout is not None:
+            return self._encode_slots_packed(slots, n, P, buf)
         arrays: dict = {}
         for field, len_key in SLOT_LEN_KEYS.items():
             cap = self.specs.get(field, 256)
@@ -364,7 +596,65 @@ class StagingEncoder:
         port[:n] = slots["remote_port"]
         port[n:] = 0
         arrays["remote_port"] = port
-        return RequestBatch(size=P, arrays=arrays, overflow=None)
+        staged = sum(a.nbytes for a in arrays.values())
+        return RequestBatch(size=P, arrays=arrays, overflow=None,
+                            staged_bytes=staged)
+
+    def _encode_slots_packed(self, slots: np.ndarray, n: int, P: int,
+                             buf: dict) -> RequestBatch:
+        """Compact-mode slot encode (hot): the capped prefix of every
+        string field copied STRAIGHT from the shm slot rows into the
+        packed buffer — one strided copy per field region, no
+        intermediate per-field staging matrices. Depth-overflow rows
+        (true slot length beyond a clamped cap) are flagged for the
+        sidecar's interpreter backstop; with unclamped plan caps the
+        thresholds equal the specs and no slot row can exceed them
+        (over-spec requests already ride the TRUNCATED/spill flags)."""
+        layout = self._layout
+        W = layout.width
+        pk = buf["packed"][: P * W].reshape(P, W)
+        pk[:] = 0
+        arrays: dict = {}
+        overflow = buf["overflow"][:P]
+        overflow[:] = False
+        for field, off, w in layout.fields:
+            data = pk[:, off:off + w]
+            if field == "country":
+                data[:n] = np.frombuffer(
+                    slots["country"].tobytes(),
+                    dtype=np.uint8).reshape(-1, 2)[:, :w]
+                clens = buf["country_len"][:P]
+                clens[:n] = 2
+                clens[n:] = 0
+                arrays["country_bytes"] = data
+                arrays["country_len"] = clens
+                continue
+            spec = self.specs.get(field, 256)
+            limit = self._thresholds.get(field, spec)
+            lens = buf[f"{field}_len"][:P]
+            lens[:n] = slots[SLOT_LEN_KEYS[field]]
+            lens[n:] = 0
+            if limit < spec:
+                overflow[:n] |= lens[:n] > limit
+            data[:n] = slots[field][:, :w]
+            arrays[f"{field}_bytes"] = data
+            arrays[f"{field}_len"] = lens
+        ip = buf["ip"][:P]
+        ip[:n] = slots["ip"].view(">u4")
+        ip[n:] = 0
+        arrays["ip"] = ip
+        asn = buf["asn"][:P]
+        asn[:n] = slots["asn"]
+        asn[n:] = 0
+        arrays["asn"] = asn
+        port = buf["remote_port"][:P]
+        port[:n] = slots["remote_port"]
+        port[n:] = 0
+        arrays["remote_port"] = port
+        self._pack_meta(pk, P, buf, layout)
+        return RequestBatch(size=P, arrays=arrays, overflow=overflow,
+                            packed=pk, layout=layout,
+                            staged_bytes=P * W)
 
 
 class DeviceInputQueue:

@@ -44,11 +44,15 @@ from .batch import (
     encode_requests,
     pad_batch,
     pow2_batch_size,
+    resolve_stage_caps,
+    stage_overflow_thresholds,
     tuple_to_context,
 )
 from .verdict import (_resolve_megastep_mode, action_lanes, finish_batch,
-                      finish_megastep, make_megastep_fn, make_prefilter_fn,
-                      make_verdict_fn, megastep_k_cap, megastep_k_ladder)
+                      finish_megastep, make_megastep_fn,
+                      make_packed_prefilter_fn, make_packed_verdict_fn,
+                      make_prefilter_fn, make_verdict_fn, megastep_k_cap,
+                      megastep_k_ladder)
 
 # Per-stage slices of the PINGOO_DEADLINE_MS budget (ISSUE 9,
 # docs/EXECUTOR.md): cumulative launch-relative fractions a batch may
@@ -261,6 +265,17 @@ class ServiceStats:
             "pingoo_dfa_recheck_total",
             DFA_METRICS["pingoo_dfa_recheck_total"],
             labels={"plane": "python"})
+        # Compact staging (ISSUE 15): bytes actually staged to the
+        # device per verdict batch, split by the PINGOO_STAGING arm —
+        # the numerator of the dispatch-wall reduction this plane is
+        # serving under.
+        from ..obs.schema import STAGING_METRICS
+        self.staged_bytes_counter = {
+            mode: REGISTRY.counter(
+                "pingoo_staged_bytes_total",
+                STAGING_METRICS["pingoo_staged_bytes_total"],
+                labels={"plane": "python", "mode": mode})
+            for mode in ("full", "compact")}
 
     def observe_stage(self, stage: str, ms: float, n: int = 1) -> None:
         h = self.stage_hist[stage]
@@ -365,12 +380,20 @@ class VerdictService:
         except ValueError:
             pass
         self._pipe = PipelineStats("python", self._pipeline_depth)
+        # Compact staging (ISSUE 15, docs/EXECUTOR.md "Compact
+        # staging"): PINGOO_STAGING=compact stages plan-capped field
+        # prefixes into ONE packed buffer and ships it in a single
+        # device_put; the jitted programs slice the fields back out on
+        # device. `full` (the default) keeps the per-field staging path
+        # byte-for-byte untouched — the bit-identity oracle.
+        self._stage_caps: Optional[dict] = None
+        self._packed_verdict_fn = None
+        self._packed_pf_fn = None
         self._staging: Optional[StagingEncoder] = None
         if self.pipeline_mode == "on":
             # nbuf = depth + 1: every in-flight batch holds one buffer
             # set and the collector encodes the next into another.
-            self._staging = StagingEncoder(max_batch, plan.field_specs,
-                                           nbuf=self._pipeline_depth + 1)
+            self._staging = self._make_staging(plan)
         import threading as _threading
 
         # Per-stage in-flight tokens: host stages are serialized ACROSS
@@ -458,6 +481,19 @@ class VerdictService:
             state["pf_attr"] = (
                 PrefilterAttribution(pf.masked, plane="python")
                 if pf is not None and provenance_enabled() else None)
+            # Compact staging (ISSUE 15): the packed twins trace the
+            # SAME predicate bodies over unpack_staged's device-side
+            # slices; built only under PINGOO_STAGING=compact, so the
+            # default path compiles nothing new.
+            state["stage_caps"] = resolve_stage_caps(plan)
+            state["packed_verdict_fn"] = None
+            state["packed_pf_fn"] = None
+            if state["stage_caps"] is not None:
+                state["packed_verdict_fn"] = make_packed_verdict_fn(
+                    plan, donate=donate_batch_buffers())
+                ppf = make_packed_prefilter_fn(plan)
+                state["packed_pf_fn"] = \
+                    ppf.fn if ppf is not None else None
             # Mesh BEFORE table materialization: tp padding must
             # land in plan.np_tables before device_tables() runs.
             mesh = self._build_mesh(plan)
@@ -468,10 +504,8 @@ class VerdictService:
                 tables = jax.device_put(tables, device)
             state["mesh"] = mesh
             state["tables"] = tables
-            state["staging"] = (
-                StagingEncoder(self.max_batch, plan.field_specs,
-                               nbuf=self._pipeline_depth + 1)
-                if self.pipeline_mode == "on" else None)
+            state["staging"] = (self._make_staging(plan)
+                                if self.pipeline_mode == "on" else None)
             # Megastep window program (ISSUE 12): built only when
             # PINGOO_MEGASTEP is enabled at state-build time — `off`
             # (the default, and the bit-exact parity oracle) leaves
@@ -505,6 +539,42 @@ class VerdictService:
             self._staging = state["staging"]
         self._mega_fn = state.get("mega_fn")
         self._mega_queue = state.get("mega_queue")
+        # Compact staging (ISSUE 15): the packed fns + caps flip with
+        # the plan at the same batch boundary the staging encoder does,
+        # so every batch is encoded AND decoded under one cap set.
+        self._stage_caps = state.get("stage_caps")
+        self._packed_verdict_fn = state.get("packed_verdict_fn")
+        self._packed_pf_fn = state.get("packed_pf_fn")
+        self._set_cap_gauges()
+
+    def _make_staging(self, plan: RulesetPlan) -> StagingEncoder:
+        """The staging encoder for a plan: plain rotating buffers under
+        PINGOO_STAGING=full, packed one-copy layout under =compact
+        (caps from the plan's compile-time staging pass, overflow
+        thresholds keeping the rewrite set exact)."""
+        caps = resolve_stage_caps(plan)
+        if caps is None:
+            return StagingEncoder(self.max_batch, plan.field_specs,
+                                  nbuf=self._pipeline_depth + 1)
+        return StagingEncoder(
+            self.max_batch, plan.field_specs,
+            nbuf=self._pipeline_depth + 1, stage_caps=caps,
+            overflow_thresholds=stage_overflow_thresholds(plan, caps))
+
+    def _set_cap_gauges(self) -> None:
+        """Export the adopted plan's per-field staging caps (host-
+        static per epoch; the observable half of the staged-bytes
+        reduction)."""
+        if not self._stage_caps:
+            return
+        from ..obs import REGISTRY
+        from ..obs.schema import STAGING_METRICS
+
+        for field, cap in self._stage_caps.items():
+            REGISTRY.gauge(
+                "pingoo_staging_field_cap",
+                STAGING_METRICS["pingoo_staging_field_cap"],
+                labels={"field": field}).set(int(cap))
 
     def _build_mesh(self, plan) -> MeshExecutor:
         """The serving mesh for this plane (PINGOO_MESH). Degrades to
@@ -531,6 +601,11 @@ class VerdictService:
         self.plan.dfa_default_mode = "off" if dfa_off else self._dfa_mode0
         self._verdict_fn = make_verdict_fn(
             self.plan, donate=donate_batch_buffers())
+        if self._packed_verdict_fn is not None:
+            # The packed twin embeds the same DFA dispatch decision;
+            # keep it in lockstep with the per-batch program.
+            self._packed_verdict_fn = make_packed_verdict_fn(
+                self.plan, donate=donate_batch_buffers())
         if self._mega_fn is not None:
             # The megastep embeds the same DFA dispatch decision; keep
             # it in lockstep with the per-batch program it must stay
@@ -825,6 +900,24 @@ class VerdictService:
             if state["pf_fn"] is not None:
                 pf_hits, _ = state["pf_fn"](state["tables"], dev_arrays)
             state["verdict_fn"](state["tables"], dev_arrays, pf_hits)
+            # Compact staging (ISSUE 15): warm the packed twins on the
+            # new plan's layout rung too — a swap that widens a cap
+            # must not pay its re-trace inside a serving deadline.
+            if (state.get("packed_verdict_fn") is not None
+                    and state.get("staging") is not None):
+                import jax
+
+                pb = state["staging"].encode_requests(
+                    [RequestTuple()], pad_to=1)
+                if pb.packed is not None and not (
+                        mesh is not None and mesh.active):
+                    dev_packed = jax.device_put(pb.packed)
+                    pf_hits = None
+                    if state.get("packed_pf_fn") is not None:
+                        pf_hits, _ = state["packed_pf_fn"](
+                            state["tables"], dev_packed, pb.layout)
+                    state["packed_verdict_fn"](
+                        state["tables"], dev_packed, pb.layout, pf_hits)
         except Exception:
             pass
 
@@ -1320,6 +1413,19 @@ class VerdictService:
                     dev_arrays = fast.arrays
                     if self.mesh is not None and self.mesh.active:
                         dev_arrays = self.mesh.shard_batch(dev_arrays)
+                    # Compact staging (ISSUE 15): one device_put of the
+                    # packed buffer replaces the per-field transfers —
+                    # the bytes-proportional slice of the dispatch
+                    # wall. Mesh stays on the per-field path (the
+                    # shard plan addresses named arrays).
+                    use_packed = (
+                        staged and batch.packed is not None
+                        and self._packed_verdict_fn is not None
+                        and not (self.mesh is not None
+                                 and self.mesh.active))
+                    if use_packed:
+                        import jax
+                        dev_packed = jax.device_put(batch.packed)
                     pf_hits = pf_aux = None
                     if self._pf_fn is not None:
                         # Stage A (always-on, whole batch): factor hits
@@ -1327,14 +1433,23 @@ class VerdictService:
                         # aux lanes feed the candidate-rate/skip
                         # metrics after the batch's sync point.
                         t0 = time.monotonic()
-                        pf_hits, pf_aux = self._pf_fn(self._tables,
-                                                      dev_arrays)
+                        if use_packed and self._packed_pf_fn is not None:
+                            pf_hits, pf_aux = self._packed_pf_fn(
+                                self._tables, dev_packed, batch.layout)
+                        else:
+                            pf_hits, pf_aux = self._pf_fn(self._tables,
+                                                          dev_arrays)
                         self._batch_stage(
                             "prefilter", (time.monotonic() - t0) * 1e3,
                             stages)
                     t0 = time.monotonic()
-                    dev = self._verdict_fn(self._tables, dev_arrays,
-                                           pf_hits)
+                    if use_packed:
+                        dev = self._packed_verdict_fn(
+                            self._tables, dev_packed, batch.layout,
+                            pf_hits)
+                    else:
+                        dev = self._verdict_fn(self._tables, dev_arrays,
+                                               pf_hits)
                     # jax dispatch is async: this stage is issue +
                     # host->device transfer; the on-device execution
                     # residual is timed inside finish_batch via
@@ -1344,6 +1459,16 @@ class VerdictService:
                         "device_dispatch", (time.monotonic() - t0) * 1e3,
                         stages)
                 td1 = time.monotonic()
+                # Staged-bytes accounting (ISSUE 15): the transfer
+                # volume behind this dispatch window, on the metrics
+                # surface AND into the scheduler's bytes-keyed
+                # dispatch EWMA.
+                if batch.staged_bytes:
+                    self.stats.staged_bytes_counter[
+                        "compact" if batch.packed is not None
+                        else "full"].inc(batch.staged_bytes)
+                    self.sched.observe_dispatch_bytes(
+                        batch.staged_bytes, (td1 - td0) * 1e3)
                 if pipe_slot is not None:
                     self._pipe.note_stage(pipe_slot, "dispatch", td0, td1)
                 self._check_stage_budget("dispatch", t_launch)

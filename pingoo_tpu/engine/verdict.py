@@ -774,6 +774,63 @@ def make_verdict_fn(plan: RulesetPlan, donate: bool = False):
     return jax.jit(verdict, donate_argnums=(1,) if donate else ())
 
 
+# -- compact staging: device-side decode (ISSUE 15) ---------------------------
+
+
+def unpack_staged(packed, layout):
+    """Decode ONE packed staging buffer on device: [B, layout.width]
+    uint8 -> the standard per-field arrays dict the traced evaluator
+    bodies consume. Every offset/width is a static Python int from the
+    (static-argument) PackedLayout, so each field comes out as a
+    contiguous XLA slice — no gather, and the downstream predicate
+    kernels trace exactly as they do over separately-staged arrays.
+
+    Metadata tail: u16-LE true lens, 16 big-endian IP bytes -> [B, 4]
+    uint32 words, i64-LE asn/remote_port reassembled through uint64
+    shifts + a bitcast so negative values round-trip exactly."""
+    arrays = {}
+    for name, off, w in layout.fields:
+        arrays[f"{name}_bytes"] = packed[:, off:off + w]
+    for name, off in layout.lens:
+        lo = packed[:, off].astype(jnp.int32)
+        hi = packed[:, off + 1].astype(jnp.int32)
+        arrays[f"{name}_len"] = lo | (hi << 8)
+    B = packed.shape[0]
+    ipb = packed[:, layout.ip_off:layout.ip_off + 16] \
+        .astype(jnp.uint32).reshape(B, 4, 4)
+    arrays["ip"] = ((ipb[:, :, 0] << 24) | (ipb[:, :, 1] << 16)
+                    | (ipb[:, :, 2] << 8) | ipb[:, :, 3])
+
+    def _i64(off):
+        b = packed[:, off:off + 8].astype(jnp.uint64)
+        v = b[:, 0]
+        for k in range(1, 8):
+            v = v | (b[:, k] << (8 * k))
+        return jax.lax.bitcast_convert_type(v, jnp.int64)
+
+    arrays["asn"] = _i64(layout.asn_off)
+    arrays["remote_port"] = _i64(layout.port_off)
+    return arrays
+
+
+def make_packed_verdict_fn(plan: RulesetPlan, donate: bool = False):
+    """Compact-staging twin of make_verdict_fn: (tables, packed,
+    layout, pf_hits) -> [B, R_dev] bool. `layout` is a STATIC argument
+    (engine/batch.PackedLayout is a hashable NamedTuple), so the traced
+    body is literally _matched_cols over unpack_staged's slices — full
+    and compact mode share every predicate kernel by construction, and
+    plans whose caps land on the same rung-tuple share one XLA
+    compile."""
+
+    def verdict(tables, packed, layout, pf_hits=None):
+        return _matched_cols(plan, tables,
+                             unpack_staged(packed, layout),
+                             pf_hits=pf_hits)
+
+    return jax.jit(verdict, static_argnums=(2,),
+                   donate_argnums=(1,) if donate else ())
+
+
 class PrefilterProgram(NamedTuple):
     """make_prefilter_fn's bundle: the jitted Stage-A pass plus the
     static bank inventories the observability fold needs (gated = every
@@ -851,6 +908,24 @@ def make_prefilter_fn(plan: RulesetPlan):
                             masked=masked)
 
 
+def make_packed_prefilter_fn(plan: RulesetPlan):
+    """Compact-staging twin of make_prefilter_fn: the jitted Stage-A
+    signature becomes (tables, packed, layout) with `layout` static, so
+    the prefilter reads its fields straight out of the one-copy packed
+    buffer (ISSUE 15). Same PrefilterProgram contract; None when the
+    plan has no prefilter."""
+    body = _make_prefilter_body(plan)
+    if body is None:
+        return None
+    stage_a, gated, masked = body
+
+    def stage_a_packed(tables, packed, layout):
+        return stage_a(tables, unpack_staged(packed, layout))
+
+    return PrefilterProgram(fn=jax.jit(stage_a_packed, static_argnums=(2,)),
+                            gated=gated, masked=masked)
+
+
 LANE_NONE = np.int32(2**30)  # "no rule": sorts after every real index
 
 
@@ -895,6 +970,31 @@ def make_lane_fn(plan: RulesetPlan, services: list[str] | None = None,
               else ([services] if services else []))
     lanes = _make_lane_body(plan, groups, with_rule_hits)
     return jax.jit(lanes, donate_argnums=(1,) if donate else ())
+
+
+def make_packed_lane_fn(plan: RulesetPlan,
+                        services: list[str] | None = None,
+                        service_groups: list[list[str]] | None = None,
+                        with_rule_hits: bool = False,
+                        donate: bool = False):
+    """Compact-staging twin of make_lane_fn (ISSUE 15): the jitted lane
+    reduction takes (tables, packed, layout, pf_hits, n_valid) with
+    `layout` static and decodes the one-copy packed buffer on device
+    via unpack_staged. The traced body is the SAME _make_lane_body
+    closure make_lane_fn jits, so per-batch lanes are bit-identical
+    across staging modes by construction."""
+    if service_groups is not None and services is not None:
+        raise ValueError("pass services or service_groups, not both")
+    groups = (service_groups if service_groups is not None
+              else ([services] if services else []))
+    lanes = _make_lane_body(plan, groups, with_rule_hits)
+
+    def lanes_packed(tables, packed, layout, pf_hits=None, n_valid=None):
+        return lanes(tables, unpack_staged(packed, layout),
+                     pf_hits=pf_hits, n_valid=n_valid)
+
+    return jax.jit(lanes_packed, static_argnums=(2,),
+                   donate_argnums=(1,) if donate else ())
 
 
 def _make_lane_body(plan: RulesetPlan, groups: list[list[str]],

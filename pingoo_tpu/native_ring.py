@@ -712,6 +712,10 @@ class RingSidecar:
         self.processed = 0
         self.truncated_rows = 0
         self.spilled_rows = 0  # overflow rows re-evaluated untruncated
+        # Depth-capped rows re-evaluated over the full slot view
+        # (ISSUE 15: PINGOO_STAGING=compact with a PINGOO_STAGING_DEPTH
+        # clamp below a field's required depth).
+        self.depth_overflow_rows = 0
         self.batches = 0
         self.device_wait_s = 0.0  # blocking time on device lane results
         self._ring_rr = -1  # rotating drain start (multi-ring fairness)
@@ -775,9 +779,7 @@ class RingSidecar:
         pool_n = max(self.pipeline_depth,
                      self._mega_k if self._mega_mode != "off" else 1) + 1
         if self._zero_copy:
-            self._staging = StagingEncoder(
-                max_batch, field_specs=caps,
-                nbuf=self.pipeline_depth + 1)
+            self._staging = self._make_staging(plan, caps)
             for _ in range(pool_n):
                 self._slot_pool.append(
                     np.zeros(max_batch, dtype=REQUEST_SLOT_DTYPE))
@@ -789,6 +791,17 @@ class RingSidecar:
             for stage in ("sched", "encode", "prefilter",
                           "device_dispatch", "device_compute", "resolve",
                           "provenance")}
+        # Compact staging (ISSUE 15): bytes staged to the device per
+        # verdict batch, by PINGOO_STAGING arm — same series the Python
+        # listener plane exports.
+        from .obs.schema import STAGING_METRICS
+
+        self._staged_bytes_counter = {
+            mode: REGISTRY.counter(
+                "pingoo_staged_bytes_total",
+                STAGING_METRICS["pingoo_staged_bytes_total"],
+                labels={"plane": "sidecar", "mode": mode})
+            for mode in ("full", "compact")}
         # Stage-A literal prefilter (docs/PREFILTER.md): the sidecar is
         # the native plane's verdict engine, so it exports the same
         # candidate-rate/skip metrics the Python listener plane does.
@@ -917,12 +930,33 @@ class RingSidecar:
 
     # -- ruleset hot-swap (ISSUE 11, docs/RESILIENCE.md) ----------------------
 
+    def _make_staging(self, plan, caps: dict):
+        """The zero-copy staging encoder for a plan: plain rotating
+        buffers under PINGOO_STAGING=full, packed one-copy layout under
+        =compact (ISSUE 15) — slot-direct capped-prefix copies into one
+        flat buffer, one device_put per batch."""
+        from .engine.batch import (StagingEncoder, resolve_stage_caps,
+                                   stage_overflow_thresholds)
+
+        scaps = resolve_stage_caps(plan)
+        if scaps is None:
+            return StagingEncoder(self.max_batch, field_specs=caps,
+                                  nbuf=self.pipeline_depth + 1)
+        return StagingEncoder(
+            self.max_batch, field_specs=caps,
+            nbuf=self.pipeline_depth + 1, stage_caps=scaps,
+            overflow_thresholds=stage_overflow_thresholds(plan, scaps))
+
     def _build_plan_state(self, plan) -> dict:
         """Every plan-derived piece of the sidecar's engine state, built
         OFF the drain loop (init, or a request_swap caller's thread —
         compile-ahead through compiler/cache): the drain loop's flip is
         then pointer assignment at a batch boundary, never compilation."""
+        from .engine.batch import (resolve_stage_caps,
+                                   stage_overflow_thresholds)
         from .engine.verdict import (donate_batch_buffers, make_lane_fn,
+                                     make_packed_lane_fn,
+                                     make_packed_prefilter_fn,
                                      make_prefilter_fn)
         from .sched import MeshExecutor, MeshUnavailable
 
@@ -931,6 +965,24 @@ class RingSidecar:
             plan, service_groups=self._groups or None,
             with_rule_hits=self._provenance_on,
             donate=donate_batch_buffers())
+        # Compact staging (ISSUE 15): the packed twins decode the
+        # one-copy buffer on device; built only under
+        # PINGOO_STAGING=compact (the default full arm traces nothing
+        # new). Caps/thresholds flip with the plan at the same batch
+        # boundary the fns do.
+        state["stage_caps"] = resolve_stage_caps(plan)
+        state["stage_thresholds"] = None
+        state["packed_lane_fn"] = None
+        state["packed_pf_fn"] = None
+        if state["stage_caps"] is not None:
+            state["stage_thresholds"] = stage_overflow_thresholds(
+                plan, state["stage_caps"])
+            state["packed_lane_fn"] = make_packed_lane_fn(
+                plan, service_groups=self._groups or None,
+                with_rule_hits=self._provenance_on,
+                donate=donate_batch_buffers())
+            ppf = make_packed_prefilter_fn(plan)
+            state["packed_pf_fn"] = ppf.fn if ppf is not None else None
         # Services whose route predicate fell back to host interpretation
         # are merged into the device route lane per batch (per group).
         host_routes: list = []
@@ -1004,6 +1056,30 @@ class RingSidecar:
         self._mega_fn = state.get("mega_fn")
         self._dfa_mode0 = getattr(plan, "dfa_default_mode", "auto")
         self._dfa_probe = False
+        # Compact staging (ISSUE 15): re-cap the staging encoder's
+        # packed layout for the new plan at the same flip — every batch
+        # is encoded AND decoded under one cap set, so a swap that
+        # widens a cap changes layout only at this batch boundary.
+        self._stage_caps = state.get("stage_caps")
+        self._packed_lane_fn = state.get("packed_lane_fn")
+        self._packed_pf_fn = state.get("packed_pf_fn")
+        if self._staging is not None and self._stage_caps is not None:
+            try:
+                self._staging.set_stage_caps(
+                    self._stage_caps, state.get("stage_thresholds"))
+            except ValueError:
+                # Encoder built without packed buffers (mode flipped
+                # between boot and swap): keep the per-field path.
+                self._packed_lane_fn = self._packed_pf_fn = None
+        if self._stage_caps:
+            from .obs import REGISTRY
+            from .obs.schema import STAGING_METRICS
+
+            for field, cap in self._stage_caps.items():
+                REGISTRY.gauge(
+                    "pingoo_staging_field_cap",
+                    STAGING_METRICS["pingoo_staging_field_cap"],
+                    labels={"field": field}).set(int(cap))
         self._plan_state = state
         if self._provenance_on:
             from .obs.flightrecorder import (FlightRecorder,
@@ -1394,7 +1470,10 @@ class RingSidecar:
                     raw = RequestBatch(
                         size=n,
                         arrays={k: v[:n]
-                                for k, v in batch.arrays.items()})
+                                for k, v in batch.arrays.items()},
+                        overflow=(batch.overflow[:n]
+                                  if batch.overflow is not None
+                                  else None))
                     self.ladder.note_success("pipeline")
                 except Exception as exc:
                     # Ladder pipeline rung: a broken staging encoder
@@ -1443,20 +1522,47 @@ class RingSidecar:
                 # in XLA for seconds — the watchdog heartbeats through
                 # it so the data plane doesn't flip degraded.
                 with self._hb_busy():
-                    if self._pf_fn is not None:
-                        pf_hits, pf_aux = self._pf_fn(
-                            self._tables, arrays)  # async
-                    tpf = time.monotonic()
-                    if self._provenance_on:
-                        # Attribution aux lane rides the SAME dispatch;
-                        # the traced n masks batch-padding rows on
-                        # device.
-                        dev, rule_hits = self._lane_fn(
-                            self._tables, arrays, pf_hits,
-                            np.int32(n))  # async
+                    # Compact staging (ISSUE 15): ONE device_put of the
+                    # packed buffer replaces the per-field transfers;
+                    # the packed twins slice the fields back out on
+                    # device. Mesh stays on the per-field path (the
+                    # shard plan addresses named arrays).
+                    use_packed = (
+                        batch.packed is not None
+                        and self._packed_lane_fn is not None
+                        and not self.mesh.active)
+                    if use_packed:
+                        import jax
+
+                        dev_packed = jax.device_put(batch.packed)
+                        if self._packed_pf_fn is not None:
+                            pf_hits, pf_aux = self._packed_pf_fn(
+                                self._tables, dev_packed,
+                                batch.layout)  # async
+                        tpf = time.monotonic()
+                        if self._provenance_on:
+                            dev, rule_hits = self._packed_lane_fn(
+                                self._tables, dev_packed, batch.layout,
+                                pf_hits, np.int32(n))  # async
+                        else:
+                            dev = self._packed_lane_fn(
+                                self._tables, dev_packed, batch.layout,
+                                pf_hits)  # async
                     else:
-                        dev = self._lane_fn(self._tables, arrays,
-                                            pf_hits)  # async
+                        if self._pf_fn is not None:
+                            pf_hits, pf_aux = self._pf_fn(
+                                self._tables, arrays)  # async
+                        tpf = time.monotonic()
+                        if self._provenance_on:
+                            # Attribution aux lane rides the SAME
+                            # dispatch; the traced n masks
+                            # batch-padding rows on device.
+                            dev, rule_hits = self._lane_fn(
+                                self._tables, arrays, pf_hits,
+                                np.int32(n))  # async
+                        else:
+                            dev = self._lane_fn(self._tables, arrays,
+                                                pf_hits)  # async
             except Exception as exc:
                 self._note_device_failure(exc)
                 pf_hits = pf_aux = rule_hits = dev = None
@@ -1477,6 +1583,15 @@ class RingSidecar:
                                       (t1 - t0) * 1e3)
         self.sched.observe_stage_cost("dispatch", self.max_batch,
                                       (t2 - t1) * 1e3)
+        # Staged-bytes accounting (ISSUE 15): the transfer volume
+        # behind this dispatch window, on the metrics surface AND into
+        # the scheduler's bytes-keyed dispatch EWMA.
+        if batch.staged_bytes:
+            self._staged_bytes_counter[
+                "compact" if batch.packed is not None
+                else "full"].inc(batch.staged_bytes)
+            self.sched.observe_dispatch_bytes(batch.staged_bytes,
+                                              (t2 - t1) * 1e3)
         # Scheduler accounting at launch: occupancy + queue depth, the
         # sidecar's `sched` stage (oldest enqueue -> launch hold on the
         # ring clock), and the fail-open mask for rows whose deadline
@@ -1623,8 +1738,14 @@ class RingSidecar:
         j = len(self._mega_staged)
         self._mega_queue.fill_slice(self._mega_buf_id, j, batch.arrays,
                                     n, self.ruleset_epoch)
+        # Compact staging (ISSUE 15): the capped views ride the
+        # existing fill_slice width logic; carry the encoder's depth-
+        # overflow flags so `_complete` re-serves those rows from the
+        # full slot view, same as the per-batch path.
         raw = RequestBatch(size=n, arrays=self._mega_queue.slice_view(
-            self._mega_buf_id, j, n))
+            self._mega_buf_id, j, n),
+            overflow=(batch.overflow[:n]
+                      if batch.overflow is not None else None))
         t1 = time.monotonic()
         self._stage["encode"].observe((t1 - t0) * 1e3)
         self._pipe.note_stage(pipe_slot, "encode", t0, t1)
@@ -1960,6 +2081,34 @@ class RingSidecar:
                     self.spilled_rows += 1
                 ring.spill_release(idx)
             off += len(part)
+        # Depth-capped rows (ISSUE 15, PINGOO_STAGING=compact with a
+        # PINGOO_STAGING_DEPTH clamp below a field's required depth):
+        # the device matched a plan-capped prefix narrower than the
+        # slot bytes, so re-serve every lane for those rows from the
+        # FULL slot view through the host interpreter — the same
+        # exactness contract as the spill loop above. Spilled rows
+        # already re-evaluated over their untruncated strings; with no
+        # clamp the encoder's thresholds equal the slot caps and this
+        # mask is empty by construction.
+        over = getattr(raw_batch, "overflow", None)
+        if over is not None and over[:n].any():
+            off = 0
+            for ring, part in parts:
+                gi = self._ring_group_of.get(id(ring))
+                svcs = self._groups[gi] if gi is not None else None
+                rows = np.nonzero(over[off:off + len(part)]
+                                  & (part["spill_idx"] == SPILL_NONE))[0]
+                for j in rows:
+                    s = part[j]
+                    unv, vblk, rt = self._interpret_overflow_row(
+                        s, bytes(s["url"][:int(s["url_len"])]),
+                        bytes(s["path"][:int(s["path_len"])]), svcs)
+                    unverified[off + j] = unv
+                    verified_block[off + j] = vblk
+                    if route is not None and gi is not None:
+                        route[off + j] = rt
+                    self.depth_overflow_rows += 1
+                off += len(part)
         # Verdict byte carries BOTH client-state lanes (the reference
         # action loop diverges for captcha-verified clients,
         # http_listener.rs:251-264): bits 0-1 = unverified action
@@ -2102,6 +2251,13 @@ class RingSidecar:
             # view than the slot arrays — excluded from the audit.
             skip = ((slots["flags"] & SLOT_FLAG_TRUNCATED) != 0) \
                 | (slots["spill_idx"] != SPILL_NONE)
+            over = getattr(raw_batch, "overflow", None)
+            if over is not None:
+                # Depth-capped rows (ISSUE 15) were re-served from the
+                # full slot view, not the capped staging arrays the
+                # audit would rebuild contexts from — excluded like
+                # spilled rows.
+                skip = skip | np.asarray(over[:n], dtype=bool)
             raw_for_audit = raw_batch
             if self._zero_copy and self.parity.sample > 0.0:
                 # The auditor's contexts_builder runs LATER on its
@@ -2145,6 +2301,15 @@ class RingSidecar:
             self.plan, service_groups=self._groups or None,
             with_rule_hits=self._provenance_on,
             donate=donate_batch_buffers())
+        if self._packed_lane_fn is not None:
+            # The packed twin embeds the same DFA dispatch decision;
+            # keep it in lockstep with the per-batch program.
+            from .engine.verdict import make_packed_lane_fn
+
+            self._packed_lane_fn = make_packed_lane_fn(
+                self.plan, service_groups=self._groups or None,
+                with_rule_hits=self._provenance_on,
+                donate=donate_batch_buffers())
         if self._mega_fn is not None:
             # The megastep embeds the same lane body — keep its DFA
             # dispatch in lockstep with the per-batch program.

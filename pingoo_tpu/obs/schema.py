@@ -273,6 +273,24 @@ BODY_METRICS = {
         "stream not inspected this PR)",
 }
 
+# Compact-staging metrics (ISSUE 15, docs/EXECUTOR.md "Compact
+# staging"). Exported by every plane that runs the batched verdict
+# engine (plane="python" listener service, plane="sidecar" ring
+# drainer). `staged_bytes_total` carries a `mode` label over the
+# PINGOO_STAGING arms (full = per-field staging, compact = packed
+# one-copy buffer) so the bytes-per-request reduction is one division
+# on one scrape; `staging_field_cap` is host-static per adopted plan —
+# the plan-derived per-field staging width (equal to the field spec
+# under PINGOO_STAGING=full or when the ruleset pins the field).
+STAGING_METRICS = {
+    "pingoo_staged_bytes_total":
+        "request bytes staged to the device for verdict batches, by "
+        "mode (full = per-field arrays, compact = packed buffer)",
+    "pingoo_staging_field_cap":
+        "per-field staging width in bytes under the adopted plan "
+        "(plan-derived cap, quantized to the pow2 rung ladder)",
+}
+
 # Native-plane-only counters (httpd.cc Stats), exported with
 # plane="native" under these names.
 NATIVE_METRICS = {
@@ -309,4 +327,5 @@ def all_metric_names() -> set[str]:
             | set(PARITY_METRICS) | set(SCHED_METRICS)
             | set(PIPELINE_METRICS) | set(RESILIENCE_METRICS)
             | set(HOTSWAP_METRICS) | set(BODY_METRICS)
+            | set(STAGING_METRICS)
             | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

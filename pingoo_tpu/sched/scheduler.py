@@ -183,6 +183,18 @@ def _pow2_bucket(n: int, cap: int) -> int:
     return min(b, cap)
 
 
+def _pow2_kb_bucket(nbytes: int) -> int:
+    """Staged-bytes bucket for the dispatch cost model (ISSUE 15):
+    pow2 KB, floor 1 KB — coarse enough that one serving config lands
+    in one bucket, fine enough that full (~4.6 KB/req) and compact
+    (few hundred B/req) staging never share one."""
+    kb = max(1, (max(0, int(nbytes)) + 1023) // 1024)
+    b = 1
+    while b < kb:
+        b *= 2
+    return b
+
+
 class CostModel:
     """EWMA per-batch-size dispatch-cost estimates (milliseconds).
 
@@ -238,6 +250,18 @@ class CostModel:
         # ONE K-slice device-resident dispatch. Unobserved pairs fall
         # back to the amortization model dispatch + K * compute.
         self._mega_ewma: dict[tuple[int, int], float] = {}
+        # First observation per (K, bucket), tracked SEPARATELY (ISSUE
+        # 15 satellite): the first window of a new (K, rows) shape pays
+        # the cold XLA compile (BENCH_pipeline showed 4x2048 seeded at
+        # ~9.5 s), and letting it seed the EWMA meant `auto` could
+        # never size K up past the poisoned rung again.
+        self._mega_first: dict[tuple[int, int], float] = {}
+        # Dispatch-stage EWMAs keyed by staged-BYTES bucket (ISSUE 15):
+        # the dispatch wall is bytes-proportional host staging, so with
+        # compact staging in play the pow2 row bucket alone conflates
+        # full and compact batches of the same size. Bytes-keyed
+        # observations take precedence in estimate_dispatch.
+        self._dispatch_bytes_ewma: dict[int, float] = {}
 
     def _seed_for(self, bucket: int) -> float:
         cap = _pow2_bucket(self.max_batch, self.max_batch)
@@ -328,11 +352,45 @@ class CostModel:
             return
         k = max(1, int(k))
         bucket = _pow2_bucket(max(1, batch_size), self.max_batch)
-        prev = self._mega_ewma.get((k, bucket))
+        key = (k, bucket)
+        if key not in self._mega_first:
+            # The first window of a (K, bucket) shape pays the cold XLA
+            # compile; absorb it here so estimate_megastep keeps using
+            # the amortization model until a STEADY window lands.
+            self._mega_first[key] = ms
+            return
+        prev = self._mega_ewma.get(key)
         if prev is None:
-            self._mega_ewma[(k, bucket)] = ms
+            self._mega_ewma[key] = ms
         else:
-            self._mega_ewma[(k, bucket)] = prev + self.alpha * (ms - prev)
+            self._mega_ewma[key] = prev + self.alpha * (ms - prev)
+
+    def estimate_dispatch(self, batch_size: int,
+                          staged_bytes: Optional[int] = None) -> float:
+        """Expected dispatch-stage wall (ms), preferring the staged-
+        BYTES-bucket EWMA when that bucket has been observed (ISSUE 15:
+        compact staging ships a fraction of full mode's bytes at the
+        same row count, so row-bucket estimates conflate the two)."""
+        if staged_bytes:
+            est = self._dispatch_bytes_ewma.get(
+                _pow2_kb_bucket(staged_bytes))
+            if est is not None:
+                return est
+        return self.estimate_stage("dispatch", batch_size)
+
+    def observe_dispatch_bytes(self, staged_bytes: int,
+                               ms: float) -> None:
+        """EWMA update for the dispatch stage keyed by the batch's
+        staged-bytes pow2-KB bucket (hot)."""
+        if ms < 0 or not staged_bytes or staged_bytes <= 0:
+            return
+        bucket = _pow2_kb_bucket(staged_bytes)
+        prev = self._dispatch_bytes_ewma.get(bucket)
+        if prev is None:
+            self._dispatch_bytes_ewma[bucket] = ms
+        else:
+            self._dispatch_bytes_ewma[bucket] = \
+                prev + self.alpha * (ms - prev)
 
     def snapshot(self) -> dict:
         return {"seed_ms": round(self.seed_ms, 4),
@@ -345,7 +403,14 @@ class CostModel:
                         self._stage_ewma.items())},
                 "megastep_ewma_ms": {
                     f"{k}x{b}": round(v, 4)
-                    for (k, b), v in sorted(self._mega_ewma.items())}}
+                    for (k, b), v in sorted(self._mega_ewma.items())},
+                "megastep_first_ms": {
+                    f"{k}x{b}": round(v, 4)
+                    for (k, b), v in sorted(self._mega_first.items())},
+                "dispatch_bytes_ewma_ms": {
+                    f"{kb}kb": round(v, 4)
+                    for kb, v in sorted(
+                        self._dispatch_bytes_ewma.items())}}
 
 
 class SchedMetrics:
@@ -473,6 +538,12 @@ class Scheduler:
         """One completed K-slice megastep window's measured wall
         (hot; ISSUE 12)."""
         self.cost.observe_megastep(k, batch_size, ms)
+
+    def observe_dispatch_bytes(self, staged_bytes: int,
+                               ms: float) -> None:
+        """Dispatch-stage wall keyed by the batch's staged-bytes bucket
+        (hot; ISSUE 15 compact staging)."""
+        self.cost.observe_dispatch_bytes(staged_bytes, ms)
 
     def size_megastep_k(self, k_ladder, batch_size: int,
                         oldest_admit_s: float, now_s: float) -> int:

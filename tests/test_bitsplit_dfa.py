@@ -465,7 +465,8 @@ class TestCacheRoundTrip:
     def test_format_version_bumped(self):
         from pingoo_tpu.compiler.cache import FORMAT_VERSION
 
-        assert FORMAT_VERSION == 10
+        # 11: plans carry staging_required/staging_caps (compact staging).
+        assert FORMAT_VERSION == 11
 
     def test_dfa_tables_survive_cache(self, tmp_path, monkeypatch):
         from pingoo_tpu.compiler.cache import compile_ruleset_cached

@@ -614,9 +614,9 @@ class TestLintHotRegistry:
             "    def encode_slots(self, slots: np.ndarray,\n"
             "                     _leak=None,",
             1).replace(
-            "        buf = self._checkout()\n        arrays: dict = {}\n"
+            "        arrays: dict = {}\n"
             "        for field, len_key in SLOT_LEN_KEYS.items():",
-            "        buf = self._checkout()\n        arrays: dict = {}\n"
+            "        arrays: dict = {}\n"
             "        scratch = np.zeros((len(slots), 4))\n"
             "        for field, len_key in SLOT_LEN_KEYS.items():",
             1)

@@ -86,6 +86,13 @@ HOT_FUNCTIONS = frozenset({
     # cost EWMAs are pure float math on the admission path.
     "pingoo_tpu/engine/batch.py::DeviceInputQueue.fill_slice",
     "pingoo_tpu/engine/batch.py::DeviceInputQueue.device_stack",
+    # Compact staging (ISSUE 15): the packed encoders fill the single
+    # reused [B, width] staging buffer per batch (one strided copy per
+    # field into REUSED memory, never a fresh matrix), and the meta
+    # tail pack is pure byte stores into the same buffer.
+    "pingoo_tpu/engine/batch.py::StagingEncoder._encode_requests_packed",
+    "pingoo_tpu/engine/batch.py::StagingEncoder._encode_slots_packed",
+    "pingoo_tpu/engine/batch.py::StagingEncoder._pack_meta",
     "pingoo_tpu/engine/verdict.py::finish_megastep",
     "pingoo_tpu/engine/service.py::VerdictService._evaluate_megastep",
     "pingoo_tpu/sched/scheduler.py::CostModel.observe_megastep",

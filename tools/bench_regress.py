@@ -29,6 +29,8 @@ error. Tracked metrics and their directions:
     megastep_req_per_s   higher is better (ISSUE 12 megastep arm)
     swap_pause_p99_ms    lower  is better (ISSUE 11 hot-swap pause)
     body_stream_mb_per_s higher is better (ISSUE 13 streaming body scan)
+    staging_compact_req_per_s higher is better (ISSUE 15 compact staging)
+    staged_bytes_per_req lower  is better
 
 Metrics missing from either run are skipped (partial/error lines are
 trajectory too, but only shared keys gate).
@@ -71,6 +73,10 @@ TRACKED = (
     # multi-flow windowed scan throughput, verdict-identical to the
     # contiguous scan by construction.
     ("body_stream_mb_per_s", True),
+    # Compact staging A/B (ISSUE 15, bench.py --staging): compact-arm
+    # throughput and the staged bytes/request it exists to shrink.
+    ("staging_compact_req_per_s", True),
+    ("staged_bytes_per_req", False),
 )
 
 DEFAULT_THRESHOLD = 0.10

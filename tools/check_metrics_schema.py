@@ -153,6 +153,16 @@ def main() -> int:
                 problems.append(
                     f"{label}: scheduler wiring missing {symbol}")
 
+    # Compact staging (ISSUE 15): both engine planes must export the
+    # staged-bytes counter and the per-field cap gauge — the counter is
+    # what makes the full-vs-compact byte savings visible per plane,
+    # and the gauge publishes the adopted plan's staging widths.
+    for name in schema.STAGING_METRICS:
+        if name not in service_src:
+            problems.append(f"engine/service.py: missing metric {name}")
+        if name not in sidecar_src:
+            problems.append(f"native_ring.py: missing metric {name}")
+
     # Pipelined-executor telemetry (ISSUE 9): the metric-name literals
     # live in obs/pipeline.py (shared by both engine planes), and both
     # planes must construct a PipelineStats — that is what makes the
@@ -233,7 +243,8 @@ def main() -> int:
                             **schema.SCHED_METRICS,
                             **schema.PIPELINE_METRICS,
                             **schema.RESILIENCE_METRICS,
-                            **schema.BODY_METRICS}.items():
+                            **schema.BODY_METRICS,
+                            **schema.STAGING_METRICS}.items():
         if name == "pingoo_body_carry_depth":
             hb = reg.histogram(name, help_text,
                                buckets=(1, 2, 4, 8, 16, 64, 256),
@@ -275,6 +286,10 @@ def main() -> int:
         "plane": "audit", "fault": "verdict_full"}).inc()
     reg.counter("pingoo_body_degrade_total", "", labels={
         "plane": "audit", "reason": "ring_full"}).inc()
+    reg.counter("pingoo_staged_bytes_total", "", labels={
+        "plane": "audit", "mode": "compact"}).inc()
+    reg.gauge("pingoo_staging_field_cap", "", labels={
+        "field": "url"}).set(256)
     h = reg.histogram(schema.SHARED_WAIT_HISTOGRAM, "wait",
                       buckets=WAIT_BUCKETS_MS, labels={"plane": "audit"})
     for v in (0.5, 3, 70, 2000):
