@@ -4,7 +4,7 @@ PY ?= python
 
 .PHONY: all native test check bench bench-regress audit asan \
 	metrics-smoke mesh-smoke chaos-smoke megastep-smoke body-smoke \
-	staging-smoke \
+	staging-smoke timeline-smoke \
 	clean analyze analyze-abi analyze-lint analyze-tidy analyze-tsan \
 	fuzz
 
@@ -25,6 +25,7 @@ check:
 	$(MAKE) megastep-smoke
 	$(MAKE) body-smoke
 	$(MAKE) staging-smoke
+	$(MAKE) timeline-smoke
 
 # Static analysis suite (docs/STATIC_ANALYSIS.md) — offline-safe; each
 # pass skips with a warning when its toolchain is missing, and each is
@@ -121,6 +122,16 @@ megastep-smoke:
 # half skips without the native toolchain.
 staging-smoke:
 	$(PY) tools/staging_smoke.py
+
+# Perf-ledger + timeline smoke (ISSUE 17, docs/OBSERVABILITY.md): prove
+# the compile ledger records the warm-up compiles (JSONL agreeing with
+# the counters), sampled batch spans nest and export as Chrome-trace
+# JSON with the cross-plane ring-wait join, the durable cost ledger
+# round-trips EWMAs and discards stale fingerprints, and the record
+# path costs <2% of a batch. Offline-safe: skips when jax is
+# unavailable; the sidecar half skips without the native toolchain.
+timeline-smoke:
+	$(PY) tools/timeline_smoke.py
 
 # Streaming body-inspection smoke (ISSUE 13, docs/BODY_STREAMING.md):
 # prove stream==contiguous==oracle scanner parity with seams inside

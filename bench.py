@@ -1378,14 +1378,46 @@ def _history_path() -> str:
     return os.environ.get("BENCH_HISTORY_FILE", "BENCH_history.jsonl")
 
 
+_GIT_COMMIT: list = []  # one-shot cache: [] = unprobed, [str|None] = probed
+
+
+def _git_commit():
+    """Best-effort short commit hash for history provenance; None when
+    git/tree is unavailable (history append must never fail the run)."""
+    if not _GIT_COMMIT:
+        commit = None
+        try:
+            out = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                timeout=5, cwd=os.path.dirname(os.path.abspath(__file__)))
+            if out.returncode == 0:
+                commit = out.stdout.decode().strip() or None
+        except Exception:
+            commit = None
+        _GIT_COMMIT.append(commit)
+    return _GIT_COMMIT[0]
+
+
 def _append_history(line: str) -> None:
     """Bench trajectory (ISSUE 5 satellite): append THE emitted result
     line (success or error — a failed run is trajectory too) to
     BENCH_history.jsonl with a wall-clock stamp, so
     tools/bench_regress.py can diff consecutive runs. Best-effort: a
-    read-only tree must not turn a finished bench into rc=1."""
+    read-only tree must not turn a finished bench into rc=1.
+
+    ISSUE 17 satellite: every line also carries a history schema
+    version, the backend, and the git commit, so bench_regress.py can
+    refuse cross-backend comparisons explicitly instead of silently
+    diffing a CPU run against a TPU baseline."""
     try:
         entry = {"ts": round(time.time(), 3), **json.loads(line)}
+        entry.setdefault("history_schema", 2)
+        entry.setdefault("backend", os.environ.get("PINGOO_BENCH_BACKEND",
+                                                   "unknown"))
+        commit = _git_commit()
+        if commit:
+            entry.setdefault("git_commit", commit)
         with open(_history_path(), "a") as f:
             f.write(json.dumps(entry) + "\n")
     except Exception:

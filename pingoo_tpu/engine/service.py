@@ -30,10 +30,15 @@ from ..config.schema import Action
 from ..expr import execute_as_bool
 from ..obs.flightrecorder import (FlightRecorder, register_recorder,
                                   tuple_digest)
+from ..obs.perf import (get_compile_ledger, instrument_jit,
+                        instrument_megastep, plan_fingerprint,
+                        staging_widths)
 from ..obs.pipeline import PipelineStats
 from ..obs.provenance import (ParityAuditor, PrefilterAttribution,
                               RuleAttribution, provenance_enabled)
+from ..obs.timeline import get_timeline
 from ..sched import MeshExecutor, MeshUnavailable, Scheduler, SchedulerConfig
+from ..sched.scheduler import load_cost_ledger, save_cost_ledger
 from .batch import (
     DeviceInputQueue,
     RequestBatch,
@@ -347,6 +352,30 @@ class VerdictService:
         # PINGOO_MESH asks for more than one device.
         self.sched = Scheduler(SchedulerConfig.from_env(max_batch),
                                plane="python")
+        # Perf ledger + cross-plane timeline + durable cost ledger
+        # (ISSUE 17, docs/OBSERVABILITY.md): the compile ledger wraps
+        # every jitted program this plane builds (zero hot-path delta
+        # while PINGOO_PERF_LEDGER is off), the timeline samples
+        # batches at PINGOO_TIMELINE_SAMPLE, and the scheduler's
+        # CostModel reloads the prior run's measured EWMAs — keyed to
+        # this backend + ruleset fingerprint — instead of re-seeding
+        # from BENCH_history.
+        self._plan_fp = plan_fingerprint(plan)
+        self._perf = get_compile_ledger()
+        self._perf.ensure_instruments("python")
+        self._timeline = get_timeline()
+        self._timeline.ensure_instruments("python")
+        self._backend_label = "host"
+        if use_device:
+            try:
+                import jax
+
+                self._backend_label = str(jax.default_backend())
+            except Exception:
+                pass
+        self.cost_ledger_result = load_cost_ledger(
+            self.sched.cost, backend=self._backend_label,
+            fingerprint=self._plan_fp, plane="python")
         # Degradation ladder (ISSUE 10, docs/RESILIENCE.md): this
         # plane's scattered fallbacks (staging->legacy encode,
         # DFA->NFA, mesh->single-device, device->interpreter) report
@@ -440,6 +469,11 @@ class VerdictService:
         self._mega_queue: Optional[DeviceInputQueue] = None
         self._mega_rungs = megastep_k_ladder(megastep_k_cap())
         self.mega_echo_mismatch = 0
+        # Monotonic megastep window id (ISSUE 17 satellite): stamped
+        # into every flight row a window serves, so stranded-slice
+        # reconciliation after a mid-window SIGKILL is traceable per
+        # window instead of per anonymous batch.
+        self._mega_window_seq = 0
         if use_device and ensure_jax_backend():
             state = self._build_engine_state(plan, device)
             if state is None:
@@ -469,13 +503,27 @@ class VerdictService:
             from .verdict import donate_batch_buffers
 
             state: dict = {"plan": plan}
-            state["verdict_fn"] = make_verdict_fn(
-                plan, donate=donate_batch_buffers())
+            # Compile-ledger wrapping (ISSUE 17): every jitted program
+            # this state holds goes through instrument_jit so each XLA
+            # trace/compile becomes a counted, persisted event. The
+            # wrapper composes AFTER jax.jit — donation/static_argnums
+            # semantics untouched — and is a no-op passthrough while
+            # PINGOO_PERF_LEDGER is off.
+            fp = plan_fingerprint(plan)
+            widths = staging_widths(plan)
+
+            def _wrap(fn, name):
+                return instrument_jit(fn, name, plane="python",
+                                      fingerprint=fp, widths=widths)
+
+            state["verdict_fn"] = _wrap(make_verdict_fn(
+                plan, donate=donate_batch_buffers()), "verdict")
             # Stage-A prefilter as its own dispatch so the pipeline
             # stage is separately timeable (None when the plan has
             # no factors or PINGOO_PREFILTER=off).
             pf = make_prefilter_fn(plan)
-            state["pf_fn"] = pf.fn if pf is not None else None
+            state["pf_fn"] = \
+                _wrap(pf.fn, "prefilter") if pf is not None else None
             state["pf_gated_banks"] = \
                 len(pf.gated) if pf is not None else 0
             state["pf_attr"] = (
@@ -489,11 +537,13 @@ class VerdictService:
             state["packed_verdict_fn"] = None
             state["packed_pf_fn"] = None
             if state["stage_caps"] is not None:
-                state["packed_verdict_fn"] = make_packed_verdict_fn(
-                    plan, donate=donate_batch_buffers())
+                state["packed_verdict_fn"] = _wrap(
+                    make_packed_verdict_fn(
+                        plan, donate=donate_batch_buffers()), "verdict")
                 ppf = make_packed_prefilter_fn(plan)
                 state["packed_pf_fn"] = \
-                    ppf.fn if ppf is not None else None
+                    _wrap(ppf.fn, "prefilter") if ppf is not None \
+                    else None
             # Mesh BEFORE table materialization: tp padding must
             # land in plan.np_tables before device_tables() runs.
             mesh = self._build_mesh(plan)
@@ -513,7 +563,9 @@ class VerdictService:
             state["mega_fn"] = None
             state["mega_queue"] = None
             if _resolve_megastep_mode() != "off":
-                state["mega_fn"] = make_megastep_fn(plan, kind="matrix")
+                state["mega_fn"] = instrument_megastep(
+                    make_megastep_fn(plan, kind="matrix"),
+                    plane="python", fingerprint=fp, widths=widths)
                 state["mega_queue"] = DeviceInputQueue(
                     megastep_k_cap(), self.max_batch,
                     field_specs=plan.field_specs, nbuf=2)
@@ -599,18 +651,26 @@ class VerdictService:
         from .verdict import donate_batch_buffers
 
         self.plan.dfa_default_mode = "off" if dfa_off else self._dfa_mode0
-        self._verdict_fn = make_verdict_fn(
-            self.plan, donate=donate_batch_buffers())
+        fp = plan_fingerprint(self.plan)
+        widths = staging_widths(self.plan)
+        self._verdict_fn = instrument_jit(
+            make_verdict_fn(self.plan, donate=donate_batch_buffers()),
+            "verdict", plane="python", fingerprint=fp, widths=widths)
         if self._packed_verdict_fn is not None:
             # The packed twin embeds the same DFA dispatch decision;
             # keep it in lockstep with the per-batch program.
-            self._packed_verdict_fn = make_packed_verdict_fn(
-                self.plan, donate=donate_batch_buffers())
+            self._packed_verdict_fn = instrument_jit(
+                make_packed_verdict_fn(
+                    self.plan, donate=donate_batch_buffers()),
+                "verdict", plane="python", fingerprint=fp,
+                widths=widths)
         if self._mega_fn is not None:
             # The megastep embeds the same DFA dispatch decision; keep
             # it in lockstep with the per-batch program it must stay
             # bit-identical to.
-            self._mega_fn = make_megastep_fn(self.plan, kind="matrix")
+            self._mega_fn = instrument_megastep(
+                make_megastep_fn(self.plan, kind="matrix"),
+                plane="python", fingerprint=fp, widths=widths)
 
     def _dfa_rung_tick(self) -> None:
         """Demoted-dfa probe: when the backoff window opens, restore
@@ -699,6 +759,21 @@ class VerdictService:
             self.parity.stop()
         if self._attribution is not None:
             self._attribution.close()
+        # Durable cost ledger (ISSUE 17): persist the measured EWMAs on
+        # drain so the next boot estimates from THIS run's costs.
+        self.persist_cost_ledger()
+
+    def persist_cost_ledger(self) -> bool:
+        """Snapshot the scheduler's CostModel into the durable cost
+        ledger (PINGOO_COST_LEDGER). Idempotent + best-effort: also
+        safe from the SIGTERM drain path after a blown graceful-stop
+        deadline."""
+        try:
+            return save_cost_ledger(
+                self.sched.cost, backend=self._backend_label,
+                fingerprint=self._plan_fp, plane="python")
+        except Exception:
+            return False
 
     def ensure_trace_stopped(self) -> None:
         """Flush any live jax.profiler trace (the boot-time
@@ -1104,6 +1179,21 @@ class VerdictService:
                                          t_resolve, t_launch, stages)
             self.stats.observe_stage(
                 "provenance", (time.monotonic() - t_prov) * 1e3)
+            # Cross-plane timeline (ISSUE 17): per-batch cost while
+            # unsampled is the one add+compare inside sample().
+            if self._timeline.sample():
+                tl_args = {"pipeline_slot": pipe_slot}
+                if "megastep_k" in stages:
+                    tl_args["megastep_k"] = stages["megastep_k"]
+                self._timeline.batch_python(
+                    stages_ms=stages, t_launch=t_launch,
+                    t_resolve=t_resolve, t_end=t_res_end,
+                    rows=[(reqs[i].trace_id or "", pending[i][2],
+                           pending[i][3])
+                          for i in range(
+                              min(len(pending),
+                                  self._timeline.rows_per_batch))],
+                    args=tl_args)
         finally:
             self._pipe.exit()
 
@@ -1284,7 +1374,9 @@ class VerdictService:
 
                     from ..models import botscore
 
-                    self._score_fn = jax.jit(botscore.score)
+                    self._score_fn = instrument_jit(
+                        jax.jit(botscore.score), "score",
+                        plane="python", fingerprint=self._plan_fp)
                 # Pad to the same pow2 shape the verdict uses so the
                 # jitted scorer compiles once per bucket, not per
                 # occupancy.
@@ -1423,6 +1515,10 @@ class VerdictService:
                         and self._packed_verdict_fn is not None
                         and not (self.mesh is not None
                                  and self.mesh.active))
+                    if stages is not None:
+                        # Flight-row staging mode (ISSUE 17 satellite).
+                        stages["staging_mode"] = \
+                            "compact" if use_packed else "full"
                     if use_packed:
                         import jax
                         dev_packed = jax.device_put(batch.packed)
@@ -1555,6 +1651,14 @@ class VerdictService:
             return None
         if not self.ladder.try_rung("megastep"):
             return None
+        self._mega_window_seq += 1
+        if stages is not None:
+            # Flight-row window traceability (ISSUE 17 satellite): the
+            # window id + staging mode ride the batch stage dict into
+            # every flight record this window serves (megastep slices
+            # always stage per-field arrays, never the packed buffer).
+            stages["megastep_window"] = self._mega_window_seq
+            stages["staging_mode"] = "full"
         from contextlib import nullcontext
         try:
             buf = self._mega_queue.checkout()

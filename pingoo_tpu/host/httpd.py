@@ -822,6 +822,12 @@ class HttpListener:
         if req.path == "/__pingoo/flightrecorder":
             return self._flightrecorder_response()
 
+        if req.path == "/__pingoo/compileledger":
+            return self._compileledger_response()
+
+        if req.path == "/__pingoo/timeline":
+            return self._timeline_response()
+
         if req.path == "/__pingoo/explain":
             return await self._explain_response(req, request_ctx)
 
@@ -949,6 +955,24 @@ class HttpListener:
 
         return Response(200, [("content-type", "application/json")],
                         json.dumps(dump_all()).encode())
+
+    def _compileledger_response(self) -> Response:
+        """Dump the process-wide compile ledger (every jit trace/compile
+        this process paid, with fn kind / shape context / wall ms) —
+        the /__pingoo/compileledger endpoint (ISSUE 17)."""
+        from ..obs.perf import get_compile_ledger
+
+        return Response(200, [("content-type", "application/json")],
+                        json.dumps(get_compile_ledger().snapshot()).encode())
+
+    def _timeline_response(self) -> Response:
+        """Chrome-trace (catapult) JSON of the bounded cross-plane span
+        store — loads directly in Perfetto; empty traceEvents (bar the
+        metadata rows) when PINGOO_TIMELINE_SAMPLE is off."""
+        from ..obs.timeline import get_timeline
+
+        return Response(200, [("content-type", "application/json")],
+                        get_timeline().chrome_trace_json().encode())
 
     async def _explain_response(self, req: Request,
                                 request_ctx: RequestContext) -> Response:

@@ -264,6 +264,10 @@ async def run(config: Config, **kwargs) -> None:
         # lost on exit.
         if server.verdict is not None:
             server.verdict.ensure_trace_stopped()
+            # Cost-ledger snapshot on drain (ISSUE 17): the measured
+            # EWMAs are the next boot's admission costs — losing them
+            # means re-seeding from BENCH_history, which is lossier.
+            server.verdict.persist_cost_ledger()
         # ... and auto-dump the flight recorders (ISSUE 5): the last N
         # requests' provenance is exactly what a post-mortem of the
         # shutdown-adjacent traffic needs, and it lives only in memory.

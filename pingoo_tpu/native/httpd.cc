@@ -2364,6 +2364,54 @@ class Server {
            body;
   }
 
+  // -- cross-plane timeline (ISSUE 17) ---------------------------------------
+  // Chrome-trace JSON synthesized from the SAME FlightEntry stamps the
+  // flight recorder keeps: one "verdict_wait" span per recorded request,
+  // [ts_ms - wait_ms, ts_ms] on the CLOCK_MONOTONIC timebase the ring
+  // and both Python planes share, so tools/timeline_capture.py can
+  // merge this dump with /__pingoo/timeline from the Python plane by
+  // plain concatenation (same clock; the `clock` block pins it to wall
+  // time for offline viewing). No extra hot-path stamps: this endpoint
+  // only re-reads what flight_record() already wrote.
+
+  std::string timeline_json() {
+    uint64_t total = flight_next_;
+    size_t live = total < kFlightN ? static_cast<size_t>(total) : kFlightN;
+    uint64_t start = total - live;
+    std::string out =
+        "{\"displayTimeUnit\": \"ms\", \"clock\": {\"unit\": "
+        "\"monotonic_us\", \"monotonic_now_us\": " +
+        std::to_string(now_ms() * 1000) +
+        ", \"wall_now_s\": " + std::to_string(::time(nullptr)) +
+        "}, \"traceEvents\": [{\"ph\": \"M\", \"name\": \"process_name\", "
+        "\"pid\": 3, \"tid\": 0, \"args\": {\"name\": \"pingoo:native\"}}";
+    for (size_t i = 0; i < live; ++i) {
+      const FlightEntry& e = flight_[(start + i) % kFlightN];
+      if (!e.ts_ms) continue;
+      uint64_t t0_us = (e.ts_ms - e.wait_ms) * 1000;
+      out += ", {\"ph\": \"X\", \"pid\": 3, \"tid\": 1, \"name\": "
+             "\"verdict_wait\", \"cat\": \"native\", \"ts\": " +
+             std::to_string(t0_us) +
+             ", \"dur\": " + std::to_string(e.wait_ms * 1000) +
+             ", \"args\": {\"trace_id\": ";
+      out += e.ticket == UINT64_MAX
+                 ? std::string("null")
+                 : "\"t-" + std::to_string(e.ticket) + "\"";
+      out += ", \"decided\": " + std::to_string(e.decided) +
+             ", \"path\": \"" + e.path + "\"}}";
+    }
+    out += "]}";
+    return out;
+  }
+
+  std::string timeline_response() {
+    std::string body = timeline_json();
+    return "HTTP/1.1 200 OK\r\nserver: pingoo\r\ncontent-type: "
+           "application/json\r\ncontent-length: " +
+           std::to_string(body.size()) + "\r\nconnection: close\r\n\r\n" +
+           body;
+  }
+
   // -- graceful drain --------------------------------------------------------
   // SIGTERM stops accepting and drains in-flight requests with a hard
   // cap (reference drains with a 20 s limit, listeners/mod.rs:28 +
@@ -3705,6 +3753,10 @@ class Server {
       respond_close(c, flightrecorder_response().c_str());
       return;
     }
+    if (c->req.path == "/__pingoo/timeline") {
+      respond_close(c, timeline_response().c_str());
+      return;
+    }
     Policy outcome = run_policy(c);
     switch (outcome) {
       case Policy::kBlock:
@@ -3963,6 +4015,11 @@ class Server {
       if (it->second.p.path == "/__pingoo/flightrecorder") {
         h2_submit(c, sid, 200, {{"content-type", "application/json"}},
                   flightrecorder_json());
+        continue;
+      }
+      if (it->second.p.path == "/__pingoo/timeline") {
+        h2_submit(c, sid, 200, {{"content-type", "application/json"}},
+                  timeline_json());
         continue;
       }
       // h2 client streams are not body-inspected this iteration

@@ -564,7 +564,8 @@ class _MegaWindow:
     """One in-flight K-slice megastep window (ISSUE 12): the deque
     entry `_complete_inflight` routes to `_complete_megastep`."""
 
-    __slots__ = ("slices", "k", "k_ship", "dev_out", "t_launch")
+    __slots__ = ("slices", "k", "k_ship", "dev_out", "t_launch",
+                 "window_id")
 
 
 class RingSidecar:
@@ -620,6 +621,32 @@ class RingSidecar:
 
         self.sched = Scheduler(SchedulerConfig.from_env(max_batch),
                                plane="sidecar")
+        # Perf ledger + cross-plane timeline + durable cost ledger
+        # (ISSUE 17, docs/OBSERVABILITY.md): compile events from every
+        # jitted program below become counted/persisted ledger entries
+        # (no-op passthrough while PINGOO_PERF_LEDGER is off), sampled
+        # batches emit cross-plane spans joined on the ring clock, and
+        # the CostModel reloads the prior run's measured EWMAs keyed to
+        # this backend + ruleset fingerprint.
+        from .obs.perf import get_compile_ledger, plan_fingerprint
+        from .obs.timeline import get_timeline
+        from .sched.scheduler import load_cost_ledger
+
+        self._plan_fp = plan_fingerprint(plan)
+        self._perf = get_compile_ledger()
+        self._perf.ensure_instruments("sidecar")
+        self._timeline = get_timeline()
+        self._timeline.ensure_instruments("sidecar")
+        self._backend_label = "host"
+        try:
+            import jax
+
+            self._backend_label = str(jax.default_backend())
+        except Exception:
+            pass
+        self.cost_ledger_result = load_cost_ledger(
+            self.sched.cost, backend=self._backend_label,
+            fingerprint=self._plan_fp, plane="sidecar")
         # The sidecar uses the transfer-thin lane reduction — the
         # first-match action decision computes ON DEVICE and only four
         # int32 lanes come back, not the [B, R] match matrix (which
@@ -958,13 +985,25 @@ class RingSidecar:
                                      make_packed_lane_fn,
                                      make_packed_prefilter_fn,
                                      make_prefilter_fn)
+        from .obs.perf import (instrument_jit, plan_fingerprint,
+                               staging_widths)
         from .sched import MeshExecutor, MeshUnavailable
 
         state: dict = {"plan": plan}
-        state["lane_fn"] = make_lane_fn(
+        # Compile-ledger wrapping (ISSUE 17): composes AFTER jax.jit
+        # (donation/static_argnums untouched); passthrough while
+        # PINGOO_PERF_LEDGER is off.
+        fp = plan_fingerprint(plan)
+        widths = staging_widths(plan)
+
+        def _wrap(fn, name):
+            return instrument_jit(fn, name, plane="sidecar",
+                                  fingerprint=fp, widths=widths)
+
+        state["lane_fn"] = _wrap(make_lane_fn(
             plan, service_groups=self._groups or None,
             with_rule_hits=self._provenance_on,
-            donate=donate_batch_buffers())
+            donate=donate_batch_buffers()), "lanes")
         # Compact staging (ISSUE 15): the packed twins decode the
         # one-copy buffer on device; built only under
         # PINGOO_STAGING=compact (the default full arm traces nothing
@@ -977,12 +1016,13 @@ class RingSidecar:
         if state["stage_caps"] is not None:
             state["stage_thresholds"] = stage_overflow_thresholds(
                 plan, state["stage_caps"])
-            state["packed_lane_fn"] = make_packed_lane_fn(
+            state["packed_lane_fn"] = _wrap(make_packed_lane_fn(
                 plan, service_groups=self._groups or None,
                 with_rule_hits=self._provenance_on,
-                donate=donate_batch_buffers())
+                donate=donate_batch_buffers()), "lanes")
             ppf = make_packed_prefilter_fn(plan)
-            state["packed_pf_fn"] = ppf.fn if ppf is not None else None
+            state["packed_pf_fn"] = \
+                _wrap(ppf.fn, "prefilter") if ppf is not None else None
         # Services whose route predicate fell back to host interpretation
         # are merged into the device route lane per batch (per group).
         host_routes: list = []
@@ -1015,7 +1055,7 @@ class RingSidecar:
         state["pf_attr"] = None
         pf = make_prefilter_fn(plan)
         if pf is not None:
-            state["pf_fn"] = pf.fn
+            state["pf_fn"] = _wrap(pf.fn, "prefilter")
             state["pf_gated_banks"] = len(pf.gated)
             if self._provenance_on:
                 from .obs.provenance import PrefilterAttribution
@@ -1031,11 +1071,14 @@ class RingSidecar:
         state["mega_fn"] = None
         if self._mega_mode != "off":
             from .engine.verdict import make_megastep_fn
+            from .obs.perf import instrument_megastep
 
-            state["mega_fn"] = make_megastep_fn(
-                plan, kind="lanes",
-                service_groups=self._groups or None,
-                with_rule_hits=self._provenance_on)
+            state["mega_fn"] = instrument_megastep(
+                make_megastep_fn(
+                    plan, kind="lanes",
+                    service_groups=self._groups or None,
+                    with_rule_hits=self._provenance_on),
+                plane="sidecar", fingerprint=fp, widths=widths)
         return state
 
     def _adopt_plan_state(self, plan, lists, state: dict) -> None:
@@ -1612,8 +1655,14 @@ class RingSidecar:
                 parts, now_ms,
                 est_ms=self.sched.cost.estimate_stage(
                     "compute", self.max_batch))
+        # `meta` rides the in-flight tuple into _complete (ISSUE 17):
+        # the dispatch-side time points feed the cross-plane timeline's
+        # stage spans, and the staging mode lands in every flight row.
+        meta = {"t0": t0, "t1": t1, "tpf": tpf, "t2": t2,
+                "staging_mode": ("compact" if batch.packed is not None
+                                 else "full")}
         return (parts, slots, raw, dev, rule_hits, pf_aux, n, skip_masks,
-                time.monotonic(), slot_buf, pipe_slot)
+                time.monotonic(), slot_buf, pipe_slot, meta)
 
     def _failopen_late_rows(self, parts, now_ms: int,
                             est_ms: Optional[float] = None) -> list:
@@ -1827,6 +1876,10 @@ class RingSidecar:
         win.k_ship = k_ship
         win.dev_out = dev_out
         win.t_launch = t1
+        # Window id (ISSUE 17 satellite): stamps every flight row this
+        # window serves, so stranded-slice reconciliation after a
+        # mid-window SIGKILL is traceable per window.
+        win.window_id = self.mega_windows
         return win
 
     def _complete_inflight(self, entry) -> None:
@@ -1895,6 +1948,9 @@ class RingSidecar:
                  else None),
                 s.n, skip_masks=s.skip_masks, t_disp=None,
                 slot_buf=s.slot_buf, pipe_slot=s.pipe_slot,
+                meta={"megastep_window": win.window_id,
+                      "megastep_k": win.k_ship,
+                      "staging_mode": "full"},
                 host=hosts[j],
                 dev_lanes=(lanes[j][:, :s.n] if lanes is not None
                            else None))
@@ -1925,7 +1981,8 @@ class RingSidecar:
 
     def _complete(self, parts, slots, raw_batch, dev, rule_hits, pf_aux,
                   n: int, skip_masks=None, t_disp=None, slot_buf=None,
-                  pipe_slot=None, host=None, dev_lanes=None) -> None:
+                  pipe_slot=None, meta=None, host=None,
+                  dev_lanes=None) -> None:
         from .engine.verdict import host_rule_lanes, merge_lanes
 
         # Megastep slices (ISSUE 12) arrive with host AND device lanes
@@ -2182,9 +2239,29 @@ class RingSidecar:
             self._observe_provenance(slots, rule_hits, dev_lanes, host,
                                      raw_batch, unverified,
                                      verified_block, wait_s, n,
-                                     pipe_slot=pipe_slot)
+                                     pipe_slot=pipe_slot, meta=meta)
         self._stage["provenance"].observe(
             (time.monotonic() - t_prov) * 1e3)
+        # Cross-plane timeline (ISSUE 17): per-batch cost while
+        # unsampled is the one add+compare inside sample(). The rows'
+        # enq_ms stamps are the NATIVE producer's ring clock — same
+        # CLOCK_MONOTONIC timebase as the sidecar stamps, which is what
+        # joins the ring-wait span across planes.
+        if self._timeline.sample():
+            m = meta or {}
+            tl_args = {"staging_mode": m.get("staging_mode", "full")}
+            if "megastep_window" in m:
+                tl_args["megastep_window"] = m["megastep_window"]
+                tl_args["megastep_k"] = m.get("megastep_k")
+            self._timeline.batch_sidecar(
+                t0=m.get("t0", 0.0), t1=m.get("t1", 0.0),
+                tpf=m.get("tpf", 0.0), t2=m.get("t2", 0.0),
+                t_sync=tc1, t_resolve=t_resolve, t_end=t_res_end,
+                rows=[(f"t-{int(slots['ticket'][i])}",
+                       int(slots["enq_ms"][i]))
+                      for i in range(
+                          min(n, self._timeline.rows_per_batch))],
+                args=tl_args)
         self.processed += n
         # The batch is fully resolved: its accumulation buffer returns
         # to the pool and its pipeline slot retires.
@@ -2197,7 +2274,7 @@ class RingSidecar:
     def _observe_provenance(self, slots, rule_hits, dev_lanes, host,
                             raw_batch, unverified, verified_block,
                             device_wait_s, n: int,
-                            pipe_slot=None) -> None:
+                            pipe_slot=None, meta=None) -> None:
         """Sidecar-plane provenance (ISSUE 5): fold the on-device
         attribution aux lane, flight-record the batch, and hand the
         FINAL served lanes (spill rewrites included) to the parity
@@ -2239,6 +2316,17 @@ class RingSidecar:
                 # against the pingoo_pipeline_* series — which batches
                 # were in flight together when this request was served.
                 stages["pipeline_slot"] = int(pipe_slot)
+            if meta is not None:
+                # Window id + K rung + staging mode (ISSUE 17
+                # satellite): flight rows predate the megastep —
+                # without these, stranded-slice reconciliation after a
+                # mid-window SIGKILL cannot tell which window a row
+                # rode.
+                if "megastep_window" in meta:
+                    stages["megastep_window"] = meta["megastep_window"]
+                    stages["megastep_k"] = meta.get("megastep_k")
+                stages["staging_mode"] = meta.get("staging_mode",
+                                                  "full")
             recorder.record(
                 trace_id=trace_ids[i],
                 digest=f"{crc & 0xFFFFFFFF:08x}",
@@ -2295,30 +2383,39 @@ class RingSidecar:
         process-global. The next dispatch pays one re-jit (a bounded
         stall during an already-degraded event)."""
         from .engine.verdict import donate_batch_buffers, make_lane_fn
+        from .obs.perf import (instrument_jit, plan_fingerprint,
+                               staging_widths)
 
         self.plan.dfa_default_mode = "off" if dfa_off else self._dfa_mode0
-        self._lane_fn = make_lane_fn(
+        fp = plan_fingerprint(self.plan)
+        widths = staging_widths(self.plan)
+        self._lane_fn = instrument_jit(make_lane_fn(
             self.plan, service_groups=self._groups or None,
             with_rule_hits=self._provenance_on,
-            donate=donate_batch_buffers())
+            donate=donate_batch_buffers()), "lanes", plane="sidecar",
+            fingerprint=fp, widths=widths)
         if self._packed_lane_fn is not None:
             # The packed twin embeds the same DFA dispatch decision;
             # keep it in lockstep with the per-batch program.
             from .engine.verdict import make_packed_lane_fn
 
-            self._packed_lane_fn = make_packed_lane_fn(
+            self._packed_lane_fn = instrument_jit(make_packed_lane_fn(
                 self.plan, service_groups=self._groups or None,
                 with_rule_hits=self._provenance_on,
-                donate=donate_batch_buffers())
+                donate=donate_batch_buffers()), "lanes",
+                plane="sidecar", fingerprint=fp, widths=widths)
         if self._mega_fn is not None:
             # The megastep embeds the same lane body — keep its DFA
             # dispatch in lockstep with the per-batch program.
             from .engine.verdict import make_megastep_fn
+            from .obs.perf import instrument_megastep
 
-            self._mega_fn = make_megastep_fn(
-                self.plan, kind="lanes",
-                service_groups=self._groups or None,
-                with_rule_hits=self._provenance_on)
+            self._mega_fn = instrument_megastep(
+                make_megastep_fn(
+                    self.plan, kind="lanes",
+                    service_groups=self._groups or None,
+                    with_rule_hits=self._provenance_on),
+                plane="sidecar", fingerprint=fp, widths=widths)
 
     def _dfa_rung_tick(self) -> None:
         """Demoted-dfa probe: when the backoff window opens, restore
@@ -2610,6 +2707,16 @@ class RingSidecar:
         # telemetry snapshot FFI call.
         self._collector_live = False
         self._registry.unregister_collector(self._export_ring_telemetry)
+        # Durable cost ledger (ISSUE 17): persist the measured EWMAs on
+        # drain so the next boot estimates from THIS run's costs.
+        try:
+            from .sched.scheduler import save_cost_ledger
+
+            save_cost_ledger(self.sched.cost,
+                             backend=self._backend_label,
+                             fingerprint=self._plan_fp, plane="sidecar")
+        except Exception:
+            pass
         if self.parity is not None:
             self.parity.stop()
         if self._attribution is not None:

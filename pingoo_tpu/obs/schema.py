@@ -291,6 +291,39 @@ STAGING_METRICS = {
         "(plan-derived cap, quantized to the pow2 rung ladder)",
 }
 
+# Perf ledger + cross-plane timeline + durable cost ledger (ISSUE 17,
+# docs/OBSERVABILITY.md "Compile ledger"/"Timeline"/"Cost ledger").
+# Exported by every plane that runs the batched verdict engine
+# (plane="python" listener service, plane="sidecar" ring drainer).
+# `pingoo_compile_total` carries {plane, fn, kind} — fn over
+# obs/perf.COMPILE_FN_KINDS (verdict|lanes|prefilter|megastep|score;
+# the packed-staging twins report under the same fn label), kind
+# cold|warm (warm = a retrace under live traffic, the recompile-storm
+# alert series); `pingoo_compile_ms` is a {plane, fn} histogram over
+# obs/perf.COMPILE_BUCKETS_MS. `pingoo_timeline_spans_total{plane}`
+# counts spans the sampler actually recorded (plane also takes the
+# value "native" for ring-wait spans stamped from native enqueue
+# clocks). `pingoo_costmodel_reload_total{plane, result}` counts boot
+# reload attempts of the durable cost ledger (result: ok | stale |
+# missing | error).
+PERF_METRICS = {
+    "pingoo_compile_total":
+        "XLA trace/compile events observed by the compile ledger, by "
+        "{fn, kind} (cold = a wrapper's first compile, warm = a later "
+        "retrace — the recompile-storm signal)",
+    "pingoo_compile_ms":
+        "wall time of observed XLA trace/compile events (histogram "
+        "per {plane, fn})",
+    "pingoo_timeline_spans_total":
+        "spans recorded by the cross-plane timeline sampler "
+        "(PINGOO_TIMELINE_SAMPLE-gated; bounded in-memory ring)",
+    "pingoo_costmodel_reload_total":
+        "durable cost-ledger reload attempts at boot, by result (ok = "
+        "EWMAs restored, stale = fingerprint/version mismatch "
+        "discarded, missing = no snapshot for this backend+plane, "
+        "error = unreadable file)",
+}
+
 # Native-plane-only counters (httpd.cc Stats), exported with
 # plane="native" under these names.
 NATIVE_METRICS = {
@@ -327,5 +360,5 @@ def all_metric_names() -> set[str]:
             | set(PARITY_METRICS) | set(SCHED_METRICS)
             | set(PIPELINE_METRICS) | set(RESILIENCE_METRICS)
             | set(HOTSWAP_METRICS) | set(BODY_METRICS)
-            | set(STAGING_METRICS)
+            | set(STAGING_METRICS) | set(PERF_METRICS)
             | {SHARED_WAIT_HISTOGRAM, "pingoo_verdict_stage_ms"})

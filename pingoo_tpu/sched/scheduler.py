@@ -412,6 +412,218 @@ class CostModel:
                     for kb, v in sorted(
                         self._dispatch_bytes_ewma.items())}}
 
+    def restore(self, snap: dict) -> bool:
+        """Inverse of snapshot(): overwrite this model's state from a
+        durable cost-ledger entry (ISSUE 17). Snapshot keys arrive
+        JSON-round-tripped — int bucket keys are strings, megastep keys
+        are "KxB", bytes keys "<kb>kb" — so each map is re-parsed;
+        unparseable entries are skipped, and the method returns True if
+        ANY state was restored. Overwrite (not blend) semantics: a
+        ledger measured on the actual backend beats both the static
+        seed and the lossy BENCH_history p_batch_ms seeding this path
+        replaces."""
+        if not isinstance(snap, dict):
+            return False
+        restored = False
+        seed = snap.get("seed_ms")
+        if isinstance(seed, (int, float)) and seed > 0:
+            self.seed_ms = max(float(seed), 1e-3)
+            restored = True
+
+        def _fbuckets(raw):
+            out = {}
+            if isinstance(raw, dict):
+                for b, v in raw.items():
+                    try:
+                        bucket, val = int(b), float(v)
+                    except (TypeError, ValueError):
+                        continue
+                    if bucket > 0 and val >= 0:
+                        out[bucket] = val
+            return out
+
+        ewma = _fbuckets(snap.get("ewma_ms"))
+        if ewma:
+            self._ewma = ewma
+            restored = True
+        stage_raw = snap.get("stage_ewma_ms")
+        if isinstance(stage_raw, dict):
+            stage = {}
+            for name, buckets in stage_raw.items():
+                if name not in STAGE_SEED_SPLIT:
+                    continue
+                parsed = _fbuckets(buckets)
+                if parsed:
+                    stage[name] = parsed
+            if stage:
+                self._stage_ewma = stage
+                restored = True
+
+        def _mega(raw):
+            out = {}
+            if isinstance(raw, dict):
+                for key, v in raw.items():
+                    try:
+                        k_s, b_s = str(key).split("x", 1)
+                        out[(int(k_s), int(b_s))] = float(v)
+                    except (TypeError, ValueError):
+                        continue
+            return out
+
+        mega = _mega(snap.get("megastep_ewma_ms"))
+        if mega:
+            self._mega_ewma = mega
+            restored = True
+        # _mega_first travels too: it records which (K, bucket) shapes
+        # already paid their cold compile, and with the compilation
+        # cache cold on a fresh boot that absorption must happen AGAIN
+        # — but restoring the map preserves the prior run's measured
+        # cold walls for the compile ledger cross-check, and a reloaded
+        # steady EWMA above means estimate_megastep never consults it.
+        first = _mega(snap.get("megastep_first_ms"))
+        if first:
+            self._mega_first = first
+            restored = True
+        disp_raw = snap.get("dispatch_bytes_ewma_ms")
+        if isinstance(disp_raw, dict):
+            disp = {}
+            for key, v in disp_raw.items():
+                try:
+                    kb = int(str(key).rstrip("kb"))
+                    val = float(v)
+                except (TypeError, ValueError):
+                    continue
+                if kb > 0 and val >= 0:
+                    disp[kb] = val
+            if disp:
+                self._dispatch_bytes_ewma = disp
+                restored = True
+        return restored
+
+
+# ----------------------------------------------------------------------
+# Durable cost ledger (ISSUE 17): CostModel snapshots persisted on
+# drain and reloaded at boot, versioned per backend + ruleset
+# fingerprint so the future autotuner only ever selects from costs
+# measured on the ACTUAL backend under the ACTUAL plan. This replaces
+# the lossy BENCH_history seeding path: a reload overwrites whatever
+# seed the constructor derived.
+
+COST_LEDGER_VERSION = 1
+DEFAULT_COST_LEDGER = "COST_LEDGER.json"
+
+
+def cost_ledger_path() -> Optional[str]:
+    """PINGOO_COST_LEDGER: unset/empty -> the default path (the ledger
+    is on by default — it is pure boot-time/drain-time IO, never hot);
+    `0`/`off` -> disabled; anything else is the path."""
+    raw = os.environ.get("PINGOO_COST_LEDGER", "").strip()
+    if raw.lower() in ("0", "off", "false", "none"):
+        return None
+    if not raw or raw.lower() in ("1", "on", "true"):
+        return DEFAULT_COST_LEDGER
+    return raw
+
+
+def _reload_counter(plane: str, result: str, registry=None):
+    if registry is None:
+        from ..obs import REGISTRY as registry  # noqa: N813
+    from ..obs import schema
+
+    return registry.counter(
+        "pingoo_costmodel_reload_total",
+        schema.PERF_METRICS["pingoo_costmodel_reload_total"],
+        labels={"plane": plane, "result": result})
+
+
+def load_cost_ledger(cost: CostModel, *, backend: str, fingerprint: str,
+                     plane: str, path: Optional[str] = None,
+                     registry=None) -> str:
+    """Boot-time reload of this plane's persisted CostModel snapshot.
+    Returns the counted result label: `ok` (EWMAs restored), `stale`
+    (version or ruleset-fingerprint mismatch — discarded), `missing`
+    (no file / no entry for this backend+plane), `error` (unreadable),
+    or `disabled` (gated off, nothing counted)."""
+    import json
+
+    if path is None:
+        path = cost_ledger_path()
+    if path is None:
+        return "disabled"
+    # Eager zero-valued series so the inventory is scrapeable from
+    # boot regardless of which result fires.
+    for result in ("ok", "stale", "missing", "error"):
+        _reload_counter(plane, result, registry)
+    entry_key = f"{backend}|{plane}"
+    try:
+        if not os.path.exists(path):
+            _reload_counter(plane, "missing", registry).inc()
+            return "missing"
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        _reload_counter(plane, "error", registry).inc()
+        return "error"
+    if not isinstance(doc, dict) \
+            or doc.get("version") != COST_LEDGER_VERSION:
+        _reload_counter(plane, "stale", registry).inc()
+        return "stale"
+    entry = (doc.get("entries") or {}).get(entry_key)
+    if not isinstance(entry, dict):
+        _reload_counter(plane, "missing", registry).inc()
+        return "missing"
+    if entry.get("fingerprint") != fingerprint:
+        _reload_counter(plane, "stale", registry).inc()
+        return "stale"
+    if not cost.restore(entry.get("cost") or {}):
+        _reload_counter(plane, "error", registry).inc()
+        return "error"
+    _reload_counter(plane, "ok", registry).inc()
+    return "ok"
+
+
+def save_cost_ledger(cost: CostModel, *, backend: str, fingerprint: str,
+                     plane: str, path: Optional[str] = None) -> bool:
+    """Drain-time persist of this plane's CostModel snapshot:
+    read-merge-write (other backend|plane entries survive), atomic via
+    tmp+rename, best-effort — a failed save never blocks shutdown."""
+    import json
+    import time
+
+    if path is None:
+        path = cost_ledger_path()
+    if path is None:
+        return False
+    doc: dict = {"version": COST_LEDGER_VERSION, "entries": {}}
+    try:
+        with open(path) as f:
+            prior = json.load(f)
+        if isinstance(prior, dict) \
+                and prior.get("version") == COST_LEDGER_VERSION \
+                and isinstance(prior.get("entries"), dict):
+            doc["entries"] = prior["entries"]
+    except (OSError, ValueError):
+        pass
+    doc["entries"][f"{backend}|{plane}"] = {
+        "ts": round(time.time(), 3),
+        "backend": backend,
+        "plane": plane,
+        "fingerprint": fingerprint,
+        "cost": cost.snapshot(),
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return False
+
 
 class SchedMetrics:
     """The plane's `pingoo_sched_*` instruments (obs/schema.py

@@ -98,6 +98,17 @@ HOT_FUNCTIONS = frozenset({
     "pingoo_tpu/sched/scheduler.py::CostModel.observe_megastep",
     "pingoo_tpu/sched/scheduler.py::CostModel.estimate_megastep",
     "pingoo_tpu/obs/pipeline.py::PipelineStats.note_megastep",
+    # Perf ledger + timeline (ISSUE 17): the compile probe wraps EVERY
+    # jitted dispatch (two O(1) cache-size calls per invocation; event
+    # assembly only on the rare compile branch), the stride sampler is
+    # one float add+compare per batch, and the span-record methods are
+    # pure float math over already-host stage numbers into a bounded
+    # deque — no arrays, never a device sync.
+    "pingoo_tpu/obs/perf.py::_InstrumentedJit.__call__",
+    "pingoo_tpu/obs/timeline.py::Timeline.sample",
+    "pingoo_tpu/obs/timeline.py::Timeline.add_span",
+    "pingoo_tpu/obs/timeline.py::Timeline.batch_python",
+    "pingoo_tpu/obs/timeline.py::Timeline.batch_sidecar",
 })
 
 # Functions traced by jax.jit that the AST cannot see are jitted (they

@@ -160,8 +160,24 @@ def main(argv=None) -> int:
         return 0
     latest, baseline = pick_baseline(entries)
     if baseline is None:
-        print(f"bench-regress: no prior run with backend="
-              f"{latest.get('backend')!r}; nothing comparable")
+        # Explicit cross-backend refusal (ISSUE 17 satellite): name
+        # BOTH backends so "nothing comparable" is diagnosable from the
+        # message alone, and treat a latest entry with no backend stamp
+        # at all as an error — history_schema>=2 lines (bench.py
+        # _append_history) always carry one, so its absence means the
+        # file predates the stamp or was hand-edited.
+        if latest.get("backend") is None:
+            print(f"bench-regress: latest entry in {args.file} has no "
+                  f"'backend' stamp (pre-schema-2 history?); refusing "
+                  f"to guess a baseline — re-run `python bench.py "
+                  f"--history` to append a stamped run", file=sys.stderr)
+            return 2
+        others = sorted({str(e.get("backend")) for e in entries[:-1]})
+        print(f"bench-regress: REFUSED — latest run is backend="
+              f"{latest.get('backend')!r} but every prior run is "
+              f"backend in {others}; cross-backend numbers are not "
+              f"comparable (a cpu-diagnostic floor vs a device run "
+              f"measures the host, not the change)")
         return 0
     regressions, report = compare(latest, baseline, args.threshold)
     print(f"bench-regress: latest ts={latest.get('ts')} vs baseline "

@@ -213,15 +213,41 @@ def main() -> int:
         problems.append(
             "native_ring.py: chaos wiring missing ChaosInjector")
 
+    # Perf ledger + timeline (ISSUE 17): the compile/timeline metric
+    # literals live in obs/perf.py + obs/timeline.py, the cost-ledger
+    # reload counter in sched/scheduler.py; both engine planes must
+    # wire the instrumentation (instrument_jit for compile tracking,
+    # get_timeline for span emission, load_cost_ledger for the durable
+    # cost reload) — that is what makes the series exist under both
+    # plane labels.
+    perf_src = (_read("pingoo_tpu/obs/perf.py")
+                + _read("pingoo_tpu/obs/timeline.py"))
+    for name in ("pingoo_compile_total", "pingoo_compile_ms",
+                 "pingoo_timeline_spans_total"):
+        if name not in perf_src:
+            problems.append(f"obs perf layer: missing metric {name}")
+    if "pingoo_costmodel_reload_total" not in sched_src:
+        problems.append("sched/scheduler.py: missing metric "
+                        "pingoo_costmodel_reload_total")
+    for plane_src, label in ((service_src, "engine/service.py"),
+                             (sidecar_src, "native_ring.py")):
+        for symbol in ("instrument_jit", "get_timeline",
+                       "load_cost_ledger", "save_cost_ledger"):
+            if symbol not in plane_src:
+                problems.append(
+                    f"{label}: perf wiring missing {symbol}")
+
     # Flight-recorder + explain endpoints: the Python listener serves
     # both; the native plane serves its own flightrecorder dump (the
     # C++ exposition is string literals, so the source is the schema).
-    for endpoint in ("/__pingoo/flightrecorder", "/__pingoo/explain"):
+    for endpoint in ("/__pingoo/flightrecorder", "/__pingoo/explain",
+                     "/__pingoo/compileledger", "/__pingoo/timeline"):
         if endpoint not in py_listener:
             problems.append(f"host/httpd.py: missing endpoint {endpoint}")
-    if "/__pingoo/flightrecorder" not in native_src:
-        problems.append(
-            "native/httpd.cc: missing endpoint /__pingoo/flightrecorder")
+    for endpoint in ("/__pingoo/flightrecorder", "/__pingoo/timeline"):
+        if endpoint not in native_src:
+            problems.append(
+                f"native/httpd.cc: missing endpoint {endpoint}")
 
     docs = _read("docs/OBSERVABILITY.md") if os.path.exists(
         os.path.join(REPO, "docs/OBSERVABILITY.md")) else ""
@@ -244,8 +270,17 @@ def main() -> int:
                             **schema.PIPELINE_METRICS,
                             **schema.RESILIENCE_METRICS,
                             **schema.BODY_METRICS,
-                            **schema.STAGING_METRICS}.items():
-        if name == "pingoo_body_carry_depth":
+                            **schema.STAGING_METRICS,
+                            **schema.PERF_METRICS}.items():
+        if name == "pingoo_compile_ms":
+            from pingoo_tpu.obs.perf import COMPILE_BUCKETS_MS
+
+            hb = reg.histogram(name, help_text,
+                               buckets=COMPILE_BUCKETS_MS,
+                               labels={"plane": "audit", "fn": "verdict"})
+            for v in (0.5, 120, 9500):
+                hb.observe(v)
+        elif name == "pingoo_body_carry_depth":
             hb = reg.histogram(name, help_text,
                                buckets=(1, 2, 4, 8, 16, 64, 256),
                                labels={"plane": "audit"})
@@ -290,6 +325,12 @@ def main() -> int:
         "plane": "audit", "mode": "compact"}).inc()
     reg.gauge("pingoo_staging_field_cap", "", labels={
         "field": "url"}).set(256)
+    reg.counter("pingoo_compile_total", "", labels={
+        "plane": "audit", "fn": "verdict", "kind": "cold"}).inc()
+    reg.counter("pingoo_timeline_spans_total", "", labels={
+        "plane": "audit"}).inc()
+    reg.counter("pingoo_costmodel_reload_total", "", labels={
+        "plane": "audit", "result": "stale"}).inc()
     h = reg.histogram(schema.SHARED_WAIT_HISTOGRAM, "wait",
                       buckets=WAIT_BUCKETS_MS, labels={"plane": "audit"})
     for v in (0.5, 3, 70, 2000):

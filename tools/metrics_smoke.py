@@ -30,6 +30,7 @@ import os
 import socket
 import subprocess
 import sys
+import tempfile
 import threading
 import time
 import urllib.request
@@ -41,6 +42,17 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 os.environ.setdefault("PINGOO_PARITY_SAMPLE", "1")
 FAULT_PATH = "/__parity-fault"
 os.environ.setdefault("PINGOO_PARITY_FAULT_INJECT", FAULT_PATH)
+# Perf ledger + timeline live checks (ISSUE 17 satellite): sample every
+# batch and append compile events to a throwaway JSONL so the smoke can
+# assert the /__pingoo/compileledger + /__pingoo/timeline endpoints see
+# real traffic. Must be set before the pingoo imports (the singletons
+# read the env once at construction).
+_PERF_TMP = tempfile.mkdtemp(prefix="pingoo-perf-smoke-")
+os.environ.setdefault("PINGOO_TIMELINE_SAMPLE", "1")
+os.environ.setdefault("PINGOO_PERF_LEDGER",
+                      os.path.join(_PERF_TMP, "PERF_LEDGER.jsonl"))
+os.environ.setdefault("PINGOO_COST_LEDGER",
+                      os.path.join(_PERF_TMP, "COST_LEDGER.json"))
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
@@ -61,13 +73,13 @@ def _free_port():
     return port
 
 
-def _get(port, path, accept=None, ua="smoke/1.0"):
+def _get(port, path, accept=None, ua="smoke/1.0", timeout=10):
     headers = {"user-agent": ua}
     if accept:
         headers["accept"] = accept
     req = urllib.request.Request(f"http://127.0.0.1:{port}{path}",
                                  headers=headers)
-    with urllib.request.urlopen(req, timeout=10) as r:
+    with urllib.request.urlopen(req, timeout=timeout) as r:
         return (r.status, {k.lower(): v for k, v in r.headers.items()},
                 r.read())
 
@@ -267,6 +279,65 @@ def main() -> int:
                 "explain: device verdict + matched rule names")
             check(ex.get("parity", {}).get("consistent") is True,
                   "explain: interpreter agrees with device path")
+            # Perf metric series (ISSUE 17): present on BOTH planes at
+            # boot (ensure_instruments), moving where traffic ran.
+            for plane in ("python", "sidecar"):
+                for name in ("pingoo_compile_total",
+                             "pingoo_timeline_spans_total",
+                             "pingoo_costmodel_reload_total"):
+                    check(f'plane="{plane}"' in "".join(
+                        ln for ln in text.splitlines()
+                        if ln.startswith(name)),
+                        f"{plane}: perf metric {name}")
+            # Compile ledger endpoint: the warm-up compile of the
+            # verdict fn must be on it (PINGOO_PERF_LEDGER set above).
+            status, _hdrs, body = _get(port, "/__pingoo/compileledger")
+            check(status == 200, "python: compileledger endpoint 200")
+            ledger = json.loads(body)
+            check(ledger.get("enabled") is True
+                  and ledger.get("compiles_total", 0) >= 1,
+                  "compileledger: warm-up compile recorded")
+            check(any(e.get("fn") == "verdict"
+                      for e in ledger.get("events", [])),
+                  "compileledger: verdict fn compile event present")
+            # Timeline endpoint: Chrome-trace JSON with real spans
+            # (PINGOO_TIMELINE_SAMPLE=1 above samples every batch).
+            status, _hdrs, body = _get(port, "/__pingoo/timeline")
+            check(status == 200, "python: timeline endpoint 200")
+            trace = json.loads(body)
+            spans = [e for e in trace.get("traceEvents", [])
+                     if e.get("ph") == "X"]
+            check(bool(spans), "timeline: sampled batch spans exported")
+            check("clock" in trace and "monotonic_now_us"
+                  in trace["clock"], "timeline: clock pin block present")
+            # On-demand profiler window (ISSUE 17 satellite): a bounded
+            # capture starts, reports its trace dir, and refuses a
+            # second concurrent window with 409.
+            # First-ever start_trace pays a multi-second one-time
+            # profiler init; give it headroom.
+            status, _hdrs, body = _get(port,
+                                       "/__pingoo/profile?seconds=1",
+                                       timeout=90)
+            check(status == 200, "python: profile endpoint 200")
+            prof = json.loads(body)
+            check(prof.get("profiling") is True and prof.get("dir"),
+                  "profile: bounded window started with trace dir")
+            try:
+                _get(port, "/__pingoo/profile?seconds=1")
+                check(False, "profile: concurrent window refused 409")
+            except urllib.error.HTTPError as e:
+                check(e.code == 409,
+                      "profile: concurrent window refused 409")
+            # SIGTERM drain path: ensure_trace_stopped flushes the live
+            # window synchronously and is idempotent (host/server.py
+            # calls it unconditionally from the drain finally block).
+            svc.ensure_trace_stopped()
+            svc.ensure_trace_stopped()
+            check(not getattr(svc, "_tracing", True),
+                  "profile: ensure_trace_stopped idempotent + flushed")
+            check(os.path.isdir(prof["dir"])
+                  and bool(os.listdir(prof["dir"])),
+                  "profile: flushed trace dir is non-empty")
 
         await asyncio.get_running_loop().run_in_executor(None, drive)
         serve.cancel()
@@ -301,6 +372,18 @@ def main() -> int:
               "native: flightrecorder carries verdict records")
         check(any(e.get("decided") == 1 for e in nfr.get("entries", [])),
               "native: flightrecorder recorded the /.env block")
+        # Native-plane timeline (ISSUE 17): Chrome-trace JSON from the
+        # same flight stamps, mergeable with the python dump.
+        status, _hdrs, body = _get(nport, "/__pingoo/timeline")
+        check(status == 200, "native: timeline endpoint 200")
+        ntl = json.loads(body)
+        nxs = [e for e in ntl.get("traceEvents", [])
+               if e.get("ph") == "X"]
+        check(bool(nxs) and all(e["name"] == "verdict_wait"
+                                for e in nxs),
+              f"native: timeline carries verdict_wait spans ({len(nxs)})")
+        check(ntl.get("clock", {}).get("unit") == "monotonic_us",
+              "native: timeline clock pin block present")
 
         asyncio.run(python_plane())
         check(sidecar.parity is not None

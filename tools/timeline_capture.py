@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""One-shot cross-plane timeline capture (ISSUE 17).
+
+Fetches /__pingoo/timeline from the Python listener plane and —
+optionally — the native C++ httpd, merges the two Chrome-trace dumps
+into ONE file, and writes it to disk, ready for Perfetto
+(https://ui.perfetto.dev) or chrome://tracing.
+
+The merge is plain traceEvents concatenation: every plane stamps the
+same CLOCK_MONOTONIC timebase (obs/timeline.py module docstring), so
+spans from both dumps already share the x-axis on the same machine.
+Each dump carries a `clock` block (monotonic now + wall now); the
+merged file keeps both blocks under `clocks` plus the derived
+wall-time offset so a post-processor can pin spans to UTC.
+
+Usage:
+    python tools/timeline_capture.py [--port 8080] [--native-port N]
+                                     [--out timeline.json]
+
+Sampling must be on (PINGOO_TIMELINE_SAMPLE > 0) for the Python dump
+to carry spans; the native dump always carries the last-256-requests
+flight window regardless.
+"""
+
+import argparse
+import json
+import sys
+import urllib.error
+import urllib.request
+
+
+def fetch(port: int, host: str = "127.0.0.1") -> dict:
+    url = f"http://{host}:{port}/__pingoo/timeline"
+    req = urllib.request.Request(url,
+                                 headers={"user-agent": "timeline-capture"})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def merge(python_dump: dict, native_dump: dict | None) -> dict:
+    events = list(python_dump.get("traceEvents", []))
+    clocks = {"python": python_dump.get("clock", {})}
+    if native_dump is not None:
+        events.extend(native_dump.get("traceEvents", []))
+        clocks["native"] = native_dump.get("clock", {})
+    out = {
+        "displayTimeUnit": "ms",
+        "clocks": clocks,
+        "otherData": python_dump.get("otherData", {}),
+        "traceEvents": events,
+    }
+    py_clock, na_clock = clocks.get("python"), clocks.get("native")
+    if py_clock and na_clock and py_clock.get("wall_now_s") \
+            and na_clock.get("wall_now_s"):
+        # Both clocks read CLOCK_MONOTONIC; on one machine the offset
+        # between the two dumps' (monotonic, wall) pairs is just the
+        # capture skew — report it so a reader can sanity-check the
+        # shared-timebase assumption (should be ~the fetch gap).
+        skew_s = (
+            (py_clock["monotonic_now_us"] - na_clock["monotonic_now_us"])
+            / 1e6 - (py_clock["wall_now_s"] - na_clock["wall_now_s"]))
+        out["clocks"]["capture_skew_s"] = round(skew_s, 3)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=8080,
+                    help="python listener plane port")
+    ap.add_argument("--native-port", type=int, default=0,
+                    help="native httpd port (0 = skip the native dump)")
+    ap.add_argument("--out", default="timeline.json")
+    args = ap.parse_args(argv)
+
+    try:
+        python_dump = fetch(args.port, args.host)
+    except (urllib.error.URLError, OSError, ValueError) as exc:
+        print(f"timeline-capture: python plane at :{args.port} "
+              f"unreachable: {exc}", file=sys.stderr)
+        return 1
+    native_dump = None
+    if args.native_port:
+        try:
+            native_dump = fetch(args.native_port, args.host)
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            print(f"timeline-capture: warning: native plane at "
+                  f":{args.native_port} unreachable ({exc}); python-"
+                  f"plane-only capture", file=sys.stderr)
+
+    merged = merge(python_dump, native_dump)
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    spans = sum(1 for e in merged["traceEvents"] if e.get("ph") == "X")
+    planes = "python+native" if native_dump is not None else "python"
+    print(f"timeline-capture: wrote {args.out} ({spans} spans, "
+          f"{planes}); open in https://ui.perfetto.dev")
+    if spans == 0:
+        print("timeline-capture: note: 0 spans — is "
+              "PINGOO_TIMELINE_SAMPLE set on the server?",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
