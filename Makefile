@@ -6,7 +6,7 @@ PY ?= python
 	metrics-smoke mesh-smoke chaos-smoke megastep-smoke body-smoke \
 	staging-smoke timeline-smoke \
 	clean analyze analyze-abi analyze-lint analyze-tidy analyze-tsan \
-	fuzz
+	fuzz prove ringcheck surface
 
 all: native
 
@@ -38,7 +38,10 @@ check:
 #   analyze-tsan  extended ring_stress under -fsanitize=thread
 #   fuzz          differential HTTP-parsing fuzzer across all three
 #                 parse paths (docs/FUZZING.md)
-analyze: analyze-abi analyze-lint analyze-tidy analyze-tsan fuzz
+#   prove         lowering-soundness prover + compile surface +
+#                 ring-protocol model checker (ISSUE 18; skips with a
+#                 warning when jax is unavailable)
+analyze: analyze-abi analyze-lint analyze-tidy analyze-tsan fuzz prove
 	$(PY) tools/check_metrics_schema.py
 
 analyze-abi:
@@ -52,6 +55,19 @@ analyze-tidy:
 
 analyze-tsan:
 	$(PY) -m tools.analyze tsan
+
+# Machine-checked lowering soundness (ISSUE 18, docs/STATIC_ANALYSIS.md
+# "Prove"): discharge every obligation on the seed 500-rule plan + the
+# body plan, refresh COMPILE_SURFACE.json, model-check the ring
+# protocol, and run the five mutation self-tests. Offline-safe.
+prove:
+	env JAX_PLATFORMS=cpu $(PY) -m tools.analyze prove
+
+ringcheck:
+	$(PY) -m tools.analyze ringcheck
+
+surface:
+	$(PY) -m tools.analyze surface
 
 # Differential parsing fuzzer (ISSUE 11, docs/FUZZING.md): 5k seeded
 # framing/encoding mutants through the native listener, the python

@@ -12,6 +12,15 @@ fingerprint of (rule sources, actions, list contents, format version).
 The cache directory is private to the server (like /etc/pingoo's
 auto-managed files); artifacts are only ever loaded if their fingerprint
 matches, so a stale or foreign file is simply ignored.
+
+Since v12 every artifact also carries a `plan_proof` block — the
+discharged soundness obligations from compiler/obligations.py, digest-
+sealed against tampering. A cache hit with a valid proof is also a
+proof hit (no re-prove at boot); a missing/tampered/failed block forces
+a re-prove of the loaded plan, and a plan that fails its obligations is
+REFUSED at compile time (ObligationError) rather than cached or served.
+Set PINGOO_PROVE=off to skip proving (e.g. while bisecting a prover
+regression); refusal semantics only apply when proving runs.
 """
 
 from __future__ import annotations
@@ -23,14 +32,21 @@ from typing import Optional
 
 from ..config.schema import RuleConfig
 from ..expr.values import Ip
+from .obligations import PlanProof, proof_block_valid, prove_plan, require
 from .plan import RulesetPlan, compile_ruleset, split_config_token
 
-FORMAT_VERSION = 11  # bump when plan/table layout changes
+FORMAT_VERSION = 12  # bump when plan/table layout changes
 # v8: scan_plans (per-bank strategy selection, halo partition sub-banks)
 # v9: PrefilterPlan + pf_<field> factor tables (literal-prefilter cascade)
 # v10: bitsplit-DFA lowering — dfa_<field> DfaTables, NfaScanPlan
 #      dfa_key/dfa_strategy/dfa_auto, RulesetPlan.dfa_default_mode
 # v11: compact staging — RulesetPlan.staging_required/staging_caps
+# v12: plan_proof block — discharged obligation ledger rides the artifact
+
+
+def _prove_enabled() -> bool:
+    return os.environ.get("PINGOO_PROVE", "on").lower() not in (
+        "off", "0", "no", "false")
 
 
 def ruleset_fingerprint(rules: list[RuleConfig], lists: dict,
@@ -78,17 +94,32 @@ def compile_ruleset_cached(
     routes=None,
     tenant: str = "",
 ) -> RulesetPlan:
-    """compile_ruleset with a transparent on-disk artifact cache."""
+    """compile_ruleset with a transparent on-disk artifact cache.
+
+    The cached path is also the PROVED path: a fresh compile discharges
+    the soundness obligations before the artifact is written (a failure
+    raises ObligationError), and a hit re-proves only when the stored
+    plan_proof block is missing or fails its digest/fingerprint check.
+    """
     if cache_dir is None:
         return compile_ruleset(rules, lists, field_specs, routes=routes)
     fingerprint = ruleset_fingerprint(rules, lists, field_specs,
                                       routes=routes, tenant=tenant)
     path = os.path.join(cache_dir, f"ruleset-{fingerprint[:32]}.plan")
-    plan = _load(path, fingerprint)
+    plan, proof_block = _load(path, fingerprint)
     if plan is not None:
+        if _prove_enabled() and not proof_block_valid(proof_block,
+                                                      fingerprint):
+            # tampered/absent proof: re-prove the loaded plan in place
+            # (same plan -> same verdict as a fresh compile would get).
+            proof = require(prove_plan(plan, fingerprint))
+            _save(path, fingerprint, plan, proof)
         return plan
     plan = compile_ruleset(rules, lists, field_specs, routes=routes)
-    _save(path, fingerprint, plan)
+    proof = None
+    if _prove_enabled():
+        proof = require(prove_plan(plan, fingerprint))
+    _save(path, fingerprint, plan, proof)
     return plan
 
 
@@ -108,29 +139,41 @@ def update_cached_plan(
     fingerprint = ruleset_fingerprint(rules, lists, field_specs,
                                       routes=routes, tenant=tenant)
     path = os.path.join(cache_dir, f"ruleset-{fingerprint[:32]}.plan")
-    _save(path, fingerprint, plan)
+    proof = None
+    if _prove_enabled():
+        # tuned plans re-prove before re-persisting: the autotuner only
+        # mutates scan strategies, but the artifact contract is that a
+        # stored proof always covers the stored plan.
+        proof = require(prove_plan(plan, fingerprint))
+    _save(path, fingerprint, plan, proof)
     return path
 
 
-def _save(path: str, fingerprint: str, plan: RulesetPlan) -> None:
+def _save(path: str, fingerprint: str, plan: RulesetPlan,
+          proof: Optional[PlanProof] = None) -> None:
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = path + ".tmp"
+        doc = {"fingerprint": fingerprint, "plan": plan}
+        if proof is not None:
+            doc["plan_proof"] = proof.to_dict()
         with open(tmp, "wb") as f:
-            pickle.dump({"fingerprint": fingerprint, "plan": plan}, f,
-                        protocol=pickle.HIGHEST_PROTOCOL)
+            pickle.dump(doc, f, protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)  # atomic install (acme.rs-style persistence)
     except (OSError, pickle.PicklingError):
         pass  # cache is best-effort
 
 
-def _load(path: str, fingerprint: str) -> Optional[RulesetPlan]:
+def _load(path: str,
+          fingerprint: str) -> tuple[Optional[RulesetPlan], Optional[dict]]:
     try:
         with open(path, "rb") as f:
             doc = pickle.load(f)
         if doc.get("fingerprint") != fingerprint:
-            return None
+            return None, None
         plan = doc.get("plan")
-        return plan if isinstance(plan, RulesetPlan) else None
+        if not isinstance(plan, RulesetPlan):
+            return None, None
+        return plan, doc.get("plan_proof")
     except Exception:
-        return None
+        return None, None

@@ -946,19 +946,31 @@ def lower_bank_to_dfa(
     return None
 
 
-def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarray:
-    """Reference bitwise scan in numpy (same algebra as the JAX op).
+def scan_chunk_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray,
+                     state: np.ndarray | None = None,
+                     t_offset: int = 0) -> np.ndarray:
+    """Chunk-carry reference scan: resume the bitwise algebra from a
+    carried state word vector.
 
-    data: [B, L] uint8, lengths: [B] -> matched [B, P] bool.
+    `lengths` are GLOBAL row lengths and `t_offset` is the global
+    position of data[:, 0]; the anchored injection fires only at global
+    t == 0, so feeding a row through consecutive chunks while threading
+    `state` must equal one contiguous scan. That seam-invariance is what
+    the torn-literal obligation (compiler/obligations.py, `make prove`)
+    checks for every compiled body/plan bank.
     """
     B, L = data.shape
     W = bank.num_words
     has_carry = bank.has_carry
     carry_mask = bank.carry_mask
     opt = bank.opt
-    S = np.zeros((B, W), dtype=np.uint32)
-    for t in range(L):
-        c = data[:, t].astype(np.int64)
+    if state is None:
+        S = np.zeros((B, W), dtype=np.uint32)
+    else:
+        S = state.astype(np.uint32).copy()
+    for tl in range(L):
+        t = t_offset + tl
+        c = data[:, tl].astype(np.int64)
         bc = bank.byte_table[c]  # [B, W]
         inj = bank.init_unanchored[None, :]
         if t == 0:
@@ -981,6 +993,14 @@ def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarr
                 adv |= esc_in & carry_mask
         S_new = ((adv | (S & bank.rep)) & bc).astype(np.uint32)
         S = np.where((t < lengths)[:, None], S_new, S)
+    return S
+
+
+def extract_numpy(bank: NfaBank, state: np.ndarray,
+                  lengths: np.ndarray) -> np.ndarray:
+    """Slot extraction from a final scan state: [B, W] -> [B, P] bool."""
+    B = state.shape[0]
+    W = bank.num_words
     out = np.zeros((B, bank.num_patterns), dtype=bool)
     empty = lengths == 0
     for p, slot in enumerate(bank.slots):
@@ -990,8 +1010,17 @@ def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarr
         hit = np.zeros(B, dtype=bool)
         for w, mask in slot.accepts:
             if W and mask:
-                hit |= (S[:, w] & np.uint32(mask)) != 0
+                hit |= (state[:, w] & np.uint32(mask)) != 0
         if slot.empty_ok:
             hit |= empty
         out[:, p] = hit
     return out
+
+
+def scan_numpy(bank: NfaBank, data: np.ndarray, lengths: np.ndarray) -> np.ndarray:
+    """Reference bitwise scan in numpy (same algebra as the JAX op).
+
+    data: [B, L] uint8, lengths: [B] -> matched [B, P] bool.
+    """
+    return extract_numpy(
+        bank, scan_chunk_numpy(bank, data, lengths), lengths)

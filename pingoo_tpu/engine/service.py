@@ -30,8 +30,9 @@ from ..config.schema import Action
 from ..expr import execute_as_bool
 from ..obs.flightrecorder import (FlightRecorder, register_recorder,
                                   tuple_digest)
-from ..obs.perf import (get_compile_ledger, instrument_jit,
-                        instrument_megastep, plan_fingerprint,
+from ..obs.perf import (batch_leading_dim, get_compile_ledger,
+                        instrument_jit, instrument_megastep,
+                        plan_fingerprint, set_dispatch_context,
                         staging_widths)
 from ..obs.pipeline import PipelineStats
 from ..obs.provenance import (ParityAuditor, PrefilterAttribution,
@@ -1381,6 +1382,7 @@ class VerdictService:
                 # jitted scorer compiles once per bucket, not per
                 # occupancy.
                 padded = pad_batch(batch, self._pow2_size(n))
+                set_dispatch_context(batch=self._pow2_size(n))
                 score_dev = self._score_fn(self.bot_score_params,
                                            padded.arrays)
             except Exception:
@@ -1496,6 +1498,10 @@ class VerdictService:
                 # and dispatches while batch N blocks on its result.
                 tok = (self._stage_tokens["dispatch"]
                        if self._staging is not None else nullcontext())
+                # True padded launch batch for the compile ledger's
+                # surface check (the packed blob hides the batch axis
+                # from arg-shape inspection).
+                set_dispatch_context(batch=batch_leading_dim(fast.arrays))
                 td0 = time.monotonic()
                 with tok:
                     # Mesh placement (ISSUE 6): the device programs
@@ -1674,6 +1680,7 @@ class VerdictService:
             td0 = time.monotonic()
             with tok:
                 stacked, nv, ep = self._mega_queue.device_stack(buf, k)
+                set_dispatch_context(batch=rows, k=k)
                 dev_out = self._mega_fn.fn(self._tables, stacked, nv, ep)
                 self._batch_stage(
                     "device_dispatch", (time.monotonic() - td0) * 1e3,

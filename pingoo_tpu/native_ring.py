@@ -1560,6 +1560,11 @@ class RingSidecar:
         if self.ladder.try_rung("device"):
             try:
                 self.chaos.maybe_xla_error(self.batches)
+                # True padded lane batch for the compile ledger's
+                # surface check (packed blobs hide the batch axis).
+                from .obs.perf import batch_leading_dim, \
+                    set_dispatch_context
+                set_dispatch_context(batch=batch_leading_dim(arrays))
                 # Busy window: the jitted calls return async once
                 # compiled, but the FIRST call per pow2 bucket blocks
                 # in XLA for seconds — the watchdog heartbeats through
@@ -1858,6 +1863,12 @@ class RingSidecar:
             with self._hb_busy():
                 stacked, nv, ep = self._mega_queue.device_stack(
                     self._mega_buf_id, k, pad_to=k_ship)
+                from .obs.perf import set_dispatch_context
+                set_dispatch_context(
+                    batch=next((int(a.shape[1]) for a in
+                                stacked.values()
+                                if getattr(a, "ndim", 0) == 3), None),
+                    k=k_ship)
                 dev_out = self._mega_fn.fn(self._tables, stacked,
                                            nv, ep)  # async
         except Exception as exc:

@@ -134,6 +134,72 @@ def _shape_context(shapes: list) -> tuple:
     return bucket, k
 
 
+def load_compile_surface(path: str) -> Optional[dict]:
+    """Read a COMPILE_SURFACE.json (tools/analyze/surface.py); None on
+    an unreadable/malformed file — the ledger then skips surface checks
+    rather than flagging every event."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            doc = json.load(f)
+        return doc if isinstance(doc, dict) and "fns" in doc else None
+    except (OSError, ValueError):
+        return None
+
+
+def event_in_surface(event: dict, surface: dict) -> Optional[str]:
+    """None when a compile event lies inside the statically-proved
+    admissible surface; else the axis that escaped it."""
+    if event.get("plane") not in surface.get("planes", ()):
+        return f"plane={event.get('plane')!r}"
+    if event.get("fn") not in surface.get("fns", ()):
+        return f"fn={event.get('fn')!r}"
+    if event.get("kind") not in surface.get("kinds", ()):
+        return f"kind={event.get('kind')!r}"
+    bucket = event.get("batch_bucket")
+    if bucket is not None and bucket not in surface.get(
+            "batch_buckets", ()):
+        return f"batch_bucket={bucket}"
+    k = event.get("k")
+    if k is not None and k not in surface.get("k_rungs", ()):
+        return f"k={k}"
+    widths = [list(w) for w in event.get("widths") or ()]
+    if widths and "widths" in surface and widths not in surface["widths"]:
+        return "widths"
+    return None
+
+
+_SURFACE_UNSET = object()
+
+# Dispatchers stamp the TRUE padded launch shape here right before an
+# instrumented call: the compact one-copy path bakes the batch into a
+# flat packed blob + static layout, so arg-shape inspection alone
+# recovers a rule-table dim, not the batch axis. Thread-local because
+# the listener service and the ring sidecar dispatch on their own
+# threads within one process.
+_DISPATCH_TLS = threading.local()
+
+
+def set_dispatch_context(batch: Optional[int] = None,
+                         k: Optional[int] = None) -> None:
+    _DISPATCH_TLS.batch = batch
+    _DISPATCH_TLS.k = k
+
+
+def dispatch_context() -> tuple:
+    return (getattr(_DISPATCH_TLS, "batch", None),
+            getattr(_DISPATCH_TLS, "k", None))
+
+
+def batch_leading_dim(arrays) -> Optional[int]:
+    """Padded launch batch from a per-field arrays mapping (the leading
+    dim of any 2-D request array)."""
+    for a in arrays.values():
+        shape = getattr(a, "shape", ())
+        if len(shape) >= 2:
+            return int(shape[0])
+    return None
+
+
 class CompileLedger:
     """Process-global compile-event sink shared by both Python planes
     (the listener service and the ring sidecar are co-resident)."""
@@ -148,6 +214,31 @@ class CompileLedger:
         self._hists: dict[tuple, Any] = {}
         self._registry = registry
         self._io_errors = 0
+        self._surface_doc: Any = _SURFACE_UNSET
+        self._unexpected_ctrs: dict[tuple, Any] = {}
+        self.unexpected_total = 0
+
+    def _surface(self) -> Optional[dict]:
+        # Resolved once per ledger: surface membership runs only on the
+        # rare compile branch, but env/file reads still don't belong
+        # there per-event.
+        if self._surface_doc is _SURFACE_UNSET:
+            path = os.environ.get("PINGOO_COMPILE_SURFACE")
+            self._surface_doc = load_compile_surface(path) if path else None
+        return self._surface_doc
+
+    def _unexpected_counter(self, plane: str, fn: str):
+        key = (plane, fn)
+        ctr = self._unexpected_ctrs.get(key)
+        if ctr is None:
+            from . import schema
+
+            ctr = self._reg().counter(
+                "pingoo_compile_unexpected_total",
+                schema.PERF_METRICS["pingoo_compile_unexpected_total"],
+                labels={"plane": plane, "fn": fn})
+            self._unexpected_ctrs[key] = ctr
+        return ctr
 
     @property
     def enabled(self) -> bool:
@@ -197,10 +288,16 @@ class CompileLedger:
 
     def note(self, *, plane: str, fn: str, kind: str, wall_ms: float,
              fingerprint: str = "", widths: tuple = (),
-             shapes: Optional[list] = None) -> None:
+             shapes: Optional[list] = None,
+             batch_bucket: Optional[int] = None,
+             k: Optional[int] = None) -> None:
         """One trace/compile event (called from the compile branch of
-        an instrumented call — rare by construction)."""
-        bucket, k = _shape_context(shapes or [])
+        an instrumented call — rare by construction). Explicit
+        batch_bucket/k (from set_dispatch_context) win over the
+        arg-shape heuristic, which cannot see through packed blobs."""
+        h_bucket, h_k = _shape_context(shapes or [])
+        bucket = batch_bucket if batch_bucket is not None else h_bucket
+        k = k if k is not None else h_k
         event = {
             "ts": round(time.time(), 3),
             "plane": plane,
@@ -213,12 +310,20 @@ class CompileLedger:
             "fingerprint": fingerprint,
             "shapes": [list(s) for s in (shapes or [])[:12]],
         }
+        surface = self._surface()
+        if surface is not None:
+            reason = event_in_surface(event, surface)
+            if reason is not None:
+                event["unexpected"] = reason
+                self._unexpected_counter(plane, fn).inc()
         self._counter(plane, fn, kind).inc()
         self._hist(plane, fn).observe(wall_ms)
         with self._lock:
             self.events.append(event)
             tkey = (plane, fn, kind)
             self.totals[tkey] = self.totals.get(tkey, 0) + 1
+            if event.get("unexpected"):
+                self.unexpected_total += 1
         if self.path:
             try:
                 with open(self.path, "a") as f:
@@ -238,6 +343,8 @@ class CompileLedger:
             "compiles_total": sum(totals.values()),
             "totals": totals,
             "io_errors": self._io_errors,
+            "surface_loaded": self._surface() is not None,
+            "unexpected_total": self.unexpected_total,
             "events": events,
         }
 
@@ -310,10 +417,12 @@ class _InstrumentedJit:
                 wall_ms = (time.monotonic() - t0) * 1e3
                 kind = "cold" if self._compiles == 0 else "warm"
                 self._compiles += 1
+                ctx_batch, ctx_k = dispatch_context()
                 self._ledger.note(
                     plane=self._plane, fn=self._name, kind=kind,
                     wall_ms=wall_ms, fingerprint=self._fingerprint,
-                    widths=self._widths, shapes=_arg_shapes(args))
+                    widths=self._widths, shapes=_arg_shapes(args),
+                    batch_bucket=ctx_batch, k=ctx_k)
         return out
 
     def __getattr__(self, item):

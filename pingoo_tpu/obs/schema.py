@@ -322,6 +322,11 @@ PERF_METRICS = {
         "EWMAs restored, stale = fingerprint/version mismatch "
         "discarded, missing = no snapshot for this backend+plane, "
         "error = unreadable file)",
+    "pingoo_compile_unexpected_total":
+        "compile events OUTSIDE the statically-proved admissible "
+        "surface (COMPILE_SURFACE.json via PINGOO_COMPILE_SURFACE), by "
+        "{plane, fn} — any nonzero value means an unquantized shape "
+        "axis reached a jitted dispatch; fails make timeline-smoke",
 }
 
 # Native-plane-only counters (httpd.cc Stats), exported with

@@ -220,11 +220,39 @@ class TestHotPathLinter:
         assert [f.rule for f in _lint(src)] == \
             ["suppression-missing-reason"]
 
-    def test_unused_suppression_warns(self):
+    def test_unused_suppression_is_a_finding(self):
+        """ISSUE 18 satellite: a reasoned allow() that matches nothing
+        is dead weight that would swallow the NEXT finding on its line
+        — a stale-suppression FINDING now, not a warning."""
         src = "x = 1  # pingoo: allow(sync-item): nothing here\n"
         findings, warnings = lint.lint_source(src, "pingoo_tpu/x.py")
-        assert findings == []
-        assert len(warnings) == 1 and "unused" in warnings[0]
+        assert [f.rule for f in findings] == ["stale-suppression"]
+        assert warnings == []
+
+    def test_used_suppression_is_not_stale(self):
+        src = ("def f(x):\n"
+               "    return x.item()  "
+               "# pingoo: allow(sync-item): cold path\n")
+        assert _lint(src) == []
+
+    def test_unquantized_len_into_dispatch_flagged(self):
+        src = ("class S:\n"
+               "    def go(self, data, x):\n"
+               "        return self._verdict_fn(data, len(x))\n")
+        assert [f.rule for f in _lint(src)] == ["unbounded-compile-axis"]
+
+    def test_shape_attr_into_dispatch_flagged(self):
+        src = ("class S:\n"
+               "    def go(self, data, a):\n"
+               "        return self._lane_fn(data, a.shape[0])\n")
+        assert [f.rule for f in _lint(src)] == ["unbounded-compile-axis"]
+
+    def test_quantized_shape_arg_is_clean(self):
+        src = ("class S:\n"
+               "    def go(self, data, x):\n"
+               "        return self._verdict_fn(\n"
+               "            data, pow2_batch_size(len(x), 1024))\n")
+        assert _lint(src) == []
 
     def test_walker_skips_pycache_and_binaries(self, tmp_path):
         base = tmp_path / "pingoo_tpu" / "engine"

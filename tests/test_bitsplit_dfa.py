@@ -465,8 +465,9 @@ class TestCacheRoundTrip:
     def test_format_version_bumped(self):
         from pingoo_tpu.compiler.cache import FORMAT_VERSION
 
-        # 11: plans carry staging_required/staging_caps (compact staging).
-        assert FORMAT_VERSION == 11
+        # 12: artifacts carry the discharged plan_proof block (ISSUE 18)
+        # — a cache hit is also a proof hit.
+        assert FORMAT_VERSION == 12
 
     def test_dfa_tables_survive_cache(self, tmp_path, monkeypatch):
         from pingoo_tpu.compiler.cache import compile_ruleset_cached

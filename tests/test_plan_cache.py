@@ -61,3 +61,103 @@ def test_corrupt_artifact_ignored(tmp_path):
             f.write(b"garbage")
     plan2 = compile_ruleset_cached(rules, lists, cache_dir=cache)
     assert plan2.stats == plan1.stats
+
+
+# -- v12 plan_proof block (ISSUE 18): cache hit == proof hit ---------------
+
+
+def _count_proves(monkeypatch):
+    """Patch cache.prove_plan to count invocations while preserving
+    behavior (the cache module imported the name directly)."""
+    import pingoo_tpu.compiler.cache as cache_mod
+    from pingoo_tpu.compiler.obligations import prove_plan as real
+
+    calls = []
+
+    def counted(plan, fingerprint=""):
+        calls.append(fingerprint)
+        return real(plan, fingerprint)
+
+    monkeypatch.setattr(cache_mod, "prove_plan", counted)
+    return calls
+
+
+def test_valid_proof_block_skips_reprove(tmp_path, monkeypatch):
+    rules, lists = generate_ruleset(10, with_lists=False)
+    cache = str(tmp_path / "cache")
+    calls = _count_proves(monkeypatch)
+    compile_ruleset_cached(rules, lists, cache_dir=cache)
+    assert len(calls) == 1  # fresh compile proved once
+    compile_ruleset_cached(rules, lists, cache_dir=cache)
+    assert len(calls) == 1  # hit with a valid proof block: no re-prove
+
+
+def test_tampered_proof_block_forces_reprove(tmp_path, monkeypatch):
+    import os
+    import pickle
+
+    rules, lists = generate_ruleset(10, with_lists=False)
+    cache = str(tmp_path / "cache")
+    compile_ruleset_cached(rules, lists, cache_dir=cache)
+    fname = os.listdir(cache)[0]
+    path = os.path.join(cache, fname)
+    with open(path, "rb") as f:
+        doc = pickle.load(f)
+    assert doc["plan_proof"]["ok"] is True
+    doc["plan_proof"]["obligations"][0]["name"] = "tampered"
+    with open(path, "wb") as f:
+        pickle.dump(doc, f)
+    calls = _count_proves(monkeypatch)
+    compile_ruleset_cached(rules, lists, cache_dir=cache)
+    assert len(calls) == 1  # digest mismatch: loaded plan re-proved
+    # ... and the re-proved block was re-persisted: next hit is clean.
+    compile_ruleset_cached(rules, lists, cache_dir=cache)
+    assert len(calls) == 1
+
+
+def test_absent_proof_block_forces_reprove(tmp_path, monkeypatch):
+    import os
+    import pickle
+
+    rules, lists = generate_ruleset(10, with_lists=False)
+    cache = str(tmp_path / "cache")
+    compile_ruleset_cached(rules, lists, cache_dir=cache)
+    fname = os.listdir(cache)[0]
+    path = os.path.join(cache, fname)
+    with open(path, "rb") as f:
+        doc = pickle.load(f)
+    del doc["plan_proof"]
+    with open(path, "wb") as f:
+        pickle.dump(doc, f)
+    calls = _count_proves(monkeypatch)
+    compile_ruleset_cached(rules, lists, cache_dir=cache)
+    assert len(calls) == 1
+
+
+def test_proof_block_pins_fingerprint(tmp_path):
+    import os
+    import pickle
+
+    from pingoo_tpu.compiler.obligations import proof_block_valid
+
+    rules, lists = generate_ruleset(10, with_lists=False)
+    cache = str(tmp_path / "cache")
+    compile_ruleset_cached(rules, lists, cache_dir=cache)
+    fname = os.listdir(cache)[0]
+    with open(os.path.join(cache, fname), "rb") as f:
+        doc = pickle.load(f)
+    block = doc["plan_proof"]
+    fp = doc["fingerprint"]
+    assert proof_block_valid(block, fp)
+    assert not proof_block_valid(block, "deadbeef" + fp[8:])
+    assert not proof_block_valid(None, fp)
+
+
+def test_prove_off_skips_proving(tmp_path, monkeypatch):
+    monkeypatch.setenv("PINGOO_PROVE", "off")
+    rules, lists = generate_ruleset(10, with_lists=False)
+    cache = str(tmp_path / "cache")
+    calls = _count_proves(monkeypatch)
+    compile_ruleset_cached(rules, lists, cache_dir=cache)
+    compile_ruleset_cached(rules, lists, cache_dir=cache)
+    assert calls == []

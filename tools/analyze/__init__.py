@@ -23,3 +23,12 @@ import os
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+# Skip registry: a pass that downgrades to skip-with-warning records
+# (pass_name, reason) here so the `all` summary table can show WHY a
+# pass didn't really run instead of a green PASS that proved nothing.
+SKIP_NOTES: list = []
+
+
+def note_skip(pass_name: str, reason: str) -> None:
+    SKIP_NOTES.append((pass_name, reason))

@@ -3,12 +3,18 @@
     python -m tools.analyze all            # every pass (make analyze)
     python -m tools.analyze abi [--regen]  # cross-plane ABI checker
     python -m tools.analyze lint [files…]  # JAX hot-path linter
-    python -m tools.analyze tidy           # clang-tidy vs baseline
+    python -m tools.analyze tidy [--regen] # clang-tidy vs baseline
     python -m tools.analyze tsan           # ring_stress concurrency gate
     python -m tools.analyze fuzz           # differential parsing fuzzer
+    python -m tools.analyze prove          # lowering-soundness prover +
+                                           # compile surface + ringcheck
+    python -m tools.analyze ringcheck      # ring-protocol model checker
+    python -m tools.analyze surface        # emit COMPILE_SURFACE.json
 
 Passes are offline-safe; missing toolchains (C++ compiler, clang-tidy,
-TSAN runtime) downgrade the affected pass to skip-with-warning.
+TSAN runtime, jax for `prove`) downgrade the affected pass to
+skip-with-warning. `all` ends with a per-pass summary table —
+pass/fail, or skip with the recorded reason.
 """
 
 from __future__ import annotations
@@ -26,8 +32,21 @@ def main(argv=None) -> int:
     p_lint = sub.add_parser("lint", help="JAX hot-path linter")
     p_lint.add_argument("files", nargs="*",
                         help="files to lint (default: configured dirs)")
-    sub.add_parser("tidy", help="clang-tidy (bugprone/concurrency)")
+    p_tidy = sub.add_parser("tidy", help="clang-tidy "
+                                         "(bugprone/concurrency)")
+    p_tidy.add_argument("--regen", action="store_true",
+                        help="rewrite tidy_baseline.txt from the "
+                             "current findings")
     sub.add_parser("tsan", help="ring_stress thread-sanitizer gate")
+    p_prove = sub.add_parser(
+        "prove", help="machine-check the lowering obligations, compile "
+                      "surface, and ring protocol (ISSUE 18)")
+    p_prove.add_argument("--history", action="store_true",
+                         help="append prove_wall_s to BENCH_history.jsonl")
+    p_prove.add_argument("--skip-mutations", action="store_true",
+                         help="skip the checker self-tests (faster)")
+    sub.add_parser("ringcheck", help="ring-protocol model checker only")
+    sub.add_parser("surface", help="emit COMPILE_SURFACE.json only")
     p_fuzz = sub.add_parser(
         "fuzz", help="differential HTTP-parsing fuzzer (ISSUE 11)")
     p_fuzz.add_argument("--mutants", type=int, default=None)
@@ -44,9 +63,19 @@ def main(argv=None) -> int:
     if args.cmd == "lint":
         return lint.run(paths=args.files or None)
     if args.cmd == "tidy":
-        return native.run_tidy()
+        return native.run_tidy(regen=args.regen)
     if args.cmd == "tsan":
         return native.run_tsan()
+    if args.cmd == "prove":
+        from . import prove
+        return prove.run(history=args.history,
+                         mutations=not args.skip_mutations)
+    if args.cmd == "ringcheck":
+        from . import ringcheck
+        return ringcheck.run()
+    if args.cmd == "surface":
+        from . import surface
+        return surface.run()
     if args.cmd == "fuzz":
         kwargs = {}
         if args.mutants is not None:
@@ -55,12 +84,31 @@ def main(argv=None) -> int:
             kwargs["seed"] = args.seed
         return fuzz.run(corpus_only=args.corpus_only,
                         no_native=args.no_native, **kwargs)
+    from . import SKIP_NOTES, prove
+
     rc = 0
-    rc |= abi.run()
-    rc |= lint.run()
-    rc |= native.run_tidy()
-    rc |= native.run_tsan()
-    rc |= fuzz.run()
+    results = []
+    for name, pass_fn in (("abi", abi.run), ("lint", lint.run),
+                          ("tidy", native.run_tidy),
+                          ("tsan", native.run_tsan), ("fuzz", fuzz.run),
+                          ("prove", prove.run)):
+        before = len(SKIP_NOTES)
+        try:
+            prc = pass_fn()
+        except Exception as exc:
+            # A crashed pass is a FAIL for that row, not an abort of
+            # the remaining passes.
+            print(f"analyze-{name}: FAIL — pass crashed: {exc!r}",
+                  file=sys.stderr)
+            prc = 1
+        reasons = [r for _, r in SKIP_NOTES[before:]]
+        status = "FAIL" if prc else ("SKIP" if reasons else "PASS")
+        results.append((name, status, "; ".join(reasons)))
+        rc |= prc
+    print("\nanalyze summary:")
+    for name, status, reason in results:
+        print(f"  {name:<6} {status}" + (f"  — {reason}" if reason
+                                         else ""))
     return rc
 
 

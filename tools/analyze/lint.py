@@ -26,6 +26,14 @@ Rule inventory (docs/STATIC_ANALYSIS.md):
                            captured from OUTSIDE the traced region
                            (re-staged on every retrace; hoist it)
   suppression-missing-reason   # pingoo: allow(...) without a reason
+  stale-suppression    a reasoned allow() that no longer matches any
+                       finding — dead suppressions hide future
+                       regressions on their line, so they must go
+  unbounded-compile-axis   a len()/.shape-derived expression reaching a
+                           jitted dispatch without passing through a
+                           registered quantizer (SHAPE_QUANTIZERS) —
+                           every raw size value is a fresh XLA compile
+                           outside the proved COMPILE_SURFACE bound
 
 Suppression syntax — the rule name AND a reason are mandatory:
 
@@ -59,6 +67,9 @@ RULES = {
     "recompile-const-upload":
         "jnp constant captured from outside the traced region",
     "suppression-missing-reason": "allow() without a reason",
+    "stale-suppression": "suppression no longer matches any finding",
+    "unbounded-compile-axis":
+        "shape-derived jit argument outside a registered quantizer",
 }
 
 _NP_NAMES = frozenset({"np", "numpy"})
@@ -122,6 +133,34 @@ def _attr_chain_root(node: ast.AST):
         yield from _attr_chain_root(node.right)
     elif isinstance(node, ast.UnaryOp):
         yield from _attr_chain_root(node.operand)
+
+
+def _unquantized_shape_expr(node: ast.AST):
+    """Depth-first hunt for a len()/.shape-derived subexpression that
+    does NOT pass through a registered quantizer (cfg.SHAPE_QUANTIZERS)
+    — a quantizer call makes its whole subtree admissible, because its
+    output lands on a rung ladder by construction. Returns a short
+    description of the raw source, or None."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        callee = f.attr if isinstance(f, ast.Attribute) \
+            else getattr(f, "id", None)
+        if callee in cfg.SHAPE_QUANTIZERS:
+            return None
+        if callee == "len":
+            return "len()"
+        for sub in list(node.args) + [kw.value for kw in node.keywords]:
+            got = _unquantized_shape_expr(sub)
+            if got:
+                return got
+        return None
+    if isinstance(node, ast.Attribute) and node.attr == "shape":
+        return ".shape"
+    for child in ast.iter_child_nodes(node):
+        got = _unquantized_shape_expr(child)
+        if got:
+            return got
+    return None
 
 
 def _is_jit_expr(node: ast.AST) -> bool:
@@ -269,6 +308,20 @@ class _FileLinter(ast.NodeVisitor):
             self._call_on_attribute(node, f)
         elif isinstance(f, ast.Name):
             self._call_on_name(node, f)
+        callee = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if callee in cfg.JITTED_DISPATCH_NAMES:
+            for arg in (list(node.args)
+                        + [kw.value for kw in node.keywords]):
+                raw = _unquantized_shape_expr(arg)
+                if raw:
+                    self._flag(
+                        node, "unbounded-compile-axis",
+                        f"{raw} flows into jitted dispatch {callee} "
+                        "without a registered quantizer "
+                        "(SHAPE_QUANTIZERS); every raw value is a "
+                        "fresh XLA compile outside COMPILE_SURFACE")
+                    break
         self.generic_visit(node)
 
     def _call_on_attribute(self, node: ast.Call, f: ast.Attribute) -> None:
@@ -377,11 +430,19 @@ def lint_source(source: str, path: str) -> tuple[list[Finding],
                     break
         if not suppressed:
             kept.append(finding)
-    warnings = [
-        f"{path}:{sup.line}: unused suppression allow"
-        f"({', '.join(sup.rules)})"
-        for sup in suppressions if sup.has_reason and not sup.used]
-    return kept, warnings
+    # A reasoned suppression that matched nothing is dead weight that
+    # silently swallows the NEXT real finding on its line: a FINDING,
+    # not a warning (and deliberately not itself suppressible). One
+    # naming an unknown rule is already suppression-missing-reason —
+    # "stale" would misdiagnose the typo as dead code.
+    for sup in suppressions:
+        if sup.has_reason and not sup.used \
+                and all(r in RULES for r in sup.rules):
+            kept.append(Finding(
+                path, sup.line, "stale-suppression",
+                f"allow({', '.join(sup.rules)}) no longer matches any "
+                "finding; delete the suppression"))
+    return kept, []
 
 
 def iter_lint_files(repo_root: str = REPO_ROOT):

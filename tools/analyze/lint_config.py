@@ -153,6 +153,21 @@ JITTED_DISPATCH_NAMES = frozenset({
     "lane_fn", "_mega_fn", "mega_fn",
 })
 
+# Registered shape quantizers (unbounded-compile-axis): the ONLY
+# sanctioned routes from a raw size (len(x), arr.shape) to a jitted
+# dispatch argument. Each lands its input on a closed rung ladder, so
+# the reachable compile set stays inside the statically-proved
+# COMPILE_SURFACE.json bound (tools/analyze/surface.py).
+SHAPE_QUANTIZERS = frozenset({
+    "pow2_batch_size",   # engine/batch.py: pow2 batch ladder, floor 8
+    "bucket_len",        # engine/batch.py: field-axis length buckets
+    "bucket_arrays",     # engine/batch.py: bucket every field axis
+    "pad_batch",         # engine/batch.py: pad batch axis to a rung
+    "quantize_stage_cap",  # compiler/plan.py: staging-width rungs
+    "megastep_k_ladder",   # engine/verdict.py: pow2 megastep K rungs
+    "_pow2_size",        # service wrapper over pow2_batch_size
+})
+
 # numpy allocators flagged inside hot functions (hot-alloc).
 NP_ALLOCATORS = frozenset({
     "zeros", "ones", "empty", "full", "zeros_like", "ones_like",

@@ -26,7 +26,7 @@ import subprocess
 import sys
 import tempfile
 
-from . import REPO_ROOT
+from . import REPO_ROOT, note_skip
 
 NATIVE_DIR = os.path.join(REPO_ROOT, "pingoo_tpu", "native")
 BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -55,6 +55,8 @@ def _toolchain_supports_tsan() -> str | None:
 
 def run_tsan() -> int:
     if _toolchain_supports_tsan() is None:
+        note_skip("tsan", "toolchain cannot build -fsanitize=thread "
+                          "binaries")
         print("analyze-tsan: SKIP — toolchain cannot build "
               "-fsanitize=thread binaries (tier-1 stays green; run in "
               "the dev container for the full gate)", file=sys.stderr)
@@ -128,6 +130,23 @@ def load_baseline(path: str = BASELINE_PATH) -> list[str]:
     return out
 
 
+def write_baseline(findings: list[str], path: str = BASELINE_PATH
+                   ) -> None:
+    """`tidy --regen`: rewrite the baseline from the current findings.
+    Regenerated entries carry a TODO reason — the contract is that each
+    accepted line gets a real `# reason` comment before it lands."""
+    with open(path, "w") as f:
+        f.write("# clang-tidy accepted-findings baseline "
+                "(tools/analyze/native.py run_tidy).\n"
+                "# One normalized `file:check: message` key per line; "
+                "new findings not listed here fail make analyze.\n"
+                "# Regenerate with: python -m tools.analyze tidy "
+                "--regen\n")
+        for key in findings:
+            f.write("# TODO: record why this finding is accepted\n")
+            f.write(key + "\n")
+
+
 def diff_against_baseline(findings: list[str], baseline: list[str]
                           ) -> tuple[list[str], list[str]]:
     """-> (new findings not in the baseline, stale baseline entries)."""
@@ -135,9 +154,10 @@ def diff_against_baseline(findings: list[str], baseline: list[str]
     return sorted(fset - bset), sorted(bset - fset)
 
 
-def run_tidy() -> int:
+def run_tidy(regen: bool = False) -> int:
     tidy = shutil.which("clang-tidy")
     if tidy is None:
+        note_skip("tidy", "clang-tidy not installed")
         print("analyze-tidy: SKIP — clang-tidy not installed (tier-1 "
               "stays green; run in a container with clang-tools for "
               "the full gate)", file=sys.stderr)
@@ -148,6 +168,12 @@ def run_tidy() -> int:
         [tidy, "--quiet", *sources, "--", "-std=c++17", "-I", NATIVE_DIR],
         capture_output=True, cwd=REPO_ROOT, timeout=900)
     findings = normalize_tidy_output(proc.stdout.decode(errors="replace"))
+    if regen:
+        write_baseline(findings)
+        print(f"analyze-tidy: baseline regenerated "
+              f"({len(findings)} finding(s) -> "
+              f"{os.path.relpath(BASELINE_PATH, REPO_ROOT)})")
+        return 0
     fresh, stale = diff_against_baseline(findings, load_baseline())
     for s in stale:
         print(f"analyze-tidy: warning: stale baseline entry (fixed? "

@@ -1,0 +1,304 @@
+"""Bounded exhaustive model checker for the v6 ring protocol.
+
+The TSAN gate (tools/analyze/native.py) proves the ring's MEMORY model
+— no data races under real thread interleavings.  This pass proves the
+PROTOCOL logic: a faithful small Python model of the request/verdict
+ring state machine (pingoo_tpu/native_ring.py + native/pingoo_ring.cc)
+is explored over EVERY interleaving of its atomic actions up to a
+configurable ticket/crash bound, and protocol properties are checked in
+every reachable state:
+
+  exactly-once     every enqueued ticket ends applied exactly once in
+                   every quiescent state (no lost verdict, and the data
+                   plane's unknown-ticket check makes duplicate posts
+                   from crash-reattach reconciliation harmless)
+  no-double-apply  applied count never exceeds 1 anywhere (invariant)
+  floor-safety     every ticket below posted_floor has been posted —
+                   the invariant _reconcile_orphans's orphan window
+                   [max(posted_floor, tail - capacity), req_tail)
+                   depends on (its docstring's "posted_floor only
+                   advances once a part's verdicts are all posted")
+
+Modeled actions: enqueue, bulk-drain (dequeue), verdict post,
+posted-floor advance (the CAS), SIGKILL crash (in-flight knowledge
+lost, shm survives), epoch bump + orphan reconcile on reattach
+(re-posts the whole orphan window; duplicates are dropped downstream),
+and the streaming body ring as a second small model (window enqueue /
+scan / carry-losing crash / FINAL verdict) proving no body window is
+ever lost SILENTLY: a FINAL verdict may be `clean` only when every
+window was scanned on an unbroken carry chain (gap => degrade, the
+ABORT/fail-open posture).  Heartbeat-freeze handling is subsumed by the
+crash/reattach actions — the supervisor's response to a frozen
+heartbeat is exactly a kill + reattach.
+
+`mutate=` knobs deliberately break the model the way a regression in
+the sidecar would, proving the checker bites (make prove runs the
+broken-reclaim one as a self-test):
+
+  floor_before_post   advance posted_floor to the consumed cursor
+                      before the part's verdicts are posted — a crash
+                      in the gap strands a drained ticket below the
+                      reconcile window (lost verdict)
+  silent_gap          the body FINAL verdict ignores a carry break and
+                      reports clean over a torn scan
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class RingConfig:
+    tickets: int = 3
+    capacity: int = 4  # >= tickets: no slot recycling inside the bound
+    max_crashes: int = 1
+    mutate: Optional[str] = None  # None | 'floor_before_post'
+
+
+@dataclass
+class ModelResult:
+    ok: bool
+    states: int
+    violations: list = field(default_factory=list)  # (property, trace)
+
+    def describe(self) -> str:
+        if self.ok:
+            return f"{self.states} states, all properties hold"
+        prop, trace = self.violations[0]
+        return (f"{self.states} states, {len(self.violations)} "
+                f"violation(s); first: {prop} after " + " -> ".join(trace))
+
+
+# ---------------------------------------------------------------------------
+# request/verdict ring model
+#
+# State (immutable, hashable):
+#   tail      tickets enqueued so far
+#   drained   consumer cursor (tickets dequeued by any epoch)
+#   inflight  drained-but-unposted tickets the LIVE sidecar knows about
+#   posts     per-ticket total posts (any epoch, incl. reconcile)
+#   vring     per-ticket verdicts posted but not yet consumed downstream
+#   pending   per-ticket: data plane still awaits a verdict
+#   applied   per-ticket: verdicts the data plane accepted
+#   floor     posted_floor
+#   crashed   sidecar down (SIGKILL'd, not yet reattached)
+#   crashes   crashes used
+
+
+def _ring_actions(state: tuple, cfg: RingConfig):
+    (tail, drained, inflight, posts, vring, pending, applied,
+     floor, crashed, crashes) = state
+    N = cfg.tickets
+
+    if tail < N and (tail < cfg.capacity or tail - cfg.capacity < drained):
+        t = tail
+        yield (f"enqueue({t})", (
+            tail + 1, drained, inflight, posts, vring,
+            pending[:t] + (1,) + pending[t + 1:], applied,
+            floor, crashed, crashes))
+
+    if not crashed and drained < tail:
+        t = drained
+        yield (f"drain({t})", (
+            tail, drained + 1, tuple(sorted(set(inflight) | {t})), posts,
+            vring, pending, applied, floor, crashed, crashes))
+
+    if not crashed:
+        for t in inflight:
+            yield (f"post({t})", (
+                tail, drained, tuple(x for x in inflight if x != t),
+                posts[:t] + (posts[t] + 1,) + posts[t + 1:],
+                vring[:t] + (vring[t] + 1,) + vring[t + 1:],
+                pending, applied, floor, crashed, crashes))
+
+    if not crashed:
+        if cfg.mutate == "floor_before_post":
+            f2 = drained  # BROKEN: floor covers drained-but-unposted
+        else:
+            f2 = floor
+            while f2 < drained and posts[f2] >= 1:
+                f2 += 1
+        if f2 > floor:
+            yield (f"floor->{f2}", (
+                tail, drained, inflight, posts, vring, pending, applied,
+                f2, crashed, crashes))
+
+    for t in range(cfg.tickets):
+        if vring[t] > 0:
+            dup = not pending[t]
+            yield ((f"apply({t})" if not dup else f"drop-dup({t})"), (
+                tail, drained, inflight, posts,
+                vring[:t] + (vring[t] - 1,) + vring[t + 1:],
+                pending if dup else pending[:t] + (0,) + pending[t + 1:],
+                applied if dup else
+                applied[:t] + (applied[t] + 1,) + applied[t + 1:],
+                floor, crashed, crashes))
+
+    if not crashed and crashes < cfg.max_crashes:
+        yield ("SIGKILL", (
+            tail, drained, (), posts, vring, pending, applied,
+            floor, True, crashes + 1))
+
+    if crashed:
+        # epoch bump + _reconcile_orphans: re-post EVERY ticket in
+        # [max(floor, tail - capacity), tail), then floor = tail.
+        p2, v2 = list(posts), list(vring)
+        for t in range(max(floor, tail - cfg.capacity), tail):
+            p2[t] += 1
+            v2[t] += 1
+        yield ("reattach+reconcile", (
+            tail, drained, (), tuple(p2), tuple(v2), pending, applied,
+            tail, False, crashes))
+
+
+def _check_ring_state(state: tuple, cfg: RingConfig,
+                      quiescent: bool) -> list[str]:
+    (tail, drained, inflight, posts, vring, pending, applied,
+     floor, crashed, crashes) = state
+    bad = []
+    for t in range(cfg.tickets):
+        if applied[t] > 1:
+            bad.append(f"no-double-apply: ticket {t} applied {applied[t]}x")
+    for t in range(floor):
+        if t < cfg.tickets and posts[t] < 1 and not crashed:
+            bad.append(f"floor-safety: floor={floor} covers unposted "
+                       f"ticket {t}")
+    if quiescent and not crashed:
+        for t in range(tail):
+            if applied[t] != 1:
+                bad.append(f"exactly-once: ticket {t} applied "
+                           f"{applied[t]}x at quiescence")
+    return bad
+
+
+def check_ring(cfg: RingConfig | None = None) -> ModelResult:
+    """Exhaustive BFS over every interleaving up to the config bound."""
+    cfg = cfg or RingConfig()
+    N = cfg.tickets
+    zeros = (0,) * N
+    init = (0, 0, (), zeros, zeros, zeros, zeros, 0, False, 0)
+    seen = {init: ()}
+    frontier = [init]
+    violations = []
+    while frontier:
+        nxt = []
+        for state in frontier:
+            trace = seen[state]
+            succ = list(_ring_actions(state, cfg))
+            quiescent = all(name == "SIGKILL" for name, _ in succ)
+            for prop in _check_ring_state(state, cfg, quiescent):
+                violations.append((prop, trace))
+                if len(violations) >= 8:
+                    return ModelResult(False, len(seen), violations)
+            for name, s2 in succ:
+                if s2 not in seen:
+                    seen[s2] = trace + (name,)
+                    nxt.append(s2)
+        frontier = nxt
+    return ModelResult(not violations, len(seen), violations)
+
+
+# ---------------------------------------------------------------------------
+# body ring model
+#
+# State: (enq, scanned, final_enq, lost, verdict, crashes)
+#   enq      windows enqueued (0..windows)
+#   scanned  windows consumed by the scanner on the current carry chain
+#   final_enq  FINAL marker enqueued
+#   lost     a crash broke the carry chain mid-flow (windows consumed
+#            before the crash cannot be re-scanned — their bytes left
+#            the ring)
+#   verdict  None | 'clean' | 'degraded'
+
+
+@dataclass(frozen=True)
+class BodyConfig:
+    windows: int = 3
+    max_crashes: int = 1
+    mutate: Optional[str] = None  # None | 'silent_gap'
+
+
+def _body_actions(state: tuple, cfg: BodyConfig):
+    enq, scanned, final_enq, lost, verdict, crashes = state
+    if verdict is not None:
+        return
+    if enq < cfg.windows:
+        yield ("enqueue", (enq + 1, scanned, final_enq, lost, verdict,
+                           crashes))
+    if enq == cfg.windows and not final_enq:
+        yield ("FINAL", (enq, scanned, True, lost, verdict, crashes))
+    if scanned < enq:
+        yield ("scan", (enq, scanned + 1, final_enq, lost, verdict,
+                        crashes))
+    if crashes < cfg.max_crashes:
+        # SIGKILL mid-flow: the carry (and any scanned windows' bytes)
+        # are gone; scanning a partially-scanned flow can never be made
+        # whole again, which the reattached sidecar must record.
+        yield ("SIGKILL", (enq, scanned, final_enq,
+                           lost or scanned > 0, verdict, crashes + 1))
+    if final_enq and (scanned == cfg.windows or lost):
+        if cfg.mutate == "silent_gap":
+            v = "clean"  # BROKEN: ignores the carry break
+        else:
+            v = "degraded" if lost else "clean"
+        yield ("verdict", (enq, scanned, final_enq, lost, v, crashes))
+
+
+def check_body(cfg: BodyConfig | None = None) -> ModelResult:
+    cfg = cfg or BodyConfig()
+    init = (0, 0, False, False, None, 0)
+    seen = {init: ()}
+    frontier = [init]
+    violations = []
+    while frontier:
+        nxt = []
+        for state in frontier:
+            enq, scanned, final_enq, lost, verdict, crashes = state
+            if verdict == "clean" and (lost or scanned != cfg.windows):
+                violations.append((
+                    f"no-lost-window: clean verdict with scanned="
+                    f"{scanned}/{cfg.windows} lost={lost}", seen[state]))
+                if len(violations) >= 8:
+                    return ModelResult(False, len(seen), violations)
+            for name, s2 in _body_actions(state, cfg):
+                if s2 not in seen:
+                    seen[s2] = seen[state] + (name,)
+                    nxt.append(s2)
+        frontier = nxt
+    return ModelResult(not violations, len(seen), violations)
+
+
+# ---------------------------------------------------------------------------
+
+
+def run(tickets: int = 3, max_crashes: int = 2,
+        mutate: Optional[str] = None, quiet: bool = False) -> int:
+    """Model-check the ring + body protocols; 0 = all properties hold."""
+    rc = 0
+    ring = check_ring(RingConfig(tickets=tickets, max_crashes=max_crashes,
+                                 mutate=mutate))
+    body = check_body(BodyConfig(windows=tickets,
+                                 max_crashes=max_crashes, mutate=mutate))
+    for name, res in (("ring", ring), ("body", body)):
+        if not quiet or not res.ok:
+            print(f"ringcheck[{name}]: "
+                  f"{'OK' if res.ok else 'FAIL'} — {res.describe()}")
+        rc |= 0 if res.ok else 1
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tickets", type=int, default=3)
+    ap.add_argument("--max-crashes", type=int, default=2)
+    ap.add_argument("--mutate", default=None,
+                    choices=["floor_before_post", "silent_gap"])
+    args = ap.parse_args(argv)
+    return run(args.tickets, args.max_crashes, args.mutate)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

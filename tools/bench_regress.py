@@ -77,6 +77,12 @@ TRACKED = (
     # throughput and the staged bytes/request it exists to shrink.
     ("staging_compact_req_per_s", True),
     ("staged_bytes_per_req", False),
+    # Lowering-soundness prover (ISSUE 18, python -m tools.analyze
+    # prove --history): wall time to discharge every obligation on the
+    # seed 500-rule plan — the compile-time proof budget. Prove runs
+    # stamp backend="prove-<jax backend>" so they only ever compare
+    # against other prove runs.
+    ("prove_wall_s", False),
 )
 
 DEFAULT_THRESHOLD = 0.10

@@ -61,6 +61,8 @@ def parent() -> int:
     env["PINGOO_TIMELINE_SAMPLE"] = "1"
     env["PINGOO_PERF_LEDGER"] = os.path.join(tmp, "PERF_LEDGER.jsonl")
     env["PINGOO_COST_LEDGER"] = os.path.join(tmp, "COST_LEDGER.json")
+    env["PINGOO_COMPILE_SURFACE"] = os.path.join(
+        tmp, "COMPILE_SURFACE.json")
     for k in ("PINGOO_TIMELINE_N", "PINGOO_TIMELINE_ROWS",
               "PINGOO_PERF_LEDGER_N", "PINGOO_STAGING", "PINGOO_PIPELINE",
               "PINGOO_MEGASTEP", "PINGOO_MESH", "PINGOO_CHAOS",
@@ -266,10 +268,49 @@ def _sidecar_plane() -> dict:
     return {"ring_join_spans": len(joins)}
 
 
+def _surface_checks(summary: dict) -> None:
+    """ISSUE 18: every ledger compile event must lie inside the
+    statically-proved admissible surface, and an injected out-of-
+    surface compile must be detected."""
+    from pingoo_tpu.obs import REGISTRY
+    from pingoo_tpu.obs.perf import event_in_surface, \
+        get_compile_ledger, load_compile_surface
+
+    ledger = get_compile_ledger()
+    surface = load_compile_surface(os.environ["PINGOO_COMPILE_SURFACE"])
+    snap = ledger.snapshot()
+    check(surface is not None and snap["surface_loaded"],
+          "compile surface loaded by the ledger")
+    escapes = [(e["plane"], e["fn"], event_in_surface(e, surface))
+               for e in snap["events"]
+               if event_in_surface(e, surface)]
+    check(snap["compiles_total"] > 0 and not escapes
+          and snap["unexpected_total"] == 0,
+          f"all {snap['compiles_total']} compile events inside "
+          f"COMPILE_SURFACE.json (escapes={escapes[:3]})")
+    # Inject an out-of-surface compile: the detector must bite.
+    ledger.note(plane="python", fn="verdict", kind="cold", wall_ms=0.1,
+                shapes=[(65, 128)])  # 65 is on no pow2 rung
+    snap2 = ledger.snapshot()
+    check(snap2["unexpected_total"] == 1,
+          f"injected out-of-surface compile detected "
+          f"(unexpected_total={snap2['unexpected_total']})")
+    check("pingoo_compile_unexpected_total"
+          in REGISTRY.prometheus_text(),
+          "scrape exposes pingoo_compile_unexpected_total")
+    summary["surface_events_checked"] = snap["compiles_total"]
+
+
 def child() -> int:
     from pingoo_tpu import native_ring
     from pingoo_tpu.obs import REGISTRY
     from pingoo_tpu.obs.registry import lint_prometheus_text
+
+    # The admissible compile surface must exist BEFORE the first
+    # compile event — the ledger resolves PINGOO_COMPILE_SURFACE once.
+    from tools.analyze import surface as surface_mod
+    surface_mod.write_surface(surface_mod.build_surface(),
+                              os.environ["PINGOO_COMPILE_SURFACE"])
 
     summary = _python_plane()
     if native_ring.ensure_built():
@@ -277,6 +318,7 @@ def child() -> int:
     else:
         print("  note sidecar plane skipped: native toolchain "
               "unavailable")
+    _surface_checks(summary)
 
     text = REGISTRY.prometheus_text()
     problems = lint_prometheus_text(text)
